@@ -1,0 +1,22 @@
+"""accelerate_tpu — a TPU-native training & inference framework.
+
+Ground-up JAX/XLA/Pallas re-design of the HuggingFace Accelerate capability
+surface (reference: /root/reference, see SURVEY.md). The compute path is one
+pjit-compiled train step over explicitly sharded pytrees on a
+`jax.sharding.Mesh`; the runtime around it (state, launcher, data pipeline,
+checkpointing, trackers) mirrors the reference's feature set.
+"""
+
+__version__ = "0.1.0"
+
+from .logging import get_logger
+from .parallel import MeshConfig, build_mesh
+from .state import AcceleratorState, GradientState, ProcessState
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedType,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ProjectConfiguration,
+    set_seed,
+)

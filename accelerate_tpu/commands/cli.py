@@ -1,0 +1,64 @@
+"""`accelerate-tpu` / `atx` CLI entry point.
+
+Analog of the reference `commands/accelerate_cli.py:27-48` subcommand
+registry. Subcommands are registered lazily so importing the CLI stays cheap;
+full implementations arrive with the launcher milestone (`commands/launch.py`,
+`commands/config.py`, ...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="accelerate-tpu",
+        description="TPU-native training & inference framework CLI",
+    )
+    subparsers = parser.add_subparsers(dest="command")
+
+    from . import env as env_cmd
+
+    env_cmd.register(subparsers)
+    try:
+        from . import config as config_cmd
+
+        config_cmd.register(subparsers)
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from . import launch as launch_cmd
+
+        launch_cmd.register(subparsers)
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from . import estimate as estimate_cmd
+
+        estimate_cmd.register(subparsers)
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from . import test as test_cmd
+
+        test_cmd.register(subparsers)
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from . import merge as merge_cmd
+
+        merge_cmd.register(subparsers)
+    except ImportError:  # pragma: no cover
+        pass
+
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 1
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

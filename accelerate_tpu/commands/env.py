@@ -1,0 +1,41 @@
+"""`accelerate-tpu env` — print platform diagnostics for bug reports.
+
+Analog of reference `commands/env.py:47`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser("env", help="Print environment diagnostics")
+    p.set_defaults(func=run)
+
+
+def run(args: argparse.Namespace) -> int:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "JAX backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Local devices": [str(d) for d in jax.local_devices()],
+        "Process count": jax.process_count(),
+    }
+    env_vars = {k: v for k, v in os.environ.items() if k.startswith(("ATX_", "JAX_", "XLA_"))}
+    print("\nCopy-and-paste the text below in your bug report.\n")
+    for key, value in info.items():
+        print(f"- `{key}`: {value}")
+    if env_vars:
+        print("- Framework/JAX environment variables:")
+        for k, v in sorted(env_vars.items()):
+            print(f"  - {k}={v}")
+    return 0

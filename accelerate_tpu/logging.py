@@ -1,0 +1,56 @@
+"""Rank-aware logging.
+
+Analog of the reference `logging.py` (`MultiProcessAdapter` :22,
+`get_logger` :85): log lines are emitted only on the main process unless
+``main_process_only=False``; ``in_order=True`` emits once per process in rank
+order with barriers between ranks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+from .state import ProcessState
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        state = ProcessState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level: int, msg: Any, *args: Any, **kwargs: Any) -> None:
+        if not self.isEnabledFor(level):
+            return
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if not in_order:
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            return
+
+        state = ProcessState()
+        for i in range(state.num_processes):
+            if i == state.process_index:
+                msg2, kwargs2 = self.process(msg, dict(kwargs))
+                self.logger.log(level, msg2, *args, **kwargs2)
+            state.wait_for_everyone()
+
+    def process(self, msg: Any, kwargs: dict) -> tuple[Any, dict]:
+        state = ProcessState()
+        prefix = f"[rank {state.process_index}] " if state.num_processes > 1 else ""
+        return f"{prefix}{msg}", kwargs
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    if log_level is None:
+        log_level = os.environ.get("ATX_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,142 @@
+"""Device-mesh construction for SPMD parallelism.
+
+This replaces the reference's backend zoo (`state.py:734-799` selecting
+nccl/gloo/mpi/xla process groups) with a single concept: a
+`jax.sharding.Mesh` over all devices with the canonical axes
+
+    (data, fsdp, tensor, sequence, expert)
+
+Every parallelism strategy in the framework is a choice of mesh shape plus
+PartitionSpecs over these axes:
+
+- pure DP            -> data=N, everything else 1 (reference DDP,
+  `accelerator.py:1519-1544`)
+- FSDP / ZeRO-3      -> shard params over ``fsdp`` (reference FSDP plugin,
+  `utils/dataclasses.py:1449-1861`)
+- tensor parallel    -> shard weight matrices over ``tensor`` (reference TP,
+  `utils/dataclasses.py:1863-1895`)
+- sequence/context   -> shard the sequence dim over ``sequence`` (reference:
+  Megatron-only flag, `utils/dataclasses.py:2001`; first-class here)
+- expert parallel    -> shard MoE experts over ``expert``
+
+The batch dimension of inputs is sharded over (data, fsdp) jointly — the
+standard TPU recipe where the fsdp axis doubles as a data axis for the input
+pipeline while parameters are sharded over it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical mesh axis names, in fixed order (outermost/slowest-varying first).
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQUENCE_AXIS = "sequence"
+EXPERT_AXIS = "expert"
+
+MESH_AXES: tuple[str, ...] = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS, EXPERT_AXIS)
+
+# Axes over which the global batch is sharded (input pipeline + activations).
+BATCH_AXES: tuple[str, ...] = (DATA_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Declarative mesh shape. ``-1`` on ``data`` means "all remaining devices".
+
+    Replaces the reference's DistributedType selection: instead of picking a
+    backend, the user (or the strategy plugin) picks a mesh factorization.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
+    # Optional explicit device list (defaults to jax.devices()).
+    devices: Sequence[jax.Device] | None = None
+    allow_split_physical_axes: bool = False
+
+    def resolved_shape(self, n_devices: int) -> tuple[int, ...]:
+        fixed = self.fsdp * self.tensor * self.sequence * self.expert
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"Mesh axes fsdp*tensor*sequence*expert={fixed} does not divide "
+                    f"device count {n_devices}"
+                )
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh shape {(data, self.fsdp, self.tensor, self.sequence, self.expert)} "
+                f"uses {total} devices but {n_devices} are available"
+            )
+        return (data, self.fsdp, self.tensor, self.sequence, self.expert)
+
+
+def build_mesh(config: MeshConfig | None = None) -> Mesh:
+    """Construct the global device mesh.
+
+    Uses `mesh_utils.create_device_mesh` so the logical axes are laid out to
+    maximize ICI bandwidth on real TPU topologies (nearest-neighbour torus
+    links for the innermost axes); falls back to a plain reshape when the
+    topology is unknown (CPU simulation).
+    """
+    config = config or MeshConfig()
+    devices = list(config.devices) if config.devices is not None else jax.devices()
+    shape = config.resolved_shape(len(devices))
+    try:
+        device_array = mesh_utils.create_device_mesh(
+            shape,
+            devices=devices,
+            allow_split_physical_axes=config.allow_split_physical_axes,
+        )
+    except (ValueError, AssertionError, NotImplementedError):
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str | Sequence[str]) -> int:
+    if isinstance(axis, str):
+        return mesh.shape[axis]
+    return math.prod(mesh.shape[a] for a in axis)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of data-parallel replicas = product of the batch axes."""
+    return mesh_axis_size(mesh, BATCH_AXES)
+
+
+def batch_spec(extra: PartitionSpec | None = None) -> PartitionSpec:
+    """PartitionSpec for a batch-leading array: batch over (data, fsdp)."""
+    if extra is None:
+        return PartitionSpec(BATCH_AXES)
+    return PartitionSpec(BATCH_AXES, *extra)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_count(mesh: Mesh) -> int:
+    """How many batch shards live on this process (for host-sharded loading)."""
+    return data_parallel_size(mesh) // jax.process_count()

@@ -1,0 +1,29 @@
+from .dataclasses import (
+    DataLoaderConfiguration,
+    DistributedType,
+    FsdpPlugin,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    PrecisionType,
+    ProjectConfiguration,
+    RNGType,
+    ShardingStrategyType,
+    TensorParallelPlugin,
+)
+from .environment import (
+    clear_environment,
+    get_int_from_env,
+    get_str_from_env,
+    parse_flag_from_env,
+    patch_environment,
+    purge_framework_environment,
+    str_to_bool,
+)
+from .random import (
+    key_for_process,
+    key_for_step,
+    load_rng_state_dict,
+    rng_state_dict,
+    set_seed,
+    synchronize_rng_states,
+)

@@ -1,0 +1,212 @@
+"""Configuration dataclasses & enums.
+
+Analog of the reference `utils/dataclasses.py` (2,620 LoC of plugins/enums).
+The TPU design needs far fewer knobs because whole subsystems (DDP comm hooks,
+GradScaler, dynamo backends, per-vendor process groups) have no equivalent —
+they collapse into mesh shape + PartitionSpecs + dtype policy. Every config
+here supports the same env-var fallback contract as the reference (plugin
+``__post_init__`` reading ``ACCELERATE_*`` — here ``ATX_*``) so the launcher
+can configure child processes through the environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+
+from .environment import get_int_from_env, parse_flag_from_env
+
+
+class BaseEnum(str, enum.Enum):
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+    @classmethod
+    def list(cls) -> list[str]:
+        return [e.value for e in cls]
+
+
+class DistributedType(BaseEnum):
+    """Runtime topology (reference `DistributedType`, `dataclasses.py:552`).
+
+    The reference enumerates backends (MULTI_GPU/DEEPSPEED/FSDP/XLA/...); on
+    TPU the runtime question is only "how many processes/devices", and the
+    *strategy* question lives in `ShardingStrategyType`.
+    """
+
+    NO = "NO"
+    MULTI_DEVICE = "MULTI_DEVICE"  # 1 process, >1 local device (SPMD)
+    MULTI_HOST = "MULTI_HOST"  # >1 process (TPU pod slice / DCN)
+
+
+class ShardingStrategyType(BaseEnum):
+    """How params/grads/optimizer state are laid out on the mesh.
+
+    Maps the reference's plugin zoo onto PartitionSpec policies:
+    - DATA_PARALLEL: replicate params (reference DDP, `accelerator.py:1519`)
+    - ZERO1: replicate params, shard optimizer state over data axis
+      (DeepSpeed stage 1, `utils/dataclasses.py:1019`)
+    - FSDP: shard params+grads+opt over the fsdp axis (torch FSDP
+      FULL_SHARD / ZeRO-3, `utils/dataclasses.py:1449`)
+    - TENSOR_PARALLEL: shard weight matrices over the tensor axis
+      (`utils/dataclasses.py:1863`)
+    - HYBRID: any combination via explicit mesh shape + rules.
+    """
+
+    DATA_PARALLEL = "DATA_PARALLEL"
+    ZERO1 = "ZERO1"
+    FSDP = "FSDP"
+    TENSOR_PARALLEL = "TENSOR_PARALLEL"
+    HYBRID = "HYBRID"
+
+
+class PrecisionType(BaseEnum):
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+
+class RNGType(BaseEnum):
+    PYTHON = "python"
+    NUMPY = "numpy"
+    JAX = "jax"
+
+
+_DTYPES = {
+    PrecisionType.NO: jnp.float32,
+    PrecisionType.BF16: jnp.bfloat16,
+    PrecisionType.FP16: jnp.float16,
+    PrecisionType.FP8: jnp.float8_e4m3fn,
+}
+
+
+@dataclass
+class MixedPrecisionPolicy:
+    """Dtype policy: fp32 master params, low-precision compute.
+
+    Replaces torch autocast + GradScaler (reference `accelerator.py:528-577`,
+    `utils/modeling.py:2011-2054`): on TPU bf16 compute needs no loss scaling,
+    so the policy is just three dtypes applied functionally.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
+        precision = PrecisionType(precision)
+        if precision == PrecisionType.NO:
+            return cls()
+        compute = _DTYPES[precision]
+        return cls(param_dtype=jnp.float32, compute_dtype=compute, output_dtype=jnp.float32)
+
+    def cast_for_compute(self, tree: Any) -> Any:
+        import jax
+
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+@dataclass
+class GradientAccumulationPlugin:
+    """Reference `GradientAccumulationPlugin` (`dataclasses.py:920`).
+
+    ``adjust_scheduler`` and ``sync_with_dataloader`` keep their reference
+    meanings; ``sync_each_batch`` is irrelevant on TPU (accumulation happens
+    inside one compiled step, there is no unsynced gradient hook to manage).
+    """
+
+    num_steps: int | None = None
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+
+    def __post_init__(self) -> None:
+        if self.num_steps is None:
+            self.num_steps = get_int_from_env(("ATX_GRADIENT_ACCUMULATION_STEPS",), 1)
+
+
+@dataclass
+class DataLoaderConfiguration:
+    """Reference `DataLoaderConfiguration` (`dataclasses.py:762`)."""
+
+    split_batches: bool = False
+    dispatch_batches: bool | None = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    non_blocking: bool = True  # device prefetch is always async on TPU
+    prefetch_size: int = 2
+
+
+@dataclass
+class ProjectConfiguration:
+    """Reference `ProjectConfiguration` (`dataclasses.py:857`)."""
+
+    project_dir: str | None = None
+    logging_dir: str | None = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: int | None = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: str | None = None) -> None:
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self) -> None:
+        self.set_directories(self.project_dir)
+
+
+@dataclass
+class FsdpPlugin:
+    """FSDP/ZeRO-3-style sharding config (reference `dataclasses.py:1449-1861`).
+
+    ``min_weight_size`` mirrors size-based auto-wrap: tensors smaller than
+    this stay replicated (sharding tiny params wastes collective latency).
+    ``state_dict_type`` chooses consolidated vs sharded checkpoint layout
+    (reference FULL_STATE_DICT / SHARDED_STATE_DICT, `constants.py:39`).
+    """
+
+    reshard_after_forward: bool = True  # FULL_SHARD vs SHARD_GRAD_OP analog
+    min_weight_size: int = 2**11
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    cpu_offload: bool = False
+    activation_checkpointing: bool = False
+
+    def __post_init__(self) -> None:
+        if parse_flag_from_env("ATX_FSDP_CPU_OFFLOAD"):
+            self.cpu_offload = True
+        if parse_flag_from_env("ATX_FSDP_ACTIVATION_CHECKPOINTING"):
+            self.activation_checkpointing = True
+        env_sdt = os.environ.get("ATX_FSDP_STATE_DICT_TYPE")
+        if env_sdt:
+            self.state_dict_type = env_sdt
+
+
+@dataclass
+class TensorParallelPlugin:
+    """TP config (reference `dataclasses.py:1863-1895`): mesh size + plan name."""
+
+    tp_size: int | None = None
+    plan: str | None = None  # named rule-set in parallel/tp.py registry
+
+    def __post_init__(self) -> None:
+        if self.tp_size is None:
+            self.tp_size = get_int_from_env(("ATX_TP_SIZE",), 1)
+
+
+def asdict_not_none(obj: Any) -> dict[str, Any]:
+    return {
+        k: v for k, v in dataclasses.asdict(obj).items() if v is not None
+    }

@@ -1,0 +1,95 @@
+"""Environment-variable helpers.
+
+TPU-native analog of the reference environment layer
+(`/root/reference/src/accelerate/utils/environment.py`): typed env parsing, a
+context manager for temporarily patching the environment (used heavily by the
+test suite), and detection of the JAX runtime platform.
+
+All framework env vars use the ``ATX_`` prefix (mirroring the reference's
+``ACCELERATE_`` contract, `utils/launch.py:98-470`) so the launcher can
+configure the library in child processes purely through the environment.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+_FALSE = {"0", "false", "no", "n", "off", ""}
+
+
+def str_to_bool(value: str) -> bool:
+    value = value.strip().lower()
+    if value in _TRUE:
+        return True
+    if value in _FALSE:
+        return False
+    raise ValueError(f"Cannot interpret {value!r} as a boolean")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key)
+    if value is None:
+        return default
+    return str_to_bool(value)
+
+
+def get_int_from_env(keys: list[str] | tuple[str, ...], default: int) -> int:
+    for key in keys:
+        value = os.environ.get(key)
+        if value is not None and value != "":
+            return int(value)
+    return default
+
+
+def get_str_from_env(keys: list[str] | tuple[str, ...], default: str = "") -> str:
+    for key in keys:
+        value = os.environ.get(key)
+        if value is not None and value != "":
+            return value
+    return default
+
+
+@contextmanager
+def patch_environment(**kwargs: Any) -> Iterator[None]:
+    """Temporarily set env vars (upper-cased keys), restoring prior state on exit.
+
+    Mirrors the reference helper at `utils/environment.py:291-360`; pass
+    ``key=None`` to unset a variable for the duration of the block.
+    """
+    saved: dict[str, str | None] = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        saved[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+@contextmanager
+def clear_environment(prefixes: tuple[str, ...] = ("ATX_",)) -> Iterator[None]:
+    """Remove all framework env vars for the duration of the block."""
+    saved = {k: v for k, v in os.environ.items() if k.startswith(prefixes)}
+    for k in saved:
+        del os.environ[k]
+    try:
+        yield
+    finally:
+        os.environ.update(saved)
+
+
+def purge_framework_environment() -> None:
+    """Unconditionally remove every ``ATX_*`` env var (test isolation helper)."""
+    for key in [k for k in os.environ if k.startswith("ATX_")]:
+        del os.environ[key]

@@ -1,0 +1,95 @@
+"""Seeding & PRNG management.
+
+Analog of the reference `utils/random.py` (`set_seed` :39,
+`synchronize_rng_states` :154). The reference must *broadcast* rank-0 RNG
+state to keep torch generators aligned; JAX PRNG keys are pure values derived
+from an integer seed, so cross-process agreement is achieved by construction —
+every process derives the same root key, and per-process/per-step streams are
+``fold_in``s of it. What still needs explicit state management is the *host*
+RNG used for data shuffling (numpy / python random), which checkpointing must
+capture (reference `checkpointing.py:148-171`).
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+
+
+def set_seed(seed: int, *, device_specific: bool = False) -> jax.Array:
+    """Seed python/numpy RNGs and return the root JAX PRNG key.
+
+    With ``device_specific=True`` the returned key is folded with the process
+    index (reference `set_seed(..., device_specific=True)` adds rank to seed).
+    """
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    key = jax.random.PRNGKey(seed)
+    if device_specific:
+        key = jax.random.fold_in(key, jax.process_index())
+    return key
+
+
+def key_for_step(root: jax.Array, step: int) -> jax.Array:
+    """Deterministic per-step stream: fold the step counter into the root key."""
+    return jax.random.fold_in(root, step)
+
+
+def key_for_process(root: jax.Array, process_index: int | None = None) -> jax.Array:
+    if process_index is None:
+        process_index = jax.process_index()
+    return jax.random.fold_in(root, process_index)
+
+
+def split_for_devices(root: jax.Array, n: int) -> jax.Array:
+    return jax.random.split(root, n)
+
+
+def rng_state_dict() -> dict[str, Any]:
+    """Capture host RNG state (python, numpy) for checkpointing."""
+    return {
+        "python": _py_random.getstate(),
+        "numpy": np.random.get_state(),
+    }
+
+
+def load_rng_state_dict(state: dict[str, Any]) -> None:
+    if "python" in state:
+        _py_random.setstate(state["python"])
+    if "numpy" in state:
+        np_state = state["numpy"]
+        if isinstance(np_state, (list, tuple)) and len(np_state) == 5:
+            np_state = (
+                np_state[0],
+                np.asarray(np_state[1], dtype=np.uint32),
+                int(np_state[2]),
+                int(np_state[3]),
+                float(np_state[4]),
+            )
+        np.random.set_state(np_state)
+
+
+def synchronize_rng_states(kinds: Iterable[str] = ("python", "numpy")) -> None:
+    """Force all processes to the main process's host RNG state.
+
+    Cross-process host RNG agreement (reference `utils/random.py:78-156`).
+    JAX device PRNG never needs this; only host-side shuffling does, and the
+    framework's samplers are seeded deterministically anyway — this exists for
+    user code that consumed host randomness unevenly across ranks.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    seed = np.zeros((), dtype=np.int64)
+    if jax.process_index() == 0:
+        seed = np.asarray(np.random.randint(0, 2**31 - 1), dtype=np.int64)
+    seed = int(multihost_utils.broadcast_one_to_all(seed))
+    kinds = set(kinds)
+    if "python" in kinds:
+        _py_random.seed(seed)
+    if "numpy" in kinds:
+        np.random.seed(seed % (2**32))

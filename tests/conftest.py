@@ -1,0 +1,41 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference tests multi-process behavior by launching driver scripts under
+`accelerate launch` on real multi-GPU runners (SURVEY.md §4). Here the primary
+harness is JAX's host-platform device simulation: 8 virtual CPU devices let
+every sharding/collective path run in plain single-process CI, which the
+reference cannot do. Multi-process paths are additionally covered by
+subprocess-launched driver scripts in `tests/scripts/`.
+"""
+
+import os
+import sys
+
+# Must be set before jax initializes its backends.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# Force CPU: the surrounding environment may point JAX at a real TPU
+# (JAX_PLATFORMS=axon); tests always run on the virtual 8-device CPU mesh.
+# sitecustomize may have latched JAX_PLATFORMS at interpreter start, so update
+# the live config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Fresh state singletons per test (reference `AccelerateTestCase`,
+    `test_utils/testing.py:595-606`)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, ProcessState
+
+    yield
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    ProcessState._reset_state()

@@ -1,0 +1,62 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from accelerate_tpu.parallel.mesh import (
+    MeshConfig,
+    batch_sharding,
+    batch_spec,
+    build_mesh,
+    data_parallel_size,
+    mesh_axis_size,
+    replicated_sharding,
+    single_device_mesh,
+)
+
+
+def test_default_mesh_all_data():
+    mesh = build_mesh()
+    assert mesh.shape["data"] == 8
+    assert data_parallel_size(mesh) == 8
+
+
+def test_mesh_factorization():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert data_parallel_size(mesh) == 4  # data * fsdp
+    assert mesh_axis_size(mesh, ("data", "tensor")) == 4
+
+
+def test_mesh_infer_data_axis():
+    mesh = build_mesh(MeshConfig(fsdp=4))
+    assert mesh.shape["data"] == 2
+
+
+def test_mesh_invalid_factorization():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(data=3, fsdp=3))
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(fsdp=3))
+
+
+def test_batch_spec():
+    assert batch_spec() == PartitionSpec(("data", "fsdp"))
+    assert batch_spec(PartitionSpec("sequence")) == PartitionSpec(("data", "fsdp"), "sequence")
+
+
+def test_shardings_place_arrays():
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = jax.device_put(x, batch_sharding(mesh))
+    assert arr.sharding.is_fully_replicated is False
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    r = jax.device_put(x, replicated_sharding(mesh))
+    assert r.sharding.is_fully_replicated
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert mesh.devices.size == 1

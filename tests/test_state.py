@@ -1,0 +1,75 @@
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.state import AcceleratorState, GradientState, ProcessState
+
+
+def test_process_state_singleton():
+    a = ProcessState()
+    b = ProcessState()
+    assert a.__dict__ is b.__dict__
+    assert a.initialized
+    assert a.num_processes == 1
+    assert a.is_main_process and a.is_last_process
+    assert a.device_count == 8  # virtual CPU mesh from conftest
+
+
+def test_wait_for_everyone_noop():
+    ProcessState().wait_for_everyone()
+
+
+def test_split_between_processes_single():
+    state = ProcessState()
+    with state.split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_split_between_processes_math():
+    # Simulate the index math directly for an 3-way split of 8 elements.
+    state = ProcessState()
+    state.__dict__["num_processes"] = 3
+    items = list(range(8))
+    chunks = []
+    for rank in range(3):
+        state.__dict__["process_index"] = rank
+        with state.split_between_processes(items) as chunk:
+            chunks.append(list(chunk))
+    assert chunks == [[0, 1, 2], [3, 4, 5], [6, 7]]
+    # Padding makes all chunks the same length by repeating the last element.
+    state.__dict__["process_index"] = 2
+    with state.split_between_processes(items, apply_padding=True) as chunk:
+        assert list(chunk) == [6, 7, 7]
+    # dict splitting
+    state.__dict__["process_index"] = 0
+    with state.split_between_processes({"a": [1, 2, 3, 4], "b": [5, 6, 7, 8]}) as d:
+        assert d == {"a": [1, 2], "b": [5, 6]}
+    # numpy splitting with padding
+    state.__dict__["process_index"] = 2
+    with state.split_between_processes(np.arange(8), apply_padding=True) as arr:
+        np.testing.assert_array_equal(arr, [6, 7, 7])
+
+
+def test_accelerator_state_mesh():
+    state = AcceleratorState()
+    mesh = state.mesh
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("data", "fsdp", "tensor", "sequence", "expert")
+    assert state.num_processes == 1  # delegation to ProcessState
+
+
+def test_gradient_state():
+    gs = GradientState()
+    assert gs.num_steps == 1
+    assert gs.sync_gradients
+    assert not gs.in_dataloader
+    GradientState(gradient_accumulation_steps=4)
+    assert gs.num_steps == 4  # singleton
+
+
+def test_on_main_process_decorator():
+    state = ProcessState()
+    calls = []
+    fn = state.on_main_process(lambda: calls.append(1))
+    fn()
+    assert calls == [1]
