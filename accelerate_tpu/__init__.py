@@ -9,14 +9,20 @@ checkpointing, trackers) mirrors the reference's feature set.
 
 __version__ = "0.1.0"
 
+from .accelerator import Accelerator, TrainState
+from .data import DataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
+from .parallel.sharding import ShardingStrategy
 from .state import AcceleratorState, GradientState, ProcessState
 from .utils import (
     DataLoaderConfiguration,
     DistributedType,
+    FsdpPlugin,
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     ProjectConfiguration,
+    ShardingStrategyType,
+    TensorParallelPlugin,
     set_seed,
 )

@@ -19,39 +19,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     subparsers = parser.add_subparsers(dest="command")
 
-    from . import env as env_cmd
+    import importlib
 
-    env_cmd.register(subparsers)
-    try:
-        from . import config as config_cmd
-
-        config_cmd.register(subparsers)
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import launch as launch_cmd
-
-        launch_cmd.register(subparsers)
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import estimate as estimate_cmd
-
-        estimate_cmd.register(subparsers)
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import test as test_cmd
-
-        test_cmd.register(subparsers)
-    except ImportError:  # pragma: no cover
-        pass
-    try:
-        from . import merge as merge_cmd
-
-        merge_cmd.register(subparsers)
-    except ImportError:  # pragma: no cover
-        pass
+    for name in ("env", "config", "launch", "estimate", "test", "merge"):
+        try:
+            module = importlib.import_module(f".{name}", package=__package__)
+        except ImportError as e:
+            # Only tolerate the subcommand module itself being absent; a
+            # broken import *inside* an existing module must surface.
+            if e.name == f"{__package__}.{name}":
+                continue
+            raise
+        module.register(subparsers)
 
     args = parser.parse_args(argv)
     if args.command is None:

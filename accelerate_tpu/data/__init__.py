@@ -1,0 +1,8 @@
+from .loader import DataLoader, default_collate, prepare_data_loader, skip_first_batches
+from .sampler import (
+    SeedableSampler,
+    batch_indices,
+    shard_batches,
+    shard_iterable,
+    sharded_length,
+)

@@ -1,0 +1,250 @@
+"""Deterministic sampling & cross-process batch-sharding index math.
+
+Behavioral spec from the reference (`data_loader.py` — `SeedableRandomSampler`
+:72, `BatchSamplerShard` :109-262, `IterableDatasetShard` :265-364), re-built
+as pure generators over index lists (no torch sampler classes):
+
+- every process always sees the same number of batches, all of equal size,
+  unless ``even_batches=False``;
+- with ``even_batches=True`` the tail is completed by cycling samples from the
+  *beginning* of the epoch (the reference's wraparound contract);
+- ``split_batches=True`` slices each global batch into per-process pieces
+  instead of handing out alternating full batches.
+
+These generators are the single source of truth for which sample lands on
+which process at which step — the device loader (`data/loader.py`) only
+materializes them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+class SeedableSampler:
+    """Deterministic (optionally shuffled) index stream, re-seeded per epoch.
+
+    Reference `SeedableRandomSampler` (`data_loader.py:72`): identical
+    permutations on every process for a given (seed, epoch) pair, so shards
+    are disjoint by construction.
+    """
+
+    def __init__(self, num_samples: int, shuffle: bool = True, seed: int = 0) -> None:
+        self.num_samples = num_samples
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.RandomState(seed=(self.seed + self.epoch) % (2**32))
+            yield from rng.permutation(self.num_samples).tolist()
+        else:
+            yield from range(self.num_samples)
+
+
+def batch_indices(
+    sampler: Iterable[int], batch_size: int, drop_last: bool = False
+) -> Iterator[list[int]]:
+    """Group an index stream into batches (torch `BatchSampler` analog)."""
+    batch: list[int] = []
+    for idx in sampler:
+        batch.append(idx)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch
+
+
+def shard_batches(
+    batches: Iterable[Sequence[int]],
+    num_processes: int,
+    process_index: int,
+    *,
+    batch_size: int,
+    split_batches: bool = False,
+    even_batches: bool = True,
+    drop_last: bool = False,
+) -> Iterator[list[int]]:
+    """Yield this process's batches from a global batch stream.
+
+    Contract of reference `BatchSamplerShard` (`data_loader.py:109-262`):
+
+    - ``split_batches=False``: batch *k* of the underlying stream goes to
+      process ``k % num_processes``; a full round of ``num_processes``
+      batches is required before any is released. Tail handling: drop_last
+      drops the incomplete round; ``even_batches`` completes it by cycling
+      samples collected from the first round; otherwise processes holding a
+      leftover batch yield it unevenly.
+    - ``split_batches=True``: each global batch (size must divide by
+      ``num_processes``) is sliced; the tail is completed from the first
+      batch's samples when ``even_batches``.
+    """
+    if split_batches:
+        yield from _shard_split(
+            batches, num_processes, process_index, batch_size, even_batches, drop_last
+        )
+    else:
+        yield from _shard_no_split(
+            batches, num_processes, process_index, batch_size, even_batches, drop_last
+        )
+
+
+def _shard_split(
+    batches: Iterable[Sequence[int]],
+    num_processes: int,
+    process_index: int,
+    batch_size: int,
+    even_batches: bool,
+    drop_last: bool,
+) -> Iterator[list[int]]:
+    if batch_size % num_processes != 0:
+        raise ValueError(
+            f"split_batches requires the global batch size ({batch_size}) to be a "
+            f"round multiple of the number of processes ({num_processes})."
+        )
+    piece = batch_size // num_processes
+    lo, hi = piece * process_index, piece * (process_index + 1)
+    first: list[int] = []
+    last: list[int] = []
+    for i, batch in enumerate(batches):
+        batch = list(batch)
+        if i == 0:
+            first = batch
+        last = batch
+        if len(batch) == batch_size:
+            yield batch[lo:hi]
+    if drop_last or not first or len(last) == batch_size:
+        return
+    if not even_batches:
+        if len(last) > lo:
+            yield last[lo:hi]
+        return
+    fill = list(first)
+    while len(fill) < batch_size:
+        fill += fill
+    completed = last + fill
+    yield completed[lo:hi]
+
+
+def _shard_no_split(
+    batches: Iterable[Sequence[int]],
+    num_processes: int,
+    process_index: int,
+    batch_size: int,
+    even_batches: bool,
+    drop_last: bool,
+) -> Iterator[list[int]]:
+    first_round: list[int] = []
+    mine: list[int] = []
+    last: list[int] = []
+    count = 0
+    for count, batch in enumerate(batches, start=1):
+        batch = list(batch)
+        if not drop_last and count <= num_processes:
+            first_round += batch
+        if (count - 1) % num_processes == process_index:
+            mine = batch
+        last = batch
+        if count % num_processes == 0 and len(batch) == batch_size:
+            yield mine
+            mine = []
+    if drop_last or not first_round:
+        return
+    if not even_batches:
+        if mine:
+            yield mine
+        return
+    # A full round whose last batch was full has already been yielded above;
+    # anything else must be completed by cycling first-round samples so every
+    # process ends the epoch with the same batch count and size.
+    if count % num_processes == 0 and len(last) == batch_size:
+        return
+    # A full-size batch held from the unfinished round is released as-is; a
+    # short one is completed inside the recycle loop below.
+    if len(mine) == batch_size:
+        yield mine
+    fill = list(first_round)
+    while len(fill) < num_processes * batch_size:
+        fill += fill
+    if len(last) == batch_size:
+        # The trailing partial round consists of full batches only; processes
+        # beyond it get recycled batches.
+        carry: list[int] = []
+        idx = count
+    else:
+        carry = last
+        idx = count - 1  # the partial batch is re-issued, completed
+    cursor = 0
+    while idx % num_processes != 0 or carry:
+        take = batch_size - len(carry)
+        carry = carry + fill[cursor : cursor + take]
+        cursor += take
+        if idx % num_processes == process_index:
+            yield carry
+        carry = []
+        idx += 1
+
+
+def shard_iterable(
+    iterable: Iterable[Any],
+    *,
+    batch_size: int,
+    num_processes: int,
+    process_index: int,
+    split_batches: bool = False,
+    drop_last: bool = False,
+) -> Iterator[Any]:
+    """Per-process element stream over a shared iterable dataset.
+
+    Contract of reference `IterableDatasetShard` (`data_loader.py:265-364`):
+    buffer ``real_batch_size`` elements (``batch_size`` if split_batches else
+    ``batch_size * num_processes``), hand this process its contiguous slice;
+    complete the tail by cycling the first buffered batch unless drop_last.
+    """
+    real = batch_size if split_batches else batch_size * num_processes
+    per_process = batch_size // num_processes if split_batches else batch_size
+    lo = process_index * per_process
+    hi = lo + per_process
+
+    first: list[Any] | None = None
+    buf: list[Any] = []
+    for element in iterable:
+        buf.append(element)
+        if len(buf) == real:
+            yield from buf[lo:hi]
+            if first is None:
+                first = list(buf)
+            buf = []
+    if drop_last or not buf:
+        return
+    if first is None:
+        first = list(buf)
+    while len(buf) < real:
+        buf += first
+    yield from buf[lo:hi]
+
+
+def sharded_length(
+    total: int, batch_size: int, num_processes: int, drop_last: bool, even_batches: bool = True
+) -> int:
+    """Number of batches each process will see (reference
+    `BatchSamplerShard.__len__`, `data_loader.py:175-191`)."""
+    n_batches = total // batch_size if drop_last else math.ceil(total / batch_size)
+    if n_batches % num_processes == 0:
+        return n_batches // num_processes
+    if drop_last:
+        return n_batches // num_processes
+    if even_batches:
+        return n_batches // num_processes + 1
+    return n_batches // num_processes  # + 1 only for low process indices

@@ -1,0 +1,317 @@
+"""Pytree collectives & data-movement operations.
+
+Analog of the reference `utils/operations.py` (867 LoC): pytree-recursive
+gather/broadcast/reduce/pad, host-object collectives, device placement, dtype
+conversion, and the debug-mode cross-process shape check
+(`verify_operation`, reference `utils/operations.py:355-417`).
+
+Two regimes, cleanly separated:
+
+1. **Host-level** (this module): operates on process-local numpy/JAX arrays or
+   already-global sharded `jax.Array`s, *outside* jit. Multi-host transport is
+   the JAX runtime (`multihost_utils`) — the analog of the reference's
+   `torch.distributed.all_gather`/`broadcast_object_list` calls.
+2. **In-jit** (`ops/in_jit.py` re-exports): `lax.psum`/`all_gather`/`ppermute`
+   inside `shard_map`-ped compiled code — the reference has no equivalent; its
+   collectives always run eagerly from Python.
+
+The reference's `recursively_apply` (`operations.py:84`) is `jax.tree.map`.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..state import ProcessState
+from ..utils.environment import parse_flag_from_env
+
+
+class DistributedOperationException(Exception):
+    """Raised when a collective would be called with mismatched inputs across
+    processes (reference `operations.py:355`)."""
+
+
+def _is_jax_array(x: Any) -> bool:
+    return isinstance(x, jax.Array)
+
+
+def _is_arraylike(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) or np.isscalar(x)
+
+
+def is_tensor_tree(tree: Any) -> bool:
+    leaves = jax.tree.leaves(tree)
+    return len(leaves) > 0 and all(_is_arraylike(leaf) for leaf in leaves)
+
+
+# --------------------------------------------------------------------- debug
+def _tree_signature(tree: Any) -> str:
+    def leaf_sig(x: Any) -> str:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return f"{tuple(x.shape)}:{x.dtype}"
+        return type(x).__name__
+
+    structure = jax.tree.structure(tree)
+    leaves = [leaf_sig(leaf) for leaf in jax.tree.leaves(tree)]
+    return f"{structure}|{leaves}"
+
+
+def verify_operation(name: str, tree: Any) -> None:
+    """Debug-mode agreement check: all processes must pass identically
+    structured/shaped pytrees to a collective. Enabled via ``ATX_DEBUG_MODE=1``
+    (reference ``ACCELERATE_DEBUG_MODE``, `operations.py:355-417`)."""
+    state = ProcessState()
+    if not state.debug or state.num_processes == 1:
+        return
+    sig = _tree_signature(tree)
+    sigs = gather_object([sig])
+    if len(set(sigs)) > 1:
+        raise DistributedOperationException(
+            f"Mismatch in inputs to collective `{name}` across processes:\n"
+            + "\n".join(f"  process {i}: {s}" for i, s in enumerate(sigs))
+        )
+
+
+# ------------------------------------------------------------------ movement
+def send_to_device(tree: Any, sharding: NamedSharding | jax.Device | None = None) -> Any:
+    """Place a pytree on device(s) (reference `send_to_device`,
+    `operations.py:135`). With a `NamedSharding`, forms global sharded arrays;
+    with a device or None, plain transfer."""
+    if sharding is None:
+        sharding = jax.devices()[0]
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def to_host(tree: Any) -> Any:
+    """Fetch a pytree of (possibly sharded but fully-addressable) arrays to
+    host numpy."""
+    return jax.tree.map(lambda x: np.asarray(x) if _is_jax_array(x) else x, tree)
+
+
+def convert_to_fp32(tree: Any) -> Any:
+    """Upcast all half-precision leaves to float32 (reference
+    `convert_to_fp32`, `operations.py:765`)."""
+
+    def _convert(x: Any) -> Any:
+        if hasattr(x, "dtype") and x.dtype in (jnp.float16, jnp.bfloat16):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree.map(_convert, tree)
+
+
+def find_batch_size(tree: Any) -> int:
+    """First leaf's leading dimension (reference `find_batch_size`,
+    `operations.py:242`)."""
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "shape") and len(leaf.shape) > 0:
+            return int(leaf.shape[0])
+    raise ValueError("Cannot find the batch size from an empty pytree.")
+
+
+def slice_tensors(tree: Any, tensor_slice: slice) -> Any:
+    """Slice every leaf along dim 0 (reference `operations.py:581`)."""
+    return jax.tree.map(
+        lambda x: x[tensor_slice] if hasattr(x, "shape") and len(x.shape) else x, tree
+    )
+
+
+def concatenate(trees: Sequence[Any], dim: int = 0) -> Any:
+    """Concatenate a list of same-structure pytrees leafwise (reference
+    `operations.py:601`)."""
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=dim), *trees)
+
+
+def get_data_structure(tree: Any) -> Any:
+    """Shape/dtype skeleton of a pytree (reference `get_data_structure`,
+    `operations.py:232`), as `jax.ShapeDtypeStruct`s."""
+    return jax.tree.map(lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype), tree)
+
+
+def initialize_tensors(structure: Any) -> Any:
+    """Materialize zeros matching a `get_data_structure` skeleton (reference
+    `operations.py:219`)."""
+    return jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), structure)
+
+
+# ---------------------------------------------------------------- collectives
+def _process_allgather(x: Any, tiled: bool) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=tiled))
+
+
+def gather(tree: Any) -> Any:
+    """All-gather a pytree across the data-parallel world; returns host numpy.
+
+    Reference `gather` (`operations.py:419`): every rank's `[B, ...]` tensor
+    becomes `[B * world, ...]` on all ranks. Here there are two cases:
+
+    - A *global* sharded `jax.Array` (the output of a jitted SPMD step)
+      already **is** the concatenation; gather materializes it to host,
+      all-gathering across hosts if shards are remote.
+    - A *process-local* value (numpy or single-device array) is concatenated
+      across processes along dim 0.
+    """
+    verify_operation("gather", tree)
+    state = ProcessState()
+
+    def _gather_leaf(x: Any) -> Any:
+        if _is_jax_array(x) and getattr(x, "is_fully_addressable", True):
+            if state.num_processes == 1:
+                return np.asarray(x)
+            return _process_allgather(np.asarray(x), tiled=True)
+        if _is_jax_array(x):
+            # Global array with remote shards: replicate via the runtime.
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        if state.num_processes == 1:
+            return np.asarray(x)
+        return _process_allgather(np.asarray(x), tiled=True)
+
+    return jax.tree.map(_gather_leaf, tree)
+
+
+def reduce(tree: Any, reduction: str = "mean") -> Any:
+    """Sum/mean a pytree across processes (reference `reduce`,
+    `operations.py:724`). ``reduction`` in {"sum", "mean", "none"}."""
+    if reduction == "none":
+        return tree
+    verify_operation("reduce", tree)
+    state = ProcessState()
+
+    def _reduce_leaf(x: Any) -> np.ndarray:
+        arr = np.asarray(x)
+        if state.num_processes == 1:
+            return arr.copy()
+        stacked = _process_allgather(arr, tiled=False)
+        out = stacked.sum(axis=0)
+        if reduction == "mean":
+            out = out / state.num_processes
+        return out.astype(arr.dtype)
+
+    return jax.tree.map(_reduce_leaf, tree)
+
+
+def broadcast(tree: Any, from_process: int = 0) -> Any:
+    """Broadcast a pytree of arrays from one process to all (reference
+    `broadcast`, `operations.py:539`)."""
+    verify_operation("broadcast", tree)
+    state = ProcessState()
+    if state.num_processes == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    if from_process != 0:
+        # broadcast_one_to_all sources from process 0; route through an
+        # object gather for non-zero roots (rare path, host-sized data).
+        gathered = gather_object([to_host(tree)])
+        return gathered[from_process]
+    return jax.tree.map(
+        lambda x: np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x))), tree
+    )
+
+
+def pad_across_processes(tree: Any, dim: int = 0, pad_index: int = 0, pad_first: bool = False) -> Any:
+    """Pad each process's tensors to the max size along ``dim`` across
+    processes (reference `pad_across_processes`, `operations.py:628`)."""
+    state = ProcessState()
+
+    def _pad_leaf(x: Any) -> np.ndarray:
+        arr = np.asarray(x)
+        if arr.ndim == 0 or dim >= arr.ndim:
+            return arr
+        if state.num_processes == 1:
+            return arr
+        sizes = gather_object([arr.shape[dim]])
+        max_size = max(sizes)
+        if arr.shape[dim] == max_size:
+            return arr
+        pad_width = [(0, 0)] * arr.ndim
+        if pad_first:
+            pad_width[dim] = (max_size - arr.shape[dim], 0)
+        else:
+            pad_width[dim] = (0, max_size - arr.shape[dim])
+        return np.pad(arr, pad_width, constant_values=pad_index)
+
+    return jax.tree.map(_pad_leaf, tree)
+
+
+def pad_input_tensors(tree: Any, batch_size: int, num_processes: int, dim: int = 0) -> Any:
+    """Pad a batch so it divides evenly across processes by repeating the last
+    row (reference `pad_input_tensors`, `operations.py:683`)."""
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tree
+    pad_count = num_processes - remainder
+
+    def _pad_leaf(x: Any) -> np.ndarray:
+        arr = np.asarray(x)
+        if arr.ndim == 0 or arr.shape[dim] != batch_size:
+            return arr
+        last = np.take(arr, [-1], axis=dim)
+        reps = np.repeat(last, pad_count, axis=dim)
+        return np.concatenate([arr, reps], axis=dim)
+
+    return jax.tree.map(_pad_leaf, tree)
+
+
+# ------------------------------------------------------------ object channel
+def _object_to_bytes_array(obj: Any) -> np.ndarray:
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+
+
+def gather_object(objects: list[Any]) -> list[Any]:
+    """All-gather arbitrary picklable objects; returns the flat list over all
+    processes in rank order (reference `gather_object`, `operations.py:445`).
+
+    The host-object control channel — the analog of
+    `torch.distributed.all_gather_object` — built on padded uint8 tensor
+    all-gather over the JAX runtime (SURVEY.md §5: host-level object channel).
+    """
+    state = ProcessState()
+    if state.num_processes == 1:
+        return list(objects)
+    payload = _object_to_bytes_array(objects)
+    length = np.asarray([payload.size], dtype=np.int64)
+    lengths = _process_allgather(length, tiled=False).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[: payload.size] = payload
+    all_payloads = _process_allgather(padded, tiled=False)
+    result: list[Any] = []
+    for rank in range(state.num_processes):
+        blob = bytes(all_payloads[rank][: int(lengths[rank])])
+        result.extend(pickle.loads(blob))
+    return result
+
+
+def broadcast_object_list(objects: list[Any], from_process: int = 0) -> list[Any]:
+    """Broadcast picklable objects from one process (reference
+    `broadcast_object_list`, `operations.py:560`)."""
+    state = ProcessState()
+    if state.num_processes == 1:
+        return list(objects)
+    everything = gather_object([list(objects)])
+    return everything[from_process]
+
+
+def copy_tensor_to_devices(tree: Any, mesh: Mesh, spec: PartitionSpec | None = None) -> Any:
+    """Form global sharded arrays from identical host data on every process
+    (reference `copy_tensor_to_devices` for XLA, `operations.py:485`)."""
+    spec = spec if spec is not None else PartitionSpec()
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sharding), tree)
+
+
+def apply_to_leaves(fn: Callable[[Any], Any], tree: Any) -> Any:
+    """Compatibility shim for the reference's `recursively_apply`
+    (`operations.py:84`) — pytrees make this trivial."""
+    return jax.tree.map(fn, tree)

@@ -1,0 +1,186 @@
+"""Parameter / optimizer-state sharding strategies.
+
+This is where the reference's strategy plugin zoo collapses into PartitionSpec
+policies (SURVEY.md §7 mapping table):
+
+- DATA_PARALLEL  — params replicated; grads all-reduced implicitly by GSPMD
+  (reference DDP wrap, `accelerator.py:1519-1544`).
+- ZERO1          — params replicated, optimizer state sharded over the batch
+  axes (DeepSpeed ZeRO stage-1, `utils/dataclasses.py:1019`).
+- FSDP           — params + grads + optimizer state sharded over the ``fsdp``
+  axis (torch FSDP FULL_SHARD / ZeRO-3, `utils/dataclasses.py:1449`); XLA
+  inserts the all-gather-on-use / reduce-scatter-on-grad collectives.
+- TENSOR_PARALLEL— weight matrices sharded over ``tensor`` by rule table
+  (reference TP plugin + transformers tp_plan, `utils/dataclasses.py:1863`).
+- HYBRID         — rules first, FSDP fallback, over an arbitrary mesh.
+
+Rules are ``(path_regex, PartitionSpec)`` pairs matched against the
+``/``-joined param path — the analog of transformers' `base_model_tp_plan`,
+owned by the framework instead (model families register plans in
+`parallel/tp.py`).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils.dataclasses import FsdpPlugin, ShardingStrategyType
+from .mesh import BATCH_AXES, FSDP_AXIS, TENSOR_AXIS
+
+Rules = Sequence[tuple[str, PartitionSpec]]
+
+
+@dataclass
+class ShardingStrategy:
+    """Resolved sharding policy applied to a params pytree."""
+
+    kind: ShardingStrategyType = ShardingStrategyType.DATA_PARALLEL
+    rules: Rules = ()
+    fsdp: FsdpPlugin = field(default_factory=FsdpPlugin)
+    # Axes used for FSDP-style sharding of params and for ZeRO-1 opt-state
+    # sharding respectively.
+    fsdp_axes: tuple[str, ...] = (FSDP_AXIS,)
+    zero1_axes: tuple[str, ...] = BATCH_AXES
+
+    @classmethod
+    def resolve(cls, strategy: Any, rules: Rules = ()) -> "ShardingStrategy":
+        if isinstance(strategy, ShardingStrategy):
+            return strategy
+        if strategy is None:
+            return cls(kind=ShardingStrategyType.DATA_PARALLEL, rules=rules)
+        if isinstance(strategy, FsdpPlugin):
+            return cls(kind=ShardingStrategyType.FSDP, rules=rules, fsdp=strategy)
+        return cls(kind=ShardingStrategyType(str(strategy).upper()), rules=rules)
+
+
+def _path_str(path: tuple) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _shard_largest_dim(
+    shape: tuple[int, ...], axes: tuple[str, ...], mesh: Mesh, min_size: int
+) -> PartitionSpec:
+    """Shard the largest dimension divisible by the axis-group size; replicate
+    tensors that are too small or indivisible (the size-based auto-wrap analog
+    of the reference FSDP plugin, `utils/constants.py:37`)."""
+    group = int(np.prod([mesh.shape[a] for a in axes]))
+    if group <= 1 or int(np.prod(shape)) < min_size:
+        return PartitionSpec()
+    candidates = [d for d in range(len(shape)) if shape[d] % group == 0 and shape[d] >= group]
+    if not candidates:
+        return PartitionSpec()
+    best = max(candidates, key=lambda d: shape[d])
+    spec: list[Any] = [None] * len(shape)
+    spec[best] = axes if len(axes) > 1 else axes[0]
+    return PartitionSpec(*spec)
+
+
+def _apply_rules(path: str, shape: tuple[int, ...], rules: Rules) -> PartitionSpec | None:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if len(spec) > len(shape):
+                raise ValueError(
+                    f"Sharding rule {pattern!r} -> {spec} has more axes than param "
+                    f"{path} with shape {shape}"
+                )
+            return spec
+    return None
+
+
+def infer_param_specs(
+    params_shapes: Any, mesh: Mesh, strategy: ShardingStrategy
+) -> Any:
+    """PartitionSpec pytree for a params pytree (shapes or concrete arrays)."""
+    kind = strategy.kind
+
+    def leaf_spec(path: tuple, leaf: Any) -> PartitionSpec:
+        shape = tuple(getattr(leaf, "shape", ()))
+        path_s = _path_str(path)
+        if kind == ShardingStrategyType.DATA_PARALLEL or kind == ShardingStrategyType.ZERO1:
+            return PartitionSpec()
+        matched = _apply_rules(path_s, shape, strategy.rules)
+        if kind == ShardingStrategyType.TENSOR_PARALLEL:
+            return matched if matched is not None else PartitionSpec()
+        if kind == ShardingStrategyType.FSDP:
+            if matched is not None:
+                return matched
+            return _shard_largest_dim(
+                shape, strategy.fsdp_axes, mesh, strategy.fsdp.min_weight_size
+            )
+        # HYBRID: explicit rules (typically tensor axis), FSDP fallback on the rest.
+        if matched is not None:
+            return matched
+        return _shard_largest_dim(
+            shape, strategy.fsdp_axes, mesh, strategy.fsdp.min_weight_size
+        )
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params_shapes)
+
+
+def infer_opt_specs(
+    opt_state_shapes: Any, params_shapes: Any, param_specs: Any, mesh: Mesh, strategy: ShardingStrategy
+) -> Any:
+    """PartitionSpec pytree for optimizer state.
+
+    Optimizer moments mirror the params pytree (optax convention), so any
+    subtree structurally identical to params inherits the param specs —
+    except under ZeRO-1, where moments shard over the batch axes even though
+    params stay replicated (optimizer-state sharding is ZeRO-1's whole
+    point). Scalars and other non-param-like leaves replicate.
+    """
+    params_struct = jax.tree.structure(params_shapes)
+
+    if strategy.kind == ShardingStrategyType.ZERO1:
+        moment_specs = jax.tree.map(
+            lambda leaf: _shard_largest_dim(
+                tuple(leaf.shape), strategy.zero1_axes, mesh, strategy.fsdp.min_weight_size
+            ),
+            params_shapes,
+        )
+    else:
+        moment_specs = param_specs
+
+    def is_params_like(x: Any) -> bool:
+        if x is None:
+            return False
+        try:
+            return jax.tree.structure(x) == params_struct
+        except Exception:
+            return False
+
+    def map_subtree(sub: Any) -> Any:
+        if is_params_like(sub):
+            return moment_specs
+        return jax.tree.map(lambda _: PartitionSpec(), sub)
+
+    return jax.tree.map(map_subtree, opt_state_shapes, is_leaf=is_params_like)
+
+
+def to_named_shardings(spec_tree: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def shard_pytree(tree: Any, spec_tree: Any, mesh: Mesh) -> Any:
+    """Place a concrete pytree onto the mesh per the spec tree."""
+    shardings = to_named_shardings(spec_tree, mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)

@@ -1,0 +1,156 @@
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.data import DataLoader, default_collate, skip_first_batches
+from accelerate_tpu.parallel import MeshConfig, build_mesh
+from accelerate_tpu.state import GradientState
+from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+
+class ArrayDataset:
+    def __init__(self, n, feat=4):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, feat).astype(np.float32)
+        self.y = (rng.rand(n) > 0.5).astype(np.int32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def test_default_collate():
+    samples = [{"x": np.ones(2), "y": 1}, {"x": np.zeros(2), "y": 2}]
+    out = default_collate(samples)
+    assert out["x"].shape == (2, 2)
+    np.testing.assert_array_equal(out["y"], [1, 2])
+    tup = default_collate([(np.ones(2), 3), (np.ones(2), 4)])
+    assert tup[0].shape == (2, 2) and tup[1].shape == (2,)
+
+
+def test_loader_global_batches():
+    mesh = build_mesh()  # 8-way data parallel
+    ds = ArrayDataset(32)
+    dl = DataLoader(ds, batch_size=2, mesh=mesh)  # global batch 16
+    assert dl.total_batch_size == 16
+    assert len(dl) == 2
+    batches = list(dl)
+    assert len(batches) == 2
+    for b in batches:
+        assert isinstance(b["x"], jax.Array)
+        assert b["x"].shape == (16, 4)
+        assert not b["x"].sharding.is_fully_replicated
+    # Content matches the dataset in order (no shuffle).
+    np.testing.assert_allclose(np.asarray(batches[0]["x"]), ds.x[:16])
+    np.testing.assert_allclose(np.asarray(batches[1]["x"]), ds.x[16:])
+
+
+def test_loader_wraparound_and_remainder():
+    mesh = build_mesh()
+    ds = ArrayDataset(20)  # 20 % 16 = 4 remainder
+    dl = DataLoader(ds, batch_size=2, mesh=mesh)
+    assert dl.remainder == 4
+    batches = list(dl)
+    assert len(batches) == 2
+    # Tail batch completed by wrapping to the epoch start.
+    np.testing.assert_allclose(np.asarray(batches[1]["x"])[:4], ds.x[16:20])
+    np.testing.assert_allclose(np.asarray(batches[1]["x"])[4:], ds.x[:12])
+
+
+def test_loader_end_of_dataloader_flag():
+    mesh = build_mesh()
+    ds = ArrayDataset(32)
+    dl = DataLoader(ds, batch_size=2, mesh=mesh)
+    gs = GradientState()
+    flags = []
+    for _ in dl:
+        flags.append(gs.end_of_dataloader)
+    assert flags == [False, True]
+    assert not gs.in_dataloader
+
+
+def test_loader_drop_last():
+    mesh = build_mesh()
+    ds = ArrayDataset(20)
+    dl = DataLoader(ds, batch_size=2, mesh=mesh, drop_last=True)
+    assert len(dl) == 1
+    assert len(list(dl)) == 1
+
+
+def test_loader_shuffle_deterministic():
+    mesh = build_mesh()
+    ds = ArrayDataset(32)
+    dl1 = DataLoader(ds, batch_size=2, mesh=mesh, shuffle=True, seed=7)
+    dl2 = DataLoader(ds, batch_size=2, mesh=mesh, shuffle=True, seed=7)
+    b1 = [np.asarray(b["x"]) for b in dl1]
+    b2 = [np.asarray(b["x"]) for b in dl2]
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(a, b)
+    # Next epoch reshuffles.
+    b1_e2 = [np.asarray(b["x"]) for b in dl1]
+    assert not np.allclose(b1[0], b1_e2[0])
+
+
+def test_loader_split_batches():
+    mesh = build_mesh()
+    ds = ArrayDataset(32)
+    dl = DataLoader(
+        ds, batch_size=16, mesh=mesh, config=DataLoaderConfiguration(split_batches=True)
+    )
+    assert dl.total_batch_size == 16
+    assert len(list(dl)) == 2
+    with pytest.raises(ValueError):
+        DataLoader(ds, batch_size=10, mesh=mesh, config=DataLoaderConfiguration(split_batches=True))
+
+
+def test_skip_first_batches_and_state_dict():
+    mesh = build_mesh()
+    ds = ArrayDataset(48)
+    dl = DataLoader(ds, batch_size=2, mesh=mesh)
+    all_batches = [np.asarray(b["x"]) for b in dl]
+    dl2 = DataLoader(ds, batch_size=2, mesh=mesh)
+    skip_first_batches(dl2, 1)
+    rest = [np.asarray(b["x"]) for b in dl2]
+    assert len(rest) == len(all_batches) - 1
+    np.testing.assert_array_equal(rest[0], all_batches[1])
+    # state_dict round trip resumes mid-epoch
+    dl3 = DataLoader(ds, batch_size=2, mesh=mesh)
+    it = iter(dl3)
+    next(it)
+    sd = dl3.state_dict()
+    it.close()
+    dl4 = DataLoader(ds, batch_size=2, mesh=mesh)
+    dl4.load_state_dict({**sd, "epoch": 0})
+    resumed = [np.asarray(b["x"]) for b in dl4]
+    np.testing.assert_array_equal(resumed[0], all_batches[1])
+
+
+def test_iterable_dataset_loader():
+    mesh = build_mesh()
+
+    def gen():
+        for i in range(20):
+            yield {"x": np.full(3, i, np.float32)}
+
+    class It:
+        def __iter__(self):
+            return gen()
+
+    dl = DataLoader(It(), batch_size=1, mesh=mesh)  # global batch 8
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0]["x"].shape == (8, 3)
+    vals = np.asarray(batches[2]["x"])[:, 0]
+    np.testing.assert_array_equal(vals[:4], [16, 17, 18, 19])
+    np.testing.assert_array_equal(vals[4:], [0, 1, 2, 3])  # wraparound fill
+
+
+def test_mesh_2d_batch_formation():
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    ds = ArrayDataset(16)
+    dl = DataLoader(ds, batch_size=4, mesh=mesh)  # dp=4 → global 16
+    (batch,) = list(dl)
+    assert batch["x"].shape == (16, 4)
+    np.testing.assert_allclose(np.asarray(batch["x"]), ds.x)

@@ -1,0 +1,108 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from accelerate_tpu.ops import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    get_data_structure,
+    initialize_tensors,
+    pad_across_processes,
+    pad_input_tensors,
+    pmean,
+    psum,
+    reduce,
+    send_to_device,
+    shard_map_over,
+    slice_tensors,
+    to_host,
+)
+from accelerate_tpu.parallel import MeshConfig, batch_sharding, build_mesh
+
+
+def test_gather_single_process_global_array():
+    mesh = build_mesh()
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    arr = jax.device_put(x, batch_sharding(mesh))
+    out = gather({"a": arr, "b": np.ones(3)})
+    np.testing.assert_array_equal(out["a"], x)
+    np.testing.assert_array_equal(out["b"], np.ones(3))
+
+
+def test_reduce_and_broadcast_single():
+    tree = {"x": np.asarray([1.0, 2.0]), "y": np.asarray(3.0)}
+    out = reduce(tree, "mean")
+    np.testing.assert_array_equal(out["x"], [1.0, 2.0])
+    out2 = broadcast(tree)
+    np.testing.assert_array_equal(out2["x"], [1.0, 2.0])
+
+
+def test_object_collectives_single():
+    assert gather_object([1, "a"]) == [1, "a"]
+    assert broadcast_object_list([{"k": 2}]) == [{"k": 2}]
+
+
+def test_pad_input_tensors():
+    batch = {"x": np.arange(10).reshape(5, 2), "meta": np.asarray(7)}
+    out = pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    np.testing.assert_array_equal(out["x"][5], out["x"][4])
+    np.testing.assert_array_equal(out["meta"], 7)
+
+
+def test_pad_across_processes_noop_single():
+    x = {"a": np.ones((3, 4))}
+    out = pad_across_processes(x, dim=1)
+    assert out["a"].shape == (3, 4)
+
+
+def test_misc_ops():
+    tree = {"a": np.zeros((4, 3), np.float32), "b": np.zeros((4,), np.int32)}
+    assert find_batch_size(tree) == 4
+    sliced = slice_tensors(tree, slice(0, 2))
+    assert sliced["a"].shape == (2, 3)
+    cat = concatenate([tree, tree])
+    assert cat["a"].shape == (8, 3)
+    struct = get_data_structure(tree)
+    zeros = initialize_tensors(struct)
+    assert zeros["a"].shape == (4, 3) and zeros["a"].dtype == np.float32
+    half = {"h": jnp.ones((2,), jnp.bfloat16), "i": jnp.ones((2,), jnp.int32)}
+    up = convert_to_fp32(half)
+    assert up["h"].dtype == jnp.float32 and up["i"].dtype == jnp.int32
+
+
+def test_send_to_device_and_to_host():
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}
+    on_device = send_to_device(batch, batch_sharding(mesh))
+    assert isinstance(on_device["x"], jax.Array)
+    assert not on_device["x"].sharding.is_fully_replicated
+    back = to_host(on_device)
+    np.testing.assert_array_equal(back["x"], batch["x"])
+
+
+def test_in_jit_collectives_via_shard_map():
+    mesh = build_mesh()  # data=8
+    x = np.arange(8, dtype=np.float32)
+
+    def per_shard(v):
+        total = psum(v, "data")
+        mean = pmean(v, "data")
+        return total, mean
+
+    fn = shard_map_over(
+        per_shard,
+        mesh,
+        in_specs=PartitionSpec(("data",)),
+        out_specs=(PartitionSpec(), PartitionSpec()),
+    )
+    total, mean = jax.jit(fn)(x)
+    assert float(total[0]) == x.sum()
+    assert float(mean[0]) == x.mean()

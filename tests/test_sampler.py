@@ -1,0 +1,185 @@
+"""Index-math tests. Expected tables are the behavioral spec from the
+reference test suite (`/root/reference/tests/test_data_loader.py:107-330`) —
+the framework must land the same sample on the same process at the same step.
+"""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.data.sampler import (
+    SeedableSampler,
+    batch_indices,
+    shard_batches,
+    shard_iterable,
+    sharded_length,
+)
+
+
+def make_batches(n, batch_size, drop_last=False):
+    return list(batch_indices(range(n), batch_size, drop_last))
+
+
+def shards(n, batch_size, num_processes=2, split_batches=False, even_batches=True, drop_last=False):
+    return [
+        list(
+            shard_batches(
+                make_batches(n, batch_size, drop_last),
+                num_processes,
+                p,
+                batch_size=batch_size,
+                split_batches=split_batches,
+                even_batches=even_batches,
+                drop_last=drop_last,
+            )
+        )
+        for p in range(num_processes)
+    ]
+
+
+class TestNoSplit:
+    def test_round_multiple_of_total(self):
+        expected = [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17], [21, 22, 23]],
+        ]
+        assert shards(24, 3) == expected
+        assert shards(24, 3, drop_last=True) == expected
+
+    def test_round_multiple_of_batch_only(self):
+        assert shards(21, 3) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17], [0, 1, 2]],
+        ]
+        assert shards(21, 3, drop_last=True) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17]],
+        ]
+
+    def test_multiple_of_process_batches(self):
+        assert shards(22, 3) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17], [21, 0, 1]],
+        ]
+
+    def test_ragged(self):
+        assert shards(20, 3) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 0]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17], [1, 2, 3]],
+        ]
+        assert shards(20, 3, drop_last=True) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17]],
+        ]
+
+    def test_tiny_dataset(self):
+        assert shards(2, 3) == [[[0, 1, 0]], [[1, 0, 1]]]
+        assert shards(2, 3, drop_last=True) == [[], []]
+
+    def test_no_even(self):
+        assert shards(21, 3, even_batches=False) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17]],
+        ]
+        assert shards(22, 3, even_batches=False) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17], [21]],
+        ]
+        assert shards(20, 3, even_batches=False) == [
+            [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19]],
+            [[3, 4, 5], [9, 10, 11], [15, 16, 17]],
+        ]
+        assert shards(2, 3, even_batches=False) == [[[0, 1]], []]
+
+
+class TestSplit:
+    def test_round_multiple(self):
+        expected = [
+            [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17], [20, 21]],
+            [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19], [22, 23]],
+        ]
+        assert shards(24, 4, split_batches=True) == expected
+        assert shards(24, 4, split_batches=True, drop_last=True) == expected
+
+    def test_not_round_multiple(self):
+        assert shards(22, 4, split_batches=True) == [
+            [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17], [20, 21]],
+            [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19], [0, 1]],
+        ]
+        assert shards(22, 4, split_batches=True, drop_last=True) == [
+            [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17]],
+            [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19]],
+        ]
+
+    def test_ragged(self):
+        assert shards(21, 4, split_batches=True) == [
+            [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17], [20, 0]],
+            [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19], [1, 2]],
+        ]
+
+    def test_tiny(self):
+        assert shards(2, 4, split_batches=True) == [[[0, 1]], [[0, 1]]]
+        assert shards(2, 4, split_batches=True, drop_last=True) == [[], []]
+
+    def test_no_even(self):
+        assert shards(22, 4, split_batches=True, even_batches=False) == [
+            [[0, 1], [4, 5], [8, 9], [12, 13], [16, 17], [20, 21]],
+            [[2, 3], [6, 7], [10, 11], [14, 15], [18, 19]],
+        ]
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            shards(24, 3, split_batches=True)
+
+
+class TestIterableShard:
+    def test_even_split(self):
+        out = [
+            list(
+                shard_iterable(
+                    range(10), batch_size=2, num_processes=2, process_index=p
+                )
+            )
+            for p in range(2)
+        ]
+        assert out == [[0, 1, 4, 5, 8, 9], [2, 3, 6, 7, 0, 1]]
+
+    def test_drop_last(self):
+        out = [
+            list(
+                shard_iterable(
+                    range(10), batch_size=2, num_processes=2, process_index=p, drop_last=True
+                )
+            )
+            for p in range(2)
+        ]
+        assert out == [[0, 1, 4, 5], [2, 3, 6, 7]]
+
+    def test_split_batches(self):
+        out = [
+            list(
+                shard_iterable(
+                    range(8), batch_size=4, num_processes=2, process_index=p, split_batches=True
+                )
+            )
+            for p in range(2)
+        ]
+        assert out == [[0, 1, 4, 5], [2, 3, 6, 7]]
+
+
+def test_seedable_sampler_determinism():
+    s1 = SeedableSampler(10, shuffle=True, seed=42)
+    s2 = SeedableSampler(10, shuffle=True, seed=42)
+    assert list(s1) == list(s2)
+    s1.set_epoch(1)
+    assert list(s1) != list(s2)
+    s2.set_epoch(1)
+    assert list(s1) == list(s2)
+    assert sorted(list(s1)) == list(range(10))
+    assert list(SeedableSampler(5, shuffle=False)) == [0, 1, 2, 3, 4]
+
+
+def test_sharded_length():
+    assert sharded_length(24, 3, 2, drop_last=False) == 4
+    assert sharded_length(21, 3, 2, drop_last=False) == 4
+    assert sharded_length(21, 3, 2, drop_last=True) == 3
+    assert sharded_length(2, 3, 2, drop_last=False) == 1
