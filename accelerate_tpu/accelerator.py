@@ -90,12 +90,6 @@ class TrainState(struct.PyTreeNode):
         )
 
 
-def _tree_cast(tree: Any, dtype: Any) -> Any:
-    return jax.tree.map(
-        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree
-    )
-
-
 def global_norm(tree: Any) -> jax.Array:
     leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
@@ -234,8 +228,7 @@ class Accelerator:
         return obj
 
     def _prepare_data_loader_obj(self, dl: DataLoader) -> DataLoader:
-        dl.mesh = self.mesh
-        dl.config = self.dataloader_config
+        dl._rebind(self.mesh, self.dataloader_config)
         self._dataloaders.append(dl)
         return dl
 
@@ -363,13 +356,8 @@ class Accelerator:
         max_grad_norm = self.max_grad_norm
 
         def compute_loss(params: Any, batch: Any, rng: jax.Array):
-            cparams = _tree_cast(params, policy.compute_dtype)
-            cbatch = jax.tree.map(
-                lambda x: x.astype(policy.compute_dtype)
-                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-                else x,
-                batch,
-            )
+            cparams = policy.cast_for_compute(params)
+            cbatch = policy.cast_for_compute(batch)
             out = loss_fn(cparams, cbatch, rng)
             if has_aux:
                 loss, aux = out
@@ -415,6 +403,17 @@ class Accelerator:
                 )
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
+                # lax.scan stacked aux along the accumulation axis; reduce it
+                # so extra_metrics_fn sees the same shapes regardless of the
+                # accumulation setting (mean for float metrics, last value
+                # otherwise).
+                if aux is not None:
+                    aux = jax.tree.map(
+                        lambda x: jnp.mean(x, axis=0)
+                        if jnp.issubdtype(x.dtype, jnp.inexact)
+                        else x[-1],
+                        aux,
+                    )
             else:
                 (loss, aux), grads = grad_fn(state.params, batch, rng)
 
@@ -446,8 +445,7 @@ class Accelerator:
         policy = self.policy
 
         def eval_fn(state: TrainState, batch: Any) -> Any:
-            cparams = _tree_cast(state.params, policy.compute_dtype)
-            return fn(cparams, batch)
+            return fn(policy.cast_for_compute(state.params), batch)
 
         return jax.jit(eval_fn)
 

@@ -111,32 +111,39 @@ class DataLoader:
         self.gradient_state = GradientState()
         self.state = ProcessState()
 
-        dp = data_parallel_size(mesh)
-        if self.config.split_batches:
-            if batch_size % dp != 0:
-                raise ValueError(
-                    f"split_batches=True requires batch_size ({batch_size}) divisible "
-                    f"by the data-parallel world size ({dp})"
-                )
-            self.total_batch_size = batch_size
-        else:
-            self.total_batch_size = batch_size * dp
         self.batch_size = batch_size
-
         self._sized = hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__")
         self.sampler = (
             SeedableSampler(len(dataset), shuffle=shuffle, seed=seed) if self._sized else None
         )
         self._epoch = 0
         self._batches_yielded = 0
+        self.end_of_dataloader = False
+        self._rebind(mesh, self.config)
+
+    def _rebind(self, mesh: Mesh, config: DataLoaderConfiguration) -> None:
+        """(Re)derive mesh/config-dependent sizing. Called from __init__ and
+        again by `Accelerator.prepare` when it swaps in its own mesh/config —
+        total_batch_size and remainder must track the *final* topology."""
+        self.mesh = mesh
+        self.config = config
+        dp = data_parallel_size(mesh)
+        if config.split_batches:
+            if self.batch_size % dp != 0:
+                raise ValueError(
+                    f"split_batches=True requires batch_size ({self.batch_size}) divisible "
+                    f"by the data-parallel world size ({dp})"
+                )
+            self.total_batch_size = self.batch_size
+        else:
+            self.total_batch_size = self.batch_size * dp
         # Reference `DataLoaderStateMixin` fields (data_loader.py:364-405).
         # remainder only exists when the wraparound duplicates samples — with
         # drop_last the tail is dropped, nothing is duplicated, and
         # gather_for_metrics must not trim (reference data_loader.py:396-399).
-        self.end_of_dataloader = False
         self.remainder = -1
-        if self._sized and not drop_last:
-            self.remainder = len(dataset) % self.total_batch_size
+        if self._sized and not self.drop_last:
+            self.remainder = len(self.dataset) % self.total_batch_size
 
     # ----------------------------------------------------------------- sizing
     def __len__(self) -> int:
@@ -224,61 +231,85 @@ class DataLoader:
             else:
                 yield host_batch  # ragged tail stays on host
 
-    def _prefetched(self, it: Iterator[Any]) -> Iterator[Any]:
+    def _prefetched(self, it: Iterator[Any], stop: threading.Event) -> Iterator[Any]:
         q: queue.Queue = queue.Queue(maxsize=max(1, self.config.prefetch_size))
         err: list[BaseException] = []
+
+        def put(item: Any) -> bool:
+            # Bounded put that gives up when the consumer abandoned iteration,
+            # so an early `break` can't strand the worker blocked on a full
+            # queue (pinning the dataset iterator forever).
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker() -> None:
             try:
                 for item in it:
-                    q.put(item)
+                    if not put(item):
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(_SENTINEL)
+                put(_SENTINEL)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
 
     def __iter__(self) -> Iterator[Any]:
         self.begin()
         # Position within the epoch includes batches skipped on resume, so a
         # checkpoint taken later in the resumed epoch records the true offset.
         self._batches_yielded = self.skip_batches
+        stop = threading.Event()
         it = self._device_batches()
         if self.config.prefetch_size > 0:
-            it = self._prefetched(it)
-        # One-batch-ahead so the consumer can observe end_of_dataloader while
-        # handling the final batch (reference DataLoaderShard.__iter__ :557).
+            it = self._prefetched(it, stop)
         try:
-            current = next(it)
-        except StopIteration:
+            # One-batch-ahead so the consumer can observe end_of_dataloader
+            # while handling the final batch (reference :557).
+            try:
+                current = next(it)
+            except StopIteration:
+                self.end_of_dataloader = True
+                return
+            for upcoming in it:
+                self.end_of_dataloader = False
+                # Count before handing out: a checkpoint taken while the
+                # consumer holds this batch must skip it on resume.
+                self._batches_yielded += 1
+                yield current
+                current = upcoming
             self.end_of_dataloader = True
-            self.end()
-            return
-        for upcoming in it:
-            self.end_of_dataloader = False
-            # Count before handing out: a checkpoint taken while the consumer
-            # holds this batch must skip it on resume.
             self._batches_yielded += 1
             yield current
-            current = upcoming
-        self.end_of_dataloader = True
-        self._batches_yielded += 1
-        yield current
-        self._epoch += 1
-        # A mid-epoch resume offset applies only to the resumed epoch.
-        self.skip_batches = 0
-        if self.sampler is not None:
-            self.sampler.set_epoch(self._epoch)
-        self.end()
+            self._epoch += 1
+            # A mid-epoch resume offset applies only to the resumed epoch.
+            self.skip_batches = 0
+            if self.sampler is not None:
+                self.sampler.set_epoch(self._epoch)
+        finally:
+            # Runs on normal exhaustion AND on early break/GC (GeneratorExit):
+            # unregister from GradientState and release the prefetch worker.
+            stop.set()
+            if hasattr(it, "close"):
+                it.close()
+            self.end()
 
     # ------------------------------------------------------ GradientState glue
     def begin(self) -> None:

@@ -1,0 +1,14 @@
+"""TPU-native model families.
+
+The reference owns no models (they come from `transformers` and are rewritten
+post-hoc); a TPU-native framework owns them because scan-over-layers structure,
+sharding plans, and attention kernels are the performance story. Each family
+module exposes: a frozen ``*Config``, ``init(rng, config) -> params``,
+``forward``/``loss_fn`` pure functions, and a registered TP plan
+(`parallel/tp.py`).
+"""
+
+from . import bert, llama
+from .layers import cross_entropy_loss, dot_product_attention
+
+__all__ = ["bert", "llama", "cross_entropy_loss", "dot_product_attention"]

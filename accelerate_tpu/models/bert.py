@@ -1,0 +1,164 @@
+"""BERT-style encoder + sequence classifier.
+
+Parity target: the reference's canonical example trains BERT-base on GLUE/MRPC
+(`examples/nlp_example.py`, perf gate `test_utils/scripts/external_deps/
+test_performance.py:157-219`). This is the same architecture built TPU-native:
+scan-over-layers, einsum projections, fp32 layernorm, learned positions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import (
+    AttentionSpec,
+    attention_out,
+    attention_qkv,
+    dot_product_attention,
+    init_attention,
+    init_mlp_gelu,
+    layer_norm,
+    mlp_gelu,
+    truncated_normal_init,
+)
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    norm_eps: float = 1e-12
+    dropout_rate: float = 0.1
+    remat: bool = False
+
+    @property
+    def attention_spec(self) -> AttentionSpec:
+        return AttentionSpec(self.d_model, self.num_heads, self.num_heads, self.d_model // self.num_heads)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "BertConfig":
+        defaults = dict(vocab_size=128, d_model=32, n_layers=2, num_heads=2, d_ff=64, max_seq_len=64)
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def bert_base(cls, **overrides: Any) -> "BertConfig":
+        return cls(**overrides)
+
+    def param_count(self) -> int:
+        block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
+        embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * self.d_model
+        return self.n_layers * block + embed + self.d_model * self.d_model + self.d_model * self.num_labels
+
+
+def init_block(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> Params:
+    ka, km = jax.random.split(rng)
+    return {
+        "attn": init_attention(ka, config.attention_spec, dtype),
+        "attn_norm_scale": jnp.ones((config.d_model,), dtype),
+        "attn_norm_bias": jnp.zeros((config.d_model,), dtype),
+        "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),
+        "mlp_norm_scale": jnp.ones((config.d_model,), dtype),
+        "mlp_norm_bias": jnp.zeros((config.d_model,), dtype),
+    }
+
+
+def init(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> Params:
+    k_tok, k_pos, k_typ, k_blocks, k_pool, k_cls = jax.random.split(rng, 6)
+    block_keys = jax.random.split(k_blocks, config.n_layers)
+    return {
+        "tok_embed": truncated_normal_init(k_tok, (config.vocab_size, config.d_model), 0.02, dtype),
+        "pos_embed": truncated_normal_init(k_pos, (config.max_seq_len, config.d_model), 0.02, dtype),
+        "type_embed": truncated_normal_init(k_typ, (config.type_vocab_size, config.d_model), 0.02, dtype),
+        "embed_norm_scale": jnp.ones((config.d_model,), dtype),
+        "embed_norm_bias": jnp.zeros((config.d_model,), dtype),
+        "blocks": jax.vmap(lambda k: init_block(k, config, dtype))(block_keys),
+        "pooler": {
+            "w": truncated_normal_init(k_pool, (config.d_model, config.d_model), 0.02, dtype),
+            "b": jnp.zeros((config.d_model,), dtype),
+        },
+        "classifier": {
+            "w": truncated_normal_init(k_cls, (config.d_model, config.num_labels), 0.02, dtype),
+            "b": jnp.zeros((config.num_labels,), dtype),
+        },
+    }
+
+
+def block_forward(block: Params, x: jax.Array, *, config: BertConfig, mask: jax.Array | None) -> jax.Array:
+    q, k, v = attention_qkv(block["attn"], x)
+    attn = dot_product_attention(q, k, v, mask=mask)
+    x = layer_norm(x + attention_out(block["attn"], attn), block["attn_norm_scale"], block["attn_norm_bias"], config.norm_eps)
+    h = mlp_gelu(block["mlp"], x)
+    return layer_norm(x + h, block["mlp_norm_scale"], block["mlp_norm_bias"], config.norm_eps)
+
+
+def encode(
+    params: Params,
+    input_ids: jax.Array,
+    config: BertConfig,
+    *,
+    attention_mask: jax.Array | None = None,
+    token_type_ids: jax.Array | None = None,
+) -> jax.Array:
+    B, S = input_ids.shape
+    positions = jnp.arange(S, dtype=jnp.int32)
+    x = params["tok_embed"][input_ids] + params["pos_embed"][positions][None]
+    if token_type_ids is not None:
+        x = x + params["type_embed"][token_type_ids]
+    else:
+        x = x + params["type_embed"][jnp.zeros((B, S), jnp.int32)]
+    x = layer_norm(x, params["embed_norm_scale"], params["embed_norm_bias"], config.norm_eps)
+
+    body = partial(block_forward, config=config, mask=attention_mask)
+    if config.remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(carry, block):
+        return body(block, carry), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    return x
+
+
+def classify(
+    params: Params,
+    batch: dict[str, jax.Array],
+    config: BertConfig,
+) -> jax.Array:
+    """batch -> classification logits (B, num_labels) from the [CLS] token."""
+    x = encode(
+        params,
+        batch["input_ids"],
+        config,
+        attention_mask=batch.get("attention_mask"),
+        token_type_ids=batch.get("token_type_ids"),
+    )
+    cls = x[:, 0]
+    pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(cls.dtype) + params["pooler"]["b"].astype(cls.dtype))
+    return pooled @ params["classifier"]["w"].astype(cls.dtype) + params["classifier"]["b"].astype(cls.dtype)
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    config: BertConfig,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    logits = classify(params, batch, config).astype(jnp.float32)
+    labels = batch["labels"]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logprobs, labels[:, None], axis=-1))

@@ -1,0 +1,213 @@
+"""Shared neural-net building blocks (pure functions over param pytrees).
+
+The reference framework owns no model code — models come from `transformers`
+and are rewritten by `Accelerator.prepare` (reference `accelerator.py:1421`).
+A TPU-native framework must own its model family instead, because the sharding
+plan, the scan-over-layers structure, and the attention kernels ARE the
+performance story (SURVEY.md §7: MFU target requires fused attention + 2-D
+sharding). These blocks follow the standard TPU recipe:
+
+- params in fp32, compute in bf16 (cast at call boundaries);
+- einsum-everything so XLA tiles straight onto the MXU;
+- no python control flow on data — shapes static under jit.
+
+Conventions: ``B`` batch, ``S`` sequence, ``D`` model dim, ``H`` heads,
+``K`` kv-heads, ``h`` head dim, ``F`` ff dim, ``L`` layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+def truncated_normal_init(rng: jax.Array, shape: tuple[int, ...], stddev: float, dtype=jnp.float32) -> jax.Array:
+    return jax.random.truncated_normal(rng, -2.0, 2.0, shape, jnp.float32).astype(dtype) * stddev
+
+
+# --------------------------------------------------------------------- norms
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 regardless of input dtype (normalization is
+    numerically fragile in bf16; standard TPU practice)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * (1.0 + scale.astype(jnp.float32))).astype(dtype)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-12) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+# ---------------------------------------------------------------------- rope
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> tuple[np.ndarray, np.ndarray]:
+    """Precomputed cos/sin tables, shape (max_len, head_dim/2), fp32."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_len, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary position embedding. x: (B, S, H, h); positions: (B, S)."""
+    dtype = x.dtype
+    cos = cos[positions][:, :, None, :]  # (B, S, 1, h/2)
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)
+
+
+# ----------------------------------------------------------------- attention
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Reference (non-fused) attention. q: (B, S, H, h), k/v: (B, T, K, h)
+    with grouped-query broadcast when K < H. fp32 softmax.
+
+    The fused path lives in `ops/flash_attention.py` (Pallas) and the
+    sequence-parallel path in `ops/ring_attention.py`; this function is the
+    numerical oracle both are tested against.
+    """
+    B, S, H, h = q.shape
+    T, K = k.shape[1], k.shape[2]
+    if K != H:
+        if H % K != 0:
+            raise ValueError(f"num_heads {H} not divisible by num_kv_heads {K}")
+        group = H // K
+        q = q.reshape(B, S, K, group, h)
+        logits = jnp.einsum("bskgh,btkh->bkgst", q, k).astype(jnp.float32)
+    else:
+        logits = jnp.einsum("bskh,btkh->bkst", q, k).astype(jnp.float32)
+        logits = logits[:, :, None]  # group dim of 1
+        group = 1
+        q = q.reshape(B, S, K, group, h)
+    scale = scale if scale is not None else 1.0 / np.sqrt(h)
+    logits = logits * scale
+
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((S, T), bool), k=T - S)
+        logits = jnp.where(causal_mask[None, None, None], logits, -1e30)
+    if mask is not None:
+        # mask: (B, T) padding mask or (B, S, T) full mask
+        if mask.ndim == 2:
+            mask = mask[:, None, :]
+        logits = jnp.where(mask[:, None, None].astype(bool), logits, -1e30)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+    return out.reshape(B, S, H, h)
+
+
+# ------------------------------------------------------------------ attention block
+@dataclasses.dataclass(frozen=True)
+class AttentionSpec:
+    d_model: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+def init_attention(rng: jax.Array, spec: AttentionSpec, dtype=jnp.float32) -> Params:
+    kq, kk, kv, ko = jax.random.split(rng, 4)
+    std = 1.0 / np.sqrt(spec.d_model)
+    return {
+        "wq": truncated_normal_init(kq, (spec.d_model, spec.num_heads, spec.head_dim), std, dtype),
+        "wk": truncated_normal_init(kk, (spec.d_model, spec.num_kv_heads, spec.head_dim), std, dtype),
+        "wv": truncated_normal_init(kv, (spec.d_model, spec.num_kv_heads, spec.head_dim), std, dtype),
+        "wo": truncated_normal_init(ko, (spec.num_heads, spec.head_dim, spec.d_model), std, dtype),
+    }
+
+
+def attention_qkv(params: Params, x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    q = jnp.einsum("bsd,dhk->bshk", x, params["wq"].astype(x.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, params["wk"].astype(x.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, params["wv"].astype(x.dtype))
+    return q, k, v
+
+
+def attention_out(params: Params, attn: jax.Array) -> jax.Array:
+    return jnp.einsum("bshk,hkd->bsd", attn, params["wo"].astype(attn.dtype))
+
+
+# ------------------------------------------------------------------------ mlp
+def init_swiglu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> Params:
+    kg, ku, kd = jax.random.split(rng, 3)
+    std_in = 1.0 / np.sqrt(d_model)
+    std_out = 1.0 / np.sqrt(d_ff)
+    return {
+        "w_gate": truncated_normal_init(kg, (d_model, d_ff), std_in, dtype),
+        "w_up": truncated_normal_init(ku, (d_model, d_ff), std_in, dtype),
+        "w_down": truncated_normal_init(kd, (d_ff, d_model), std_out, dtype),
+    }
+
+
+def swiglu(params: Params, x: jax.Array) -> jax.Array:
+    gate = jnp.einsum("bsd,df->bsf", x, params["w_gate"].astype(x.dtype))
+    up = jnp.einsum("bsd,df->bsf", x, params["w_up"].astype(x.dtype))
+    hidden = jax.nn.silu(gate) * up
+    return jnp.einsum("bsf,fd->bsd", hidden, params["w_down"].astype(x.dtype))
+
+
+def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> Params:
+    ki, ko = jax.random.split(rng)
+    return {
+        "w_in": truncated_normal_init(ki, (d_model, d_ff), 1.0 / np.sqrt(d_model), dtype),
+        "b_in": jnp.zeros((d_ff,), dtype),
+        "w_out": truncated_normal_init(ko, (d_ff, d_model), 1.0 / np.sqrt(d_ff), dtype),
+        "b_out": jnp.zeros((d_model,), dtype),
+    }
+
+
+def mlp_gelu(params: Params, x: jax.Array) -> jax.Array:
+    h = jnp.einsum("bsd,df->bsf", x, params["w_in"].astype(x.dtype)) + params["b_in"].astype(x.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("bsf,fd->bsd", h, params["w_out"].astype(x.dtype)) + params["b_out"].astype(x.dtype)
+
+
+# ----------------------------------------------------------------------- loss
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Token-level cross entropy in fp32 with optional z-loss regularizer
+    (keeps the softmax normalizer bounded — stabilizes long bf16 runs)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    losses = logz - label_logits
+    if z_loss > 0.0:
+        losses = losses + z_loss * jnp.square(logz)
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(losses)

@@ -91,6 +91,25 @@ def _shard_largest_dim(
     return PartitionSpec(*spec)
 
 
+def _sanitize_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Drop sharding on dims the mesh can't divide evenly, replicating them
+    instead. This is what makes one plan serve many topologies — e.g. GQA
+    kv-head projections replicate when num_kv_heads < tensor-parallel size
+    (the analog of torch TP falling back to replicated DTensor placements)."""
+    out: list[Any] = []
+    for d, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        group = int(np.prod([mesh.shape[a] for a in axes]))
+        if group > 1 and shape[d] % group == 0:
+            out.append(entry)
+        else:
+            out.append(None)
+    return PartitionSpec(*out)
+
+
 def _apply_rules(path: str, shape: tuple[int, ...], rules: Rules) -> PartitionSpec | None:
     for pattern, spec in rules:
         if re.search(pattern, path):
@@ -115,17 +134,11 @@ def infer_param_specs(
         if kind == ShardingStrategyType.DATA_PARALLEL or kind == ShardingStrategyType.ZERO1:
             return PartitionSpec()
         matched = _apply_rules(path_s, shape, strategy.rules)
-        if kind == ShardingStrategyType.TENSOR_PARALLEL:
-            return matched if matched is not None else PartitionSpec()
-        if kind == ShardingStrategyType.FSDP:
-            if matched is not None:
-                return matched
-            return _shard_largest_dim(
-                shape, strategy.fsdp_axes, mesh, strategy.fsdp.min_weight_size
-            )
-        # HYBRID: explicit rules (typically tensor axis), FSDP fallback on the rest.
         if matched is not None:
-            return matched
+            return _sanitize_spec(matched, shape, mesh)
+        if kind == ShardingStrategyType.TENSOR_PARALLEL:
+            return PartitionSpec()
+        # FSDP and HYBRID fall back to sharding the largest divisible dim.
         return _shard_largest_dim(
             shape, strategy.fsdp_axes, mesh, strategy.fsdp.min_weight_size
         )
@@ -156,11 +169,18 @@ def infer_opt_specs(
     else:
         moment_specs = param_specs
 
+    params_shapes_list = [tuple(l.shape) for l in jax.tree.leaves(params_shapes)]
+
     def is_params_like(x: Any) -> bool:
+        # Structure equality alone is degenerate when params is a single bare
+        # array (every leaf matches a leaf treedef) — require leaf shapes to
+        # match too, so e.g. adam's scalar `count` never inherits param specs.
         if x is None:
             return False
         try:
-            return jax.tree.structure(x) == params_struct
+            if jax.tree.structure(x) != params_struct:
+                return False
+            return [tuple(l.shape) for l in jax.tree.leaves(x)] == params_shapes_list
         except Exception:
             return False
 

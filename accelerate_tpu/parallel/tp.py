@@ -1,0 +1,79 @@
+"""Tensor-parallel sharding plan registry.
+
+The reference gets its TP plan from `transformers` (`model.tensor_parallel`
+requires `supports_tp_plan`/`base_model_tp_plan`; reference
+`accelerator.py:1545-1554`, `utils/dataclasses.py:1863-1895`). This framework
+owns the plans: each model family registers a named rule-set of
+``(path_regex, PartitionSpec)`` pairs consumed by
+`parallel.sharding.infer_param_specs`.
+
+Plans use **2-D specs** (megatron-style column/row parallel over ``tensor``,
+weight-dim sharding over ``fsdp``): on a pure-TP mesh the fsdp axis has size 1
+and those entries are no-ops, so one plan serves TP, FSDP+TP, and 3-D
+(data × fsdp × tensor) meshes. Param paths follow the scan-over-layers layout
+(leading layer axis, always unsharded → `None` first).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from .mesh import FSDP_AXIS as F
+from .mesh import TENSOR_AXIS as T
+
+Rules = tuple[tuple[str, P], ...]
+
+_REGISTRY: dict[str, Rules] = {}
+
+
+def register_tp_plan(name: str, rules: Rules) -> None:
+    _REGISTRY[name] = tuple(rules)
+
+
+def get_tp_plan(name: str) -> Rules:
+    if name not in _REGISTRY:
+        raise KeyError(f"No TP plan named {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_tp_plans() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# --------------------------------------------------------------------- llama
+# Layout (llama.init): blocks/* leaves have a leading n_layers axis.
+#   attn/wq (L, D, H, h)   — heads column-parallel, D sharded over fsdp
+#   attn/wk|wv (L, D, K, h) — kv heads column-parallel
+#   attn/wo (L, H, h, D)   — row-parallel (output proj reduces over heads)
+#   mlp/w_gate|w_up (L, D, F) — column-parallel
+#   mlp/w_down (L, F, D)   — row-parallel
+#   embed (V, D)           — vocab over tensor (ICI all-gather on lookup)
+#   lm_head (D, V)         — vocab column-parallel
+register_tp_plan(
+    "llama",
+    (
+        (r"blocks/attn/wq$", P(None, F, T, None)),
+        (r"blocks/attn/w[kv]$", P(None, F, T, None)),
+        (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/mlp/w_(gate|up)$", P(None, F, T)),
+        (r"blocks/mlp/w_down$", P(None, T, F)),
+        (r"^embed$", P(T, F)),
+        (r"^lm_head$", P(F, T)),
+        (r"norm", P()),
+    ),
+)
+
+# ---------------------------------------------------------------------- bert
+register_tp_plan(
+    "bert",
+    (
+        (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
+        (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/mlp/w_in$", P(None, F, T)),
+        (r"blocks/mlp/b_in$", P(None, T)),
+        (r"blocks/mlp/w_out$", P(None, T, F)),
+        (r"embed", P()),
+        (r"norm", P()),
+        (r"pooler|classifier", P()),
+    ),
+)
