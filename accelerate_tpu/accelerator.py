@@ -404,14 +404,14 @@ class Accelerator:
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
                 # lax.scan stacked aux along the accumulation axis; reduce it
-                # so extra_metrics_fn sees the same shapes regardless of the
-                # accumulation setting (mean for float metrics, last value
-                # otherwise).
+                # so extra_metrics_fn sees the same values regardless of the
+                # accumulation setting: mean for float metrics, sum for
+                # integer counters (a count over the full batch).
                 if aux is not None:
                     aux = jax.tree.map(
                         lambda x: jnp.mean(x, axis=0)
                         if jnp.issubdtype(x.dtype, jnp.inexact)
-                        else x[-1],
+                        else jnp.sum(x, axis=0),
                         aux,
                     )
             else:

@@ -60,9 +60,11 @@ class BertConfig:
         return cls(**overrides)
 
     def param_count(self) -> int:
-        block = 4 * self.d_model * self.d_model + 2 * self.d_model * self.d_ff
-        embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * self.d_model
-        return self.n_layers * block + embed + self.d_model * self.d_model + self.d_model * self.num_labels
+        d, f = self.d_model, self.d_ff
+        block = 4 * d * d + 2 * d * f + 5 * d + f  # matmuls + 2 norms + mlp biases
+        embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * d + 2 * d
+        heads = d * d + d + d * self.num_labels + self.num_labels  # pooler + classifier
+        return self.n_layers * block + embed + heads
 
 
 def init_block(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> Params:
@@ -98,11 +100,28 @@ def init(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> Params:
     }
 
 
-def block_forward(block: Params, x: jax.Array, *, config: BertConfig, mask: jax.Array | None) -> jax.Array:
+def _dropout(x: jax.Array, rate: float, rng: jax.Array | None) -> jax.Array:
+    """Inverted dropout; identity when rng is None (eval mode) or rate == 0."""
+    if rng is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def block_forward(
+    block: Params,
+    x: jax.Array,
+    *,
+    config: BertConfig,
+    mask: jax.Array | None,
+    rng: jax.Array | None,
+) -> jax.Array:
+    r1, r2 = (None, None) if rng is None else tuple(jax.random.split(rng))
     q, k, v = attention_qkv(block["attn"], x)
     attn = dot_product_attention(q, k, v, mask=mask)
-    x = layer_norm(x + attention_out(block["attn"], attn), block["attn_norm_scale"], block["attn_norm_bias"], config.norm_eps)
-    h = mlp_gelu(block["mlp"], x)
+    h = _dropout(attention_out(block["attn"], attn), config.dropout_rate, r1)
+    x = layer_norm(x + h, block["attn_norm_scale"], block["attn_norm_bias"], config.norm_eps)
+    h = _dropout(mlp_gelu(block["mlp"], x), config.dropout_rate, r2)
     return layer_norm(x + h, block["mlp_norm_scale"], block["mlp_norm_bias"], config.norm_eps)
 
 
@@ -113,7 +132,9 @@ def encode(
     *,
     attention_mask: jax.Array | None = None,
     token_type_ids: jax.Array | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
+    """``rng`` enables train-mode dropout; None = deterministic eval."""
     B, S = input_ids.shape
     positions = jnp.arange(S, dtype=jnp.int32)
     x = params["tok_embed"][input_ids] + params["pos_embed"][positions][None]
@@ -122,15 +143,26 @@ def encode(
     else:
         x = x + params["type_embed"][jnp.zeros((B, S), jnp.int32)]
     x = layer_norm(x, params["embed_norm_scale"], params["embed_norm_bias"], config.norm_eps)
+    if rng is not None:
+        rng, embed_rng = jax.random.split(rng)
+        x = _dropout(x, config.dropout_rate, embed_rng)
 
-    body = partial(block_forward, config=config, mask=attention_mask)
+    layer_rngs = None if rng is None else jax.random.split(rng, config.n_layers)
+
+    def body(block, carry, layer_rng):
+        return block_forward(block, carry, config=config, mask=attention_mask, rng=layer_rng)
+
     if config.remat:
         body = jax.checkpoint(body)
 
-    def scan_body(carry, block):
-        return body(block, carry), None
+    def scan_body(carry, xs):
+        if layer_rngs is None:
+            return body(xs, carry, None), None
+        block, layer_rng = xs
+        return body(block, carry, layer_rng), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    xs = params["blocks"] if layer_rngs is None else (params["blocks"], layer_rngs)
+    x, _ = jax.lax.scan(scan_body, x, xs)
     return x
 
 
@@ -138,6 +170,7 @@ def classify(
     params: Params,
     batch: dict[str, jax.Array],
     config: BertConfig,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
     """batch -> classification logits (B, num_labels) from the [CLS] token."""
     x = encode(
@@ -146,9 +179,12 @@ def classify(
         config,
         attention_mask=batch.get("attention_mask"),
         token_type_ids=batch.get("token_type_ids"),
+        rng=rng,
     )
     cls = x[:, 0]
     pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(cls.dtype) + params["pooler"]["b"].astype(cls.dtype))
+    if rng is not None:
+        pooled = _dropout(pooled, config.dropout_rate, jax.random.fold_in(rng, 1))
     return pooled @ params["classifier"]["w"].astype(cls.dtype) + params["classifier"]["b"].astype(cls.dtype)
 
 
@@ -158,7 +194,7 @@ def loss_fn(
     config: BertConfig,
     rng: jax.Array | None = None,
 ) -> jax.Array:
-    logits = classify(params, batch, config).astype(jnp.float32)
+    logits = classify(params, batch, config, rng=rng).astype(jnp.float32)
     labels = batch["labels"]
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logprobs, labels[:, None], axis=-1))

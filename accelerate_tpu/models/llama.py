@@ -39,6 +39,7 @@ from .layers import (
     init_swiglu,
     rms_norm,
     rope_frequencies,
+    swiglu,
     truncated_normal_init,
 )
 
@@ -168,8 +169,6 @@ def block_forward(
     attn = _attention(config, q, k, v, mask)
     x = x + attention_out(block["attn"], attn)
     h = rms_norm(x, block["mlp_norm"], config.norm_eps)
-    from .layers import swiglu
-
     x = x + swiglu(block["mlp"], h)
     return x
 

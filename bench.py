@@ -1,0 +1,121 @@
+"""Headline benchmark: Llama training-step MFU on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The reference publishes no training-throughput numbers (BASELINE.md — its perf
+story defers to torch/NCCL); the driver-defined north star is >=45% MFU, so
+``vs_baseline`` is value / 0.45.
+
+On a real TPU this trains a ~450M-param Llama (bf16 compute, fp32 master
+params + adam moments, remat) at seq 2048. On CPU (no TPU attached) it runs a
+tiny config just to prove the path end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPs per chip by device kind (dense matmul).
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(device: jax.Device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for name, flops in _PEAK_FLOPS.items():
+        if kind.startswith(name) or name.startswith(kind):
+            return flops
+    return None
+
+
+def main() -> None:
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.models import llama
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu" or "TPU" in getattr(device, "device_kind", "")
+    if on_tpu:
+        config = llama.LlamaConfig(
+            vocab_size=32000,
+            d_model=1024,
+            n_layers=24,
+            num_heads=16,
+            num_kv_heads=8,
+            d_ff=4096,
+            max_seq_len=2048,
+            remat=True,
+        )
+        batch_size, seq = 8, 2048
+        steps, warmup = 10, 3
+    else:
+        config = llama.LlamaConfig.tiny(remat=True)
+        batch_size, seq = 4, 64
+        steps, warmup = 3, 1
+
+    acc = atx.Accelerator(mixed_precision="bf16", seed=0, max_grad_norm=1.0)
+    state = acc.create_train_state(lambda r: llama.init(r, config), optax.adamw(3e-4))
+    step = acc.make_train_step(lambda p, b, r: llama.loss_fn(p, b, config, r))
+    batch = {
+        "input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq), 0, config.vocab_size, jnp.int32
+        )
+    }
+    batch = jax.device_put(batch)
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    # A device->host scalar fetch is the only reliable barrier on every
+    # platform (block_until_ready is a no-op through the axon PJRT tunnel);
+    # measure its round-trip once and subtract it from the timed loop.
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    float(metrics["loss"])
+    fetch_latency = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    dt = max(time.perf_counter() - t0 - fetch_latency, 1e-9)
+
+    tokens_per_step = batch_size * (seq - 1)  # loss_fn shifts by one
+    tokens_per_sec = tokens_per_step * steps / dt
+    n_params = config.param_count()
+    # Training FLOPs/token: 6N for matmuls + causal attention term (fwd+bwd).
+    attn_flops = 6.0 * config.n_layers * config.d_model * seq  # 12*L*D*S/2 (causal)
+    flops_per_token = 6.0 * n_params + attn_flops
+    model_flops_per_sec = tokens_per_sec * flops_per_token
+    peak = _peak_flops(device)
+    mfu = model_flops_per_sec / peak if peak else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_mfu",
+                "value": round(mfu, 4),
+                "unit": "MFU",
+                "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+                "tokens_per_sec": round(tokens_per_sec, 1),
+                "step_time_ms": round(1000 * dt / steps, 2),
+                "params": n_params,
+                "device": getattr(device, "device_kind", str(device)),
+                "loss": round(float(metrics["loss"]), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

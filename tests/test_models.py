@@ -127,6 +127,23 @@ class TestBert:
         logits = bert.classify(params, batch, config)
         assert logits.shape == (4, config.num_labels)
 
+    def test_param_count_matches(self):
+        config = bert.BertConfig.tiny()
+        params = bert.init(jax.random.PRNGKey(0), config)
+        actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert actual == config.param_count()
+
+    def test_dropout_train_vs_eval(self):
+        config = bert.BertConfig.tiny(dropout_rate=0.5)
+        params = bert.init(jax.random.PRNGKey(0), config)
+        batch = {"input_ids": jnp.zeros((2, 8), jnp.int32)}
+        eval1 = bert.classify(params, batch, config)
+        eval2 = bert.classify(params, batch, config)
+        np.testing.assert_allclose(eval1, eval2)  # eval deterministic
+        t1 = bert.classify(params, batch, config, rng=jax.random.PRNGKey(1))
+        t2 = bert.classify(params, batch, config, rng=jax.random.PRNGKey(2))
+        assert not np.allclose(t1, t2)  # dropout active under rng
+
     def test_padding_mask_ignored(self):
         """Padding tokens must not affect the [CLS] representation."""
         config = bert.BertConfig.tiny()
