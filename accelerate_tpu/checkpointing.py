@@ -1,0 +1,570 @@
+"""Checkpoint / resume.
+
+TPU-native redesign of the reference checkpoint stack (`accelerator.py:3106`
+`save_state` / :3272 `load_state`, `checkpointing.py:57`, FSDP sharded dicts
+`utils/fsdp_utils.py:66-246`, merge tool :247-329). Design:
+
+- **Sharded-by-construction**: every process writes only the addressable
+  shards it owns (replica 0 of each), so a multi-host FSDP checkpoint never
+  materializes a full array anywhere — the analog of torch.distributed
+  .checkpoint's SHARDED_STATE_DICT, but it is the *only* format: one layout
+  serves save/load on any mesh because load reassembles requested slices
+  from overlapping saved shards.
+- **Topology-independent load**: save on a (data=2, fsdp=4) mesh, load on
+  (fsdp=8) or a single device — the reader slices what each target device
+  needs from the shard files (reference FULL↔SHARDED conversion collapses).
+- **Plain formats**: one `.npz` per process + one JSON index per process.
+  No tensorstore; numpy memory-maps lazily on read.
+- Round-trip state beyond params mirrors the reference: RNG bundle, step,
+  dataloader iterator states, and `register_for_checkpointing` objects
+  (`checkpointing.py:101-171`, `accelerator.py:3550`).
+- `automatic_checkpoint_naming` + `total_limit` rotation
+  (`ProjectConfiguration`, reference `utils/dataclasses.py:857-917`).
+- Async save: device->host transfer happens synchronously (cheap, HBM->RAM),
+  file writing on a background thread (the orbax async-checkpoint pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random as _py_random
+import re
+import shutil
+import threading
+from typing import TYPE_CHECKING, Any, Iterable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .accelerator import Accelerator, TrainState
+
+MODEL_DIR = "train_state"
+RNG_FILE = "rng_state_{proc}.json"
+DATALOADER_FILE = "dataloaders.json"
+CUSTOM_FILE = "custom_checkpoint_{i}.pkl"
+METADATA_FILE = "metadata.json"
+_CKPT_PATTERN = re.compile(r"^checkpoint_(\d+)$")
+
+
+# ------------------------------------------------------------------ pytree IO
+def _leaf_key(path: tuple) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _shard_entry_key(leaf_key: str, starts: tuple[int, ...]) -> str:
+    return f"{leaf_key}|{','.join(map(str, starts))}"
+
+
+def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) -> None:
+    """Write the addressable (replica-0) shards of a pytree of jax.Arrays.
+
+    Layout: ``shards_{proc}.npz`` (shard data) + ``index_{proc}.json``
+    (per-leaf global shape/dtype + shard table). Small host-side leaves
+    (python/numpy scalars) go straight into the index.
+    """
+    proc = jax.process_index() if process_index is None else process_index
+    os.makedirs(directory, exist_ok=True)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    shard_data: dict[str, np.ndarray] = {}
+    index: dict[str, Any] = {}
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        if isinstance(leaf, jax.Array):
+            entry: dict[str, Any] = {
+                "shape": list(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "shards": [],
+            }
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # exactly one process saves each block
+                starts = tuple(
+                    (sl.start or 0) for sl in shard.index
+                ) if leaf.ndim else ()
+                data = np.asarray(shard.data)
+                skey = _shard_entry_key(key, starts)
+                shard_data[skey] = data
+                entry["shards"].append({"starts": list(starts), "shape": list(data.shape)})
+            if entry["shards"]:
+                index[key] = entry
+            elif leaf.is_fully_replicated and proc == 0:
+                # replica_id bookkeeping can mark all local shards non-zero on
+                # some topologies; main process persists replicated leaves.
+                data = np.asarray(leaf)
+                skey = _shard_entry_key(key, (0,) * leaf.ndim)
+                shard_data[skey] = data
+                index[key] = {
+                    "shape": list(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                    "shards": [{"starts": [0] * leaf.ndim, "shape": list(data.shape)}],
+                }
+        else:
+            if proc == 0:
+                index[key] = {"value": _to_jsonable(leaf)}
+    np.savez(os.path.join(directory, f"shards_{proc}.npz"), **shard_data)
+    with open(os.path.join(directory, f"index_{proc}.json"), "w") as f:
+        json.dump(index, f)
+
+
+def _to_jsonable(leaf: Any) -> Any:
+    if isinstance(leaf, (np.integer,)):
+        return int(leaf)
+    if isinstance(leaf, (np.floating,)):
+        return float(leaf)
+    if isinstance(leaf, np.ndarray):
+        return {"__ndarray__": leaf.tolist(), "dtype": str(leaf.dtype)}
+    return leaf
+
+
+def _from_jsonable(value: Any) -> Any:
+    if isinstance(value, dict) and "__ndarray__" in value:
+        return np.asarray(value["__ndarray__"], dtype=value["dtype"])
+    return value
+
+
+class _ShardReader:
+    """Lazily-opened view over every process's shard files in a directory."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        self.index: dict[str, Any] = {}
+        # leaf key -> list of (starts, shape, proc)
+        self.shard_table: dict[str, list[tuple[tuple[int, ...], tuple[int, ...], int]]] = {}
+        self._files: dict[int, Any] = {}
+        procs = []
+        for name in sorted(os.listdir(directory)):
+            m = re.match(r"^index_(\d+)\.json$", name)
+            if not m:
+                continue
+            proc = int(m.group(1))
+            procs.append(proc)
+            with open(os.path.join(directory, name)) as f:
+                idx = json.load(f)
+            for key, entry in idx.items():
+                if "shards" in entry:
+                    base = self.index.setdefault(key, {k: entry[k] for k in ("shape", "dtype")})
+                    base.setdefault("shards", True)
+                    for sh in entry["shards"]:
+                        self.shard_table.setdefault(key, []).append(
+                            (tuple(sh["starts"]), tuple(sh["shape"]), proc)
+                        )
+                else:
+                    self.index.setdefault(key, entry)
+        if not procs:
+            raise FileNotFoundError(f"No checkpoint index files in {directory}")
+
+    def _npz(self, proc: int) -> Any:
+        if proc not in self._files:
+            self._files[proc] = np.load(
+                os.path.join(self.directory, f"shards_{proc}.npz"), mmap_mode="r"
+            )
+        return self._files[proc]
+
+    def leaf_info(self, key: str) -> dict[str, Any]:
+        return self.index[key]
+
+    def read_slice(self, key: str, idx: tuple[slice, ...], shape: tuple[int, ...], dtype: Any) -> np.ndarray:
+        """Assemble the requested global slice from overlapping saved shards
+        (saved and requested shard boundaries need not match)."""
+        req_starts = tuple((sl.start or 0) for sl in idx)
+        req_stops = tuple(
+            (sl.stop if sl.stop is not None else dim) for sl, dim in zip(idx, shape)
+        )
+        req_shape = tuple(b - a for a, b in zip(req_starts, req_stops))
+        out = np.empty(req_shape, dtype=dtype)
+        filled = 0
+        for starts, sshape, proc in self.shard_table.get(key, ()):
+            stops = tuple(a + s for a, s in zip(starts, sshape))
+            inter_start = tuple(max(a, b) for a, b in zip(starts, req_starts))
+            inter_stop = tuple(min(a, b) for a, b in zip(stops, req_stops))
+            if any(a >= b for a, b in zip(inter_start, inter_stop)):
+                continue
+            src = self._npz(proc)[_shard_entry_key(key, starts)]
+            src_idx = tuple(
+                slice(a - s0, b - s0) for a, b, s0 in zip(inter_start, inter_stop, starts)
+            )
+            dst_idx = tuple(
+                slice(a - r0, b - r0) for a, b, r0 in zip(inter_start, inter_stop, req_starts)
+            )
+            out[dst_idx] = src[src_idx]
+            filled += int(np.prod([b - a for a, b in zip(inter_start, inter_stop)]))
+        if filled < int(np.prod(req_shape)):
+            raise ValueError(
+                f"Checkpoint shards for {key!r} do not cover requested slice {idx} "
+                f"(covered {filled}/{int(np.prod(req_shape))} elements)"
+            )
+        return out
+
+    def read_full(self, key: str) -> np.ndarray:
+        info = self.index[key]
+        shape = tuple(info["shape"])
+        return self.read_slice(
+            key, tuple(slice(0, d) for d in shape), shape, np.dtype(info["dtype"])
+        )
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+def load_pytree(target: Any, directory: str, *, mesh: Mesh | None = None) -> Any:
+    """Restore a pytree saved with `save_pytree` into ``target``'s structure.
+
+    jax.Array leaves are rebuilt with their **current** shardings (each device
+    fetches exactly its slice — topology-independent resharding); other
+    leaves come from the JSON index. Raises KeyError on missing leaves.
+    """
+    reader = _ShardReader(directory)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    out = []
+    try:
+        for path, leaf in flat:
+            key = _leaf_key(path)
+            if key not in reader.index:
+                raise KeyError(
+                    f"Leaf {key!r} missing from checkpoint at {directory} "
+                    f"(has {len(reader.index)} leaves)"
+                )
+            info = reader.leaf_info(key)
+            if "value" in info:
+                out.append(_from_jsonable(info["value"]))
+                continue
+            shape = tuple(info["shape"])
+            dtype = np.dtype(info["dtype"])
+            if isinstance(leaf, jax.Array):
+                if tuple(leaf.shape) != shape:
+                    raise ValueError(
+                        f"Shape mismatch for {key!r}: target {tuple(leaf.shape)} vs "
+                        f"checkpoint {shape}"
+                    )
+                sharding = leaf.sharding
+                target_dtype = leaf.dtype
+                arr = jax.make_array_from_callback(
+                    shape,
+                    sharding,
+                    lambda idx, k=key, s=shape, d=dtype, td=target_dtype: reader.read_slice(
+                        k, idx, s, d
+                    ).astype(td),
+                )
+                out.append(arr)
+            else:
+                out.append(reader.read_full(key))
+    finally:
+        reader.close()
+    return jax.tree_util.tree_unflatten(treedef, [x for x in out])
+
+
+def consolidate_checkpoint(directory: str, output_path: str) -> str:
+    """Merge a sharded pytree dir into one host `.npz` with full arrays —
+    the `accelerate merge-weights` analog (reference `utils/fsdp_utils.py:275`)."""
+    reader = _ShardReader(directory)
+    merged: dict[str, np.ndarray] = {}
+    try:
+        for key, info in reader.index.items():
+            if "value" in info:
+                continue
+            merged[key] = reader.read_full(key)
+    finally:
+        reader.close()
+    if not output_path.endswith(".npz"):
+        output_path = output_path + ".npz"
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    np.savez(output_path, **merged)
+    return output_path
+
+
+# ------------------------------------------------------------------- RNG state
+def _rng_state_bundle(accelerator: "Accelerator") -> dict[str, Any]:
+    return {
+        "python_state": _encode_py_random(),
+        "numpy_state": _encode_np_random(),
+        "jax_key": _encode_jax_key(accelerator.rng),
+    }
+
+
+def _encode_jax_key(key: jax.Array) -> dict[str, Any]:
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return {"typed": True, "data": np.asarray(jax.random.key_data(key)).tolist()}
+    return {"typed": False, "data": np.asarray(key).tolist()}
+
+
+def _decode_jax_key(bundle: dict[str, Any]) -> jax.Array:
+    data = np.asarray(bundle["data"], dtype=np.uint32)
+    if bundle.get("typed"):
+        return jax.random.wrap_key_data(data)
+    import jax.numpy as jnp
+
+    return jnp.asarray(data)
+
+
+def _encode_py_random() -> list[Any]:
+    state = _py_random.getstate()
+    return json.loads(json.dumps(state, default=list))
+
+
+def _encode_np_random() -> dict[str, Any]:
+    name, keys, pos, has_gauss, cached = np.random.get_state()
+    return {
+        "name": name,
+        "keys": keys.tolist(),
+        "pos": int(pos),
+        "has_gauss": int(has_gauss),
+        "cached": float(cached),
+    }
+
+
+def _restore_rng_bundle(accelerator: "Accelerator", bundle: dict[str, Any]) -> None:
+    state = bundle.get("python_state")
+    if state:
+        version, internal, gauss = state
+        _py_random.setstate((version, tuple(internal), gauss))
+    np_state = bundle.get("numpy_state")
+    if np_state:
+        np.random.set_state(
+            (
+                np_state["name"],
+                np.asarray(np_state["keys"], dtype=np.uint32),
+                np_state["pos"],
+                np_state["has_gauss"],
+                np_state["cached"],
+            )
+        )
+    key_bundle = bundle.get("jax_key")
+    if key_bundle is not None:
+        accelerator.rng = _decode_jax_key(key_bundle)
+
+
+# ------------------------------------------------------------- rotation naming
+def _checkpoint_dirs(root: str) -> list[tuple[int, str]]:
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _CKPT_PATTERN.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+def _resolve_save_dir(accelerator: "Accelerator", output_dir: str | None) -> str:
+    cfg = accelerator.project_config
+    if cfg.automatic_checkpoint_naming:
+        root = os.path.join(cfg.project_dir or ".", "checkpoints")
+        existing = _checkpoint_dirs(root)
+        iteration = cfg.iteration
+        if existing:
+            iteration = max(iteration, existing[-1][0] + 1)
+        save_dir = os.path.join(root, f"checkpoint_{iteration}")
+        cfg.iteration = iteration + 1
+        if cfg.total_limit is not None:
+            for _, old in existing[: max(0, len(existing) + 1 - cfg.total_limit)]:
+                shutil.rmtree(old, ignore_errors=True)
+        return save_dir
+    if output_dir is None:
+        raise ValueError("output_dir is required unless automatic_checkpoint_naming is set")
+    return output_dir
+
+
+# --------------------------------------------------------------- async writing
+class _AsyncSaver:
+    """Serializes background checkpoint writes; one in flight at a time."""
+
+    def __init__(self) -> None:
+        self._thread: threading.Thread | None = None
+        self._error: list[BaseException] = []
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error:
+            err = self._error[0]
+            self._error.clear()
+            raise err
+
+    def submit(self, fn, *args: Any) -> None:
+        self.wait()
+
+        def run() -> None:
+            try:
+                fn(*args)
+            except BaseException as e:  # surfaced on next wait()
+                self._error.append(e)
+
+        self._thread = threading.Thread(target=run, daemon=False)
+        self._thread.start()
+
+
+_ASYNC_SAVER = _AsyncSaver()
+
+
+def wait_for_checkpoint() -> None:
+    """Block until any in-flight async save completes (and re-raise errors)."""
+    _ASYNC_SAVER.wait()
+
+
+# ---------------------------------------------------------------- entry points
+def save_state(
+    accelerator: "Accelerator",
+    output_dir: str | None,
+    state: "TrainState",
+    *,
+    dataloaders: Iterable[Any] | None = None,
+    async_save: bool = False,
+) -> str:
+    """Full training-state checkpoint (reference `save_state`,
+    `accelerator.py:3106`): TrainState pytree (sharded), RNG bundle, step,
+    dataloader iterator states, registered custom objects."""
+    save_dir = _resolve_save_dir(accelerator, output_dir)
+    os.makedirs(save_dir, exist_ok=True)
+    proc = jax.process_index()
+
+    saveable = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+
+    if async_save:
+        # Synchronously snapshot device data to host, write files off-thread.
+        host_tree = jax.tree.map(
+            lambda x: _HostShardSnapshot(x) if isinstance(x, jax.Array) else x, saveable
+        )
+        _ASYNC_SAVER.submit(_write_snapshot_tree, host_tree, os.path.join(save_dir, MODEL_DIR), proc)
+    else:
+        save_pytree(saveable, os.path.join(save_dir, MODEL_DIR))
+
+    with open(os.path.join(save_dir, RNG_FILE.format(proc=proc)), "w") as f:
+        json.dump(_rng_state_bundle(accelerator), f)
+
+    if proc == 0:
+        dls = list(dataloaders) if dataloaders is not None else accelerator._dataloaders
+        dl_states = [dl.state_dict() for dl in dls]
+        with open(os.path.join(save_dir, DATALOADER_FILE), "w") as f:
+            json.dump(dl_states, f)
+        for i, obj in enumerate(accelerator._checkpoint_registry):
+            with open(os.path.join(save_dir, CUSTOM_FILE.format(i=i)), "wb") as f:
+                pickle.dump(obj.state_dict(), f)
+        with open(os.path.join(save_dir, METADATA_FILE), "w") as f:
+            json.dump(
+                {
+                    "step": int(jax.device_get(state.step)),
+                    "mesh": dict(accelerator.mesh.shape),
+                    "num_processes": jax.process_count(),
+                    "version": 1,
+                },
+                f,
+            )
+    accelerator.project_config  # rotation handled in _resolve_save_dir
+    return save_dir
+
+
+class _HostShardSnapshot:
+    """Host-side copy of a jax.Array's replica-0 shards (taken synchronously
+    so training can mutate/donate the device buffers while files write)."""
+
+    def __init__(self, arr: jax.Array) -> None:
+        self.shape = tuple(arr.shape)
+        self.dtype = np.dtype(arr.dtype)
+        self.ndim = arr.ndim
+        self.shards = []
+        any_replica0 = False
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            any_replica0 = True
+            starts = tuple((sl.start or 0) for sl in shard.index) if arr.ndim else ()
+            self.shards.append((starts, np.asarray(shard.data)))
+        if not any_replica0 and arr.is_fully_replicated and jax.process_index() == 0:
+            self.shards.append(((0,) * arr.ndim, np.asarray(arr)))
+
+
+def _write_snapshot_tree(tree: Any, directory: str, proc: int) -> None:
+    os.makedirs(directory, exist_ok=True)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, _HostShardSnapshot)
+    )
+    shard_data: dict[str, np.ndarray] = {}
+    index: dict[str, Any] = {}
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        if isinstance(leaf, _HostShardSnapshot):
+            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype), "shards": []}
+            for starts, data in leaf.shards:
+                shard_data[_shard_entry_key(key, starts)] = data
+                entry["shards"].append({"starts": list(starts), "shape": list(data.shape)})
+            if entry["shards"]:
+                index[key] = entry
+        elif proc == 0:
+            index[key] = {"value": _to_jsonable(leaf)}
+    np.savez(os.path.join(directory, f"shards_{proc}.npz"), **shard_data)
+    with open(os.path.join(directory, f"index_{proc}.json"), "w") as f:
+        json.dump(index, f)
+
+
+def load_state(
+    accelerator: "Accelerator",
+    input_dir: str,
+    state: "TrainState",
+    *,
+    dataloaders: Iterable[Any] | None = None,
+) -> "TrainState":
+    """Restore a `save_state` checkpoint into ``state``'s shardings
+    (reference `load_state`, `accelerator.py:3272`)."""
+    wait_for_checkpoint()
+    target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+    restored = load_pytree(target, os.path.join(input_dir, MODEL_DIR), mesh=accelerator.mesh)
+
+    rng_path = os.path.join(input_dir, RNG_FILE.format(proc=jax.process_index()))
+    if not os.path.exists(rng_path):
+        rng_path = os.path.join(input_dir, RNG_FILE.format(proc=0))
+    if os.path.exists(rng_path):
+        with open(rng_path) as f:
+            _restore_rng_bundle(accelerator, json.load(f))
+
+    dl_path = os.path.join(input_dir, DATALOADER_FILE)
+    if os.path.exists(dl_path):
+        with open(dl_path) as f:
+            dl_states = json.load(f)
+        dls = list(dataloaders) if dataloaders is not None else accelerator._dataloaders
+        for dl, dl_state in zip(dls, dl_states):
+            dl.load_state_dict(dl_state)
+
+    for i, obj in enumerate(accelerator._checkpoint_registry):
+        path = os.path.join(input_dir, CUSTOM_FILE.format(i=i))
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                obj.load_state_dict(pickle.load(f))
+
+    return state.replace(
+        step=restored["step"], params=restored["params"], opt_state=restored["opt_state"]
+    )
+
+
+def save_model(
+    accelerator: "Accelerator",
+    params: Any,
+    output_dir: str,
+    *,
+    consolidate: bool = True,
+) -> str:
+    """Inference checkpoint of params only (reference `save_model`,
+    `accelerator.py:2963`). Sharded layout, optionally merged to one file."""
+    model_dir = os.path.join(output_dir, "model")
+    save_pytree(params, model_dir)
+    if consolidate and jax.process_index() == 0:
+        return consolidate_checkpoint(model_dir, os.path.join(output_dir, "model.npz"))
+    return model_dir

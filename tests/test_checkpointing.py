@@ -1,0 +1,223 @@
+"""Checkpoint/resume tests (reference `tests/test_state_checkpointing.py`).
+
+Core oracle: save → perturb → load must restore bit-identical state, across
+*different* mesh topologies (sharded-save → resharded-load replaces the
+reference's FULL↔SHARDED state-dict conversion and merge tool)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, MeshConfig
+from accelerate_tpu import checkpointing
+from accelerate_tpu.models import llama
+from accelerate_tpu.parallel.tp import get_tp_plan
+
+
+def _tiny_state(acc, config):
+    return acc.create_train_state(lambda r: llama.init(r, config), optax.adam(1e-3))
+
+
+def _assert_trees_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+class TestPytreeRoundTrip:
+    def test_sharded_save_load_same_mesh(self, tmp_path):
+        acc = Accelerator(mesh_config=MeshConfig(data=2, fsdp=4), strategy="FSDP")
+        config = llama.LlamaConfig.tiny()
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree({"params": state.params}, d)
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        restored = checkpointing.load_pytree({"params": zeros}, d)
+        _assert_trees_equal(restored["params"], state.params)
+
+    def test_cross_topology_reload(self, tmp_path):
+        """Save under FSDP=8 sharding, reload replicated — and vice versa."""
+        config = llama.LlamaConfig.tiny()
+        acc_sharded = Accelerator(mesh_config=MeshConfig(data=1, fsdp=8), strategy="FSDP")
+        state = _tiny_state(acc_sharded, config)
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree(state.params, d)
+
+        # reload fully replicated
+        host_params = jax.device_get(state.params)
+        replicated_target = jax.tree.map(lambda x: jnp.zeros_like(jnp.asarray(x)), host_params)
+        restored = checkpointing.load_pytree(replicated_target, d)
+        _assert_trees_equal(restored, host_params)
+
+    def test_tp_to_fsdp_reshard(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc_tp = Accelerator(
+            mesh_config=MeshConfig(data=2, tensor=4),
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=get_tp_plan("llama"),
+        )
+        state_tp = _tiny_state(acc_tp, config)
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree(state_tp.params, d)
+
+        from accelerate_tpu.state import AcceleratorState, GradientState, ProcessState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); ProcessState._reset_state()
+        acc_fsdp = Accelerator(mesh_config=MeshConfig(data=1, fsdp=8), strategy="FSDP")
+        state_fsdp = _tiny_state(acc_fsdp, config)
+        restored = checkpointing.load_pytree(state_fsdp.params, d)
+        _assert_trees_equal(jax.device_get(restored), jax.device_get(state_tp.params))
+
+    def test_missing_leaf_raises(self, tmp_path):
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree({"a": jnp.ones((4,))}, d)
+        with pytest.raises(KeyError):
+            checkpointing.load_pytree({"a": jnp.zeros((4,)), "b": jnp.zeros((2,))}, d)
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree({"a": jnp.ones((4,))}, d)
+        with pytest.raises(ValueError):
+            checkpointing.load_pytree({"a": jnp.zeros((8,))}, d)
+
+
+class TestSaveLoadState:
+    def test_full_round_trip(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(data=2, fsdp=4), strategy="FSDP", seed=3)
+        state = _tiny_state(acc, config)
+        step = acc.make_train_step(lambda p, b, r: llama.loss_fn(p, b, config, r))
+        batch = {"input_ids": jnp.ones((8, 16), jnp.int32)}
+        state, _ = step(state, batch)
+        state, _ = step(state, batch)
+
+        d = str(tmp_path / "ck")
+        acc.save_state(d, state)
+        # Snapshot before stepping again: the compiled step donates its input
+        # state buffers, so `state` is consumed by the next step call.
+        expected_params = jax.device_get(state.params)
+        expected_opt = jax.device_get(state.opt_state)
+        later, _ = step(state, batch)
+        restored = acc.load_state(d, later)
+        assert int(jax.device_get(restored.step)) == 2
+        _assert_trees_equal(jax.device_get(restored.params), expected_params)
+        _assert_trees_equal(jax.device_get(restored.opt_state), expected_opt)
+
+    def test_async_save(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        acc.save_state(d, state, async_save=True)
+        checkpointing.wait_for_checkpoint()
+        restored = acc.load_state(d, state)
+        _assert_trees_equal(jax.device_get(restored.params), jax.device_get(state.params))
+
+    def test_registered_objects(self, tmp_path):
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def state_dict(self):
+                return {"n": self.n}
+
+            def load_state_dict(self, s):
+                self.n = s["n"]
+
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        counter = Counter()
+        counter.n = 42
+        acc.register_for_checkpointing(counter)
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        acc.save_state(d, state)
+        counter.n = 0
+        acc.load_state(d, state)
+        assert counter.n == 42
+
+    def test_rng_round_trip(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=9)
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        key_before = np.asarray(acc.rng)
+        acc.save_state(d, state)
+        acc.rng = jax.random.PRNGKey(777)
+        acc.load_state(d, state)
+        np.testing.assert_array_equal(np.asarray(acc.rng), key_before)
+
+    def test_dataloader_resume(self, tmp_path):
+        from accelerate_tpu.data.loader import DataLoader
+
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        data = [{"input_ids": np.full((4,), i, np.int32)} for i in range(64)]
+        dl = acc.prepare_data_loader(data, batch_size=1)  # global batch 8
+        state = _tiny_state(acc, config)
+
+        it = iter(dl)
+        next(it); next(it); next(it)
+        d = str(tmp_path / "ck")
+        acc.save_state(d, state)
+        it.close()
+
+        from accelerate_tpu.state import AcceleratorState, GradientState, ProcessState
+
+        AcceleratorState._reset_state(); GradientState._reset_state(); ProcessState._reset_state()
+        acc2 = Accelerator(mesh_config=MeshConfig(), seed=0)
+        dl2 = acc2.prepare_data_loader(data, batch_size=1)
+        state2 = _tiny_state(acc2, config)
+        acc2.load_state(d, state2)
+        batches = list(dl2)
+        # 64 samples / global batch 8 = 8 batches; 3 consumed pre-checkpoint
+        assert len(batches) == 5
+        first = np.asarray(jax.device_get(batches[0]["input_ids"]))
+        assert first.min() == 24  # resumes at sample index 3*8
+
+
+class TestRotation:
+    def test_automatic_naming_and_total_limit(self, tmp_path):
+        from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(
+            mesh_config=MeshConfig(),
+            project_config=ProjectConfiguration(
+                project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+            ),
+        )
+        state = _tiny_state(acc, config)
+        for _ in range(4):
+            acc.save_state(None, state)
+        root = tmp_path / "checkpoints"
+        names = sorted(os.listdir(root))
+        assert names == ["checkpoint_2", "checkpoint_3"]
+
+
+class TestConsolidate:
+    def test_merge_matches_full(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(data=1, fsdp=8), strategy="FSDP")
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree(state.params, d)
+        out = checkpointing.consolidate_checkpoint(d, str(tmp_path / "merged"))
+        merged = np.load(out)
+        host = jax.device_get(state.params)
+        flat, _ = jax.tree_util.tree_flatten_with_path(host)
+        for path, leaf in flat:
+            key = checkpointing._leaf_key(path)
+            np.testing.assert_array_equal(merged[key], np.asarray(leaf))
+
+    def test_save_model(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig())
+        state = _tiny_state(acc, config)
+        out = checkpointing.save_model(acc, state.params, str(tmp_path / "m"))
+        assert out.endswith(".npz") and os.path.exists(out)
