@@ -14,7 +14,7 @@ TPU-native redesign of the reference checkpoint stack (`accelerator.py:3106`
   (fsdp=8) or a single device — the reader slices what each target device
   needs from the shard files (reference FULL↔SHARDED conversion collapses).
 - **Plain formats**: one `.npz` per process + one JSON index per process.
-  No tensorstore; numpy memory-maps lazily on read.
+  No tensorstore; readers cache decoded shards across slice requests.
 - Round-trip state beyond params mirrors the reference: RNG bundle, step,
   dataloader iterator states, and `register_for_checkpointing` objects
   (`checkpointing.py:101-171`, `accelerator.py:3550`).
@@ -70,7 +70,8 @@ def _shard_entry_key(leaf_key: str, starts: tuple[int, ...]) -> str:
 
 
 def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) -> None:
-    """Write the addressable (replica-0) shards of a pytree of jax.Arrays.
+    """Write the addressable (replica-0) shards of a pytree of jax.Arrays
+    (or pre-snapshotted `_HostShardSnapshot` leaves — the async path).
 
     Layout: ``shards_{proc}.npz`` (shard data) + ``index_{proc}.json``
     (per-leaf global shape/dtype + shard table). Small host-side leaves
@@ -78,40 +79,26 @@ def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) 
     """
     proc = jax.process_index() if process_index is None else process_index
     os.makedirs(directory, exist_ok=True)
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, _HostShardSnapshot)
+    )
     shard_data: dict[str, np.ndarray] = {}
     index: dict[str, Any] = {}
     for path, leaf in flat:
         key = _leaf_key(path)
         if isinstance(leaf, jax.Array):
+            leaf = _HostShardSnapshot(leaf, process_index=proc)
+        if isinstance(leaf, _HostShardSnapshot):
             entry: dict[str, Any] = {
                 "shape": list(leaf.shape),
                 "dtype": str(leaf.dtype),
                 "shards": [],
             }
-            for shard in leaf.addressable_shards:
-                if shard.replica_id != 0:
-                    continue  # exactly one process saves each block
-                starts = tuple(
-                    (sl.start or 0) for sl in shard.index
-                ) if leaf.ndim else ()
-                data = np.asarray(shard.data)
-                skey = _shard_entry_key(key, starts)
-                shard_data[skey] = data
+            for starts, data in leaf.shards:
+                shard_data[_shard_entry_key(key, starts)] = data
                 entry["shards"].append({"starts": list(starts), "shape": list(data.shape)})
             if entry["shards"]:
                 index[key] = entry
-            elif leaf.is_fully_replicated and proc == 0:
-                # replica_id bookkeeping can mark all local shards non-zero on
-                # some topologies; main process persists replicated leaves.
-                data = np.asarray(leaf)
-                skey = _shard_entry_key(key, (0,) * leaf.ndim)
-                shard_data[skey] = data
-                index[key] = {
-                    "shape": list(leaf.shape),
-                    "dtype": str(leaf.dtype),
-                    "shards": [{"starts": [0] * leaf.ndim, "shape": list(data.shape)}],
-                }
         else:
             if proc == 0:
                 index[key] = {"value": _to_jsonable(leaf)}
@@ -145,6 +132,7 @@ class _ShardReader:
         # leaf key -> list of (starts, shape, proc)
         self.shard_table: dict[str, list[tuple[tuple[int, ...], tuple[int, ...], int]]] = {}
         self._files: dict[int, Any] = {}
+        self._array_cache: dict[tuple[int, str], np.ndarray] = {}
         procs = []
         for name in sorted(os.listdir(directory)):
             m = re.match(r"^index_(\d+)\.json$", name)
@@ -169,10 +157,17 @@ class _ShardReader:
 
     def _npz(self, proc: int) -> Any:
         if proc not in self._files:
-            self._files[proc] = np.load(
-                os.path.join(self.directory, f"shards_{proc}.npz"), mmap_mode="r"
-            )
+            self._files[proc] = np.load(os.path.join(self.directory, f"shards_{proc}.npz"))
         return self._files[proc]
+
+    def _shard_array(self, proc: int, skey: str) -> np.ndarray:
+        # NpzFile re-reads the zip member on every access; resharding loads
+        # touch the same shard once per target device, so cache decoded arrays.
+        cached = self._array_cache.get((proc, skey))
+        if cached is None:
+            cached = self._npz(proc)[skey]
+            self._array_cache[(proc, skey)] = cached
+        return cached
 
     def leaf_info(self, key: str) -> dict[str, Any]:
         return self.index[key]
@@ -186,14 +181,16 @@ class _ShardReader:
         )
         req_shape = tuple(b - a for a, b in zip(req_starts, req_stops))
         out = np.empty(req_shape, dtype=dtype)
-        filled = 0
+        # Boolean fill mask (not a volume count): overlapping shards must not
+        # be able to mask a hole and leak uninitialized memory.
+        covered = np.zeros(req_shape, dtype=bool) if req_shape else np.zeros((), dtype=bool)
         for starts, sshape, proc in self.shard_table.get(key, ()):
             stops = tuple(a + s for a, s in zip(starts, sshape))
             inter_start = tuple(max(a, b) for a, b in zip(starts, req_starts))
             inter_stop = tuple(min(a, b) for a, b in zip(stops, req_stops))
             if any(a >= b for a, b in zip(inter_start, inter_stop)):
                 continue
-            src = self._npz(proc)[_shard_entry_key(key, starts)]
+            src = self._shard_array(proc, _shard_entry_key(key, starts))
             src_idx = tuple(
                 slice(a - s0, b - s0) for a, b, s0 in zip(inter_start, inter_stop, starts)
             )
@@ -201,11 +198,11 @@ class _ShardReader:
                 slice(a - r0, b - r0) for a, b, r0 in zip(inter_start, inter_stop, req_starts)
             )
             out[dst_idx] = src[src_idx]
-            filled += int(np.prod([b - a for a, b in zip(inter_start, inter_stop)]))
-        if filled < int(np.prod(req_shape)):
+            covered[dst_idx] = True
+        if not covered.all():
             raise ValueError(
                 f"Checkpoint shards for {key!r} do not cover requested slice {idx} "
-                f"(covered {filled}/{int(np.prod(req_shape))} elements)"
+                f"({int(covered.sum())}/{int(np.prod(req_shape))} elements covered)"
             )
         return out
 
@@ -220,9 +217,10 @@ class _ShardReader:
         for f in self._files.values():
             f.close()
         self._files.clear()
+        self._array_cache.clear()
 
 
-def load_pytree(target: Any, directory: str, *, mesh: Mesh | None = None) -> Any:
+def load_pytree(target: Any, directory: str) -> Any:
     """Restore a pytree saved with `save_pytree` into ``target``'s structure.
 
     jax.Array leaves are rebuilt with their **current** shardings (each device
@@ -432,18 +430,33 @@ def save_state(
     """Full training-state checkpoint (reference `save_state`,
     `accelerator.py:3106`): TrainState pytree (sharded), RNG bundle, step,
     dataloader iterator states, registered custom objects."""
-    save_dir = _resolve_save_dir(accelerator, output_dir)
-    os.makedirs(save_dir, exist_ok=True)
+    # Join any in-flight async save first: rotation must never delete a
+    # directory a background writer is still filling.
+    wait_for_checkpoint()
     proc = jax.process_index()
+    if proc == 0:
+        save_dir = _resolve_save_dir(accelerator, output_dir)
+    else:
+        save_dir = None
+    if jax.process_count() > 1:
+        # All hosts must agree on the directory (independent filesystem
+        # listings race under automatic_checkpoint_naming).
+        from .ops.collectives import broadcast_object_list
+
+        save_dir = broadcast_object_list([save_dir])[0]
+    os.makedirs(save_dir, exist_ok=True)
 
     saveable = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
 
     if async_save:
-        # Synchronously snapshot device data to host, write files off-thread.
+        # Synchronously snapshot device data to host, write files off-thread
+        # through the same writer as the sync path (one on-disk format).
         host_tree = jax.tree.map(
             lambda x: _HostShardSnapshot(x) if isinstance(x, jax.Array) else x, saveable
         )
-        _ASYNC_SAVER.submit(_write_snapshot_tree, host_tree, os.path.join(save_dir, MODEL_DIR), proc)
+        _ASYNC_SAVER.submit(
+            save_pytree, host_tree, os.path.join(save_dir, MODEL_DIR)
+        )
     else:
         save_pytree(saveable, os.path.join(save_dir, MODEL_DIR))
 
@@ -468,7 +481,6 @@ def save_state(
                 },
                 f,
             )
-    accelerator.project_config  # rotation handled in _resolve_save_dir
     return save_dir
 
 
@@ -476,11 +488,12 @@ class _HostShardSnapshot:
     """Host-side copy of a jax.Array's replica-0 shards (taken synchronously
     so training can mutate/donate the device buffers while files write)."""
 
-    def __init__(self, arr: jax.Array) -> None:
+    def __init__(self, arr: jax.Array, *, process_index: int | None = None) -> None:
+        proc = jax.process_index() if process_index is None else process_index
         self.shape = tuple(arr.shape)
         self.dtype = np.dtype(arr.dtype)
         self.ndim = arr.ndim
-        self.shards = []
+        self.shards: list[tuple[tuple[int, ...], np.ndarray]] = []
         any_replica0 = False
         for shard in arr.addressable_shards:
             if shard.replica_id != 0:
@@ -488,31 +501,11 @@ class _HostShardSnapshot:
             any_replica0 = True
             starts = tuple((sl.start or 0) for sl in shard.index) if arr.ndim else ()
             self.shards.append((starts, np.asarray(shard.data)))
-        if not any_replica0 and arr.is_fully_replicated and jax.process_index() == 0:
+        if not any_replica0 and arr.is_fully_replicated and proc == 0:
+            # replica_id bookkeeping can mark all local shards non-zero on
+            # some topologies; main process persists replicated leaves.
             self.shards.append(((0,) * arr.ndim, np.asarray(arr)))
 
-
-def _write_snapshot_tree(tree: Any, directory: str, proc: int) -> None:
-    os.makedirs(directory, exist_ok=True)
-    flat, _ = jax.tree_util.tree_flatten_with_path(
-        tree, is_leaf=lambda x: isinstance(x, _HostShardSnapshot)
-    )
-    shard_data: dict[str, np.ndarray] = {}
-    index: dict[str, Any] = {}
-    for path, leaf in flat:
-        key = _leaf_key(path)
-        if isinstance(leaf, _HostShardSnapshot):
-            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype), "shards": []}
-            for starts, data in leaf.shards:
-                shard_data[_shard_entry_key(key, starts)] = data
-                entry["shards"].append({"starts": list(starts), "shape": list(data.shape)})
-            if entry["shards"]:
-                index[key] = entry
-        elif proc == 0:
-            index[key] = {"value": _to_jsonable(leaf)}
-    np.savez(os.path.join(directory, f"shards_{proc}.npz"), **shard_data)
-    with open(os.path.join(directory, f"index_{proc}.json"), "w") as f:
-        json.dump(index, f)
 
 
 def load_state(
@@ -526,7 +519,7 @@ def load_state(
     (reference `load_state`, `accelerator.py:3272`)."""
     wait_for_checkpoint()
     target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
-    restored = load_pytree(target, os.path.join(input_dir, MODEL_DIR), mesh=accelerator.mesh)
+    restored = load_pytree(target, os.path.join(input_dir, MODEL_DIR))
 
     rng_path = os.path.join(input_dir, RNG_FILE.format(proc=jax.process_index()))
     if not os.path.exists(rng_path):
@@ -565,6 +558,8 @@ def save_model(
     `accelerator.py:2963`). Sharded layout, optionally merged to one file."""
     model_dir = os.path.join(output_dir, "model")
     save_pytree(params, model_dir)
+    # Every host must finish writing its shard files before the merge reads.
+    accelerator.process_state.wait_for_everyone()
     if consolidate and jax.process_index() == 0:
         return consolidate_checkpoint(model_dir, os.path.join(output_dir, "model.npz"))
     return model_dir

@@ -217,11 +217,14 @@ def loss_fn(
     labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
     if labels is None:
+        # Run the forward at full S and drop the last logit instead of
+        # slicing the tokens: keeps the sequence length at its (power-of-two,
+        # block-aligned) value so matmul tiling and the flash kernel's block
+        # path are preserved; one wasted position is noise.
         labels = tokens[:, 1:]
-        tokens = tokens[:, :-1]
         loss_mask = attn_mask[:, 1:] if attn_mask is not None else None
-        attn_mask = attn_mask[:, :-1] if attn_mask is not None else None
+        logits = forward(params, tokens, config, mask=attn_mask)[:, :-1]
     else:
         loss_mask = attn_mask
-    logits = forward(params, tokens, config, mask=attn_mask)
+        logits = forward(params, tokens, config, mask=attn_mask)
     return cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)

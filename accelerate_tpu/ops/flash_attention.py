@@ -1,0 +1,318 @@
+"""Fused flash attention (Pallas, TPU).
+
+The reference has no attention kernels at all — fused attention arrives via
+torch SDPA / Megatron CUDA kernels (SURVEY.md §2.2: "fused softmax" listed as
+a native dependency to replace). Here it is a first-class TPU kernel:
+
+- forward: online-softmax over KV blocks, O(S) memory (never materializes the
+  S×S score matrix), fp32 accumulation, saves per-row logsumexp;
+- backward: custom VJP with two Pallas kernels (dq over KV blocks, dk/dv over
+  Q blocks) using the saved logsumexp + delta trick;
+- GQA: query heads map onto kv heads via index maps (no kv replication in
+  HBM); backward folds group gradients outside the kernel;
+- causal masking by block skipping (upper-triangle blocks never touched).
+
+Layouts follow the framework convention (B, S, H, h); kernels run in
+(B, H, S, h). Falls back to the XLA reference implementation
+(`models/layers.py:dot_product_attention`) for shapes the kernel does not
+support (tiny S, explicit padding masks) so callers can use one entry point.
+Runs in interpreter mode automatically on CPU (tests/CI).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+# 512 empirically: ~3-7x faster than 128 on v5e at S=2048 (loop/semaphore
+# overhead amortizes; s-matrix VMEM stays well under budget at (512, 512) f32).
+DEFAULT_BLOCK = 512
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, h)
+    bq = q.shape[0]
+    head_dim = q.shape[1]
+    q_start = qi * bq
+    n_blocks = seq_len // block
+    # Causal: KV blocks strictly above the diagonal contribute nothing.
+    hi = jnp.minimum((q_start + bq + block - 1) // block, n_blocks) if causal else n_blocks
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)  # (bk, h)
+        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        elif valid < seq_len:
+            cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols < valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)  # (bq, 1)
+
+
+def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
+    B, H, S, h = q.shape
+    K = k.shape[1]
+    group = H // K
+    grid = (B, H, S // block)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // group, 0, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // group, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi: (b, hh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------------------------ backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # (bq, 1)
+    delta = delta_ref[0, 0]
+    bq, head_dim = q.shape
+    q_start = qi * bq
+    n_blocks = seq_len // block
+    hi = jnp.minimum((q_start + bq + block - 1) // block, n_blocks) if causal else n_blocks
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        elif valid < seq_len:
+            cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols < valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, head_dim), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid):
+    j = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # (bk, h)
+    v = v_ref[0, 0].astype(jnp.float32)
+    bk, head_dim = k.shape
+    k_start = j * bk
+    n_blocks = seq_len // block
+    lo = (k_start // block) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block, block), :]  # (bq, 1)
+        delta = delta_ref[0, 0, pl.ds(i * block, block), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            rows = i * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        elif valid < seq_len:
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols < valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    init = (
+        jnp.zeros((bk, head_dim), jnp.float32),
+        jnp.zeros((bk, head_dim), jnp.float32),
+    )
+    dk, dv = jax.lax.fori_loop(lo, n_blocks, body, init)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, block, causal, interpret, valid, residuals, g):
+    q, k, v, o, lse = residuals
+    B, H, S, h = q.shape
+    K = k.shape[1]
+    group = H // K
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+
+    grid = (B, H, S // block)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // group, 0, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // group, 0, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi: (b, hh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    grid_kv = (B, H, S // block)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        grid=grid_kv,
+        in_specs=[
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, j: (b, hh, 0, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, j: (b, hh // group, j, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, j: (b, hh // group, j, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, j: (b, hh, 0, 0)),
+            pl.BlockSpec((1, 1, S, 1), lambda b, hh, j: (b, hh, 0, 0)),
+            pl.BlockSpec((1, 1, S, 1), lambda b, hh, j: (b, hh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, j: (b, hh, j, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, j: (b, hh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        # Fold query-head-group gradients onto the shared kv heads.
+        dk = dk_h.reshape(B, K, group, S, h).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(B, K, group, S, h).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- entry point
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, block, causal, interpret, valid):
+    o, _ = _fwd(q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, block, causal, interpret, valid):
+    o, lse = _fwd(q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_mask: jax.Array | None = None,
+    block_size: int = DEFAULT_BLOCK,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused attention over (B, S, H, h) queries and (B, T, K, h) kv (GQA).
+
+    Falls back to the XLA reference path when the shape is out of kernel
+    territory (S not a multiple of the block, or an explicit padding mask —
+    packed/padded batches route through the oracle until the kernel grows
+    segment-id support)."""
+    B, S, H, h = q.shape
+    T, K = k.shape[1], k.shape[2]
+    if H % K != 0:
+        raise ValueError(f"num_heads {H} not divisible by num_kv_heads {K}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(h)
+    if segment_mask is not None or S != T or S < 16:
+        from ..models.layers import dot_product_attention
+
+        return dot_product_attention(q, k, v, mask=segment_mask, causal=causal, scale=scale)
+    interpret = _interpret_default() if interpret is None else interpret
+    block = min(block_size, _round_up(S, 128) if S < block_size else block_size)
+    # Pad S up to a block multiple (e.g. the ubiquitous S-1 from next-token
+    # shifting). Padded KV columns sit at positions >= S: under causal they
+    # are masked for every real row by construction; non-causal kernels mask
+    # cols >= valid explicitly. Padded Q rows are sliced away.
+    padded = _round_up(S, block)
+    if padded != S:
+        pad = [(0, 0), (0, padded - S), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    # kernels run in (B, H, S, h)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _flash(qt, kt, vt, scale, block, causal, interpret, S)
+    o = o.transpose(0, 2, 1, 3)
+    return o[:, :S] if padded != S else o
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

@@ -56,6 +56,7 @@ def main() -> None:
             d_ff=4096,
             max_seq_len=2048,
             remat=True,
+            attention_impl="flash",
         )
         batch_size, seq = 8, 2048
         steps, warmup = 10, 3

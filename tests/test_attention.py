@@ -1,0 +1,111 @@
+"""Attention kernel tests: flash (Pallas, interpret mode on CPU) and ring
+(shard_map over the sequence axis) against the XLA oracle
+(`models/layers.py:dot_product_attention`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import MeshConfig
+from accelerate_tpu.models.layers import dot_product_attention
+from accelerate_tpu.ops.flash_attention import flash_attention
+from accelerate_tpu.ops.ring_attention import ring_attention
+from accelerate_tpu.parallel.mesh import build_mesh
+
+
+def _qkv(rng, B=2, S=128, H=4, K=2, h=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, h), dtype)
+    k = jax.random.normal(kk, (B, S, K, h), dtype)
+    v = jax.random.normal(kv, (B, S, K, h), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_oracle(self, causal):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        expected = dot_product_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_size=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_mha_no_gqa(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1), H=4, K=4)
+        expected = dot_product_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_size=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_oracle(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2), B=1, S=64, H=4, K=2, h=16)
+        w = jax.random.normal(jax.random.PRNGKey(3), q.shape)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, block_size=32, interpret=True) * w)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) * w)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4, err_msg=f"d{name}"
+            )
+
+    def test_mask_falls_back_to_oracle(self):
+        q, k, v = _qkv(jax.random.PRNGKey(4), S=32)
+        mask = jnp.ones((2, 32), jnp.int32).at[:, 20:].set(0)
+        out = flash_attention(q, k, v, causal=True, segment_mask=mask, interpret=True)
+        expected = dot_product_attention(q, k, v, mask=mask, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-6)
+
+    def test_odd_length_falls_back(self):
+        q, k, v = _qkv(jax.random.PRNGKey(5), S=100)
+        out = flash_attention(q, k, v, causal=True, block_size=64, interpret=True)
+        expected = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-6)
+
+    def test_bf16_inputs(self):
+        q, k, v = _qkv(jax.random.PRNGKey(6), dtype=jnp.bfloat16)
+        expected = dot_product_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_size=64, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(expected, np.float32), atol=2e-2, rtol=2e-2
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("seq_shards", [2, 4, 8])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, seq_shards, causal):
+        mesh = build_mesh(MeshConfig(data=-1, sequence=seq_shards))
+        q, k, v = _qkv(jax.random.PRNGKey(7), B=2, S=64, H=4, K=2, h=16)
+        expected = dot_product_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, causal=causal, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_inside_jit(self):
+        mesh = build_mesh(MeshConfig(data=1, sequence=8))
+        q, k, v = _qkv(jax.random.PRNGKey(8), B=1, S=64, H=4, K=4, h=16)
+        expected = dot_product_attention(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=True, mesh=mesh))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_differentiable(self):
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        q, k, v = _qkv(jax.random.PRNGKey(9), B=1, S=32, H=2, K=2, h=16)
+        w = jax.random.normal(jax.random.PRNGKey(10), q.shape)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, causal=True, mesh=mesh) * w)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) * w)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gr, ge, name in zip(g_ring, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(ge), atol=5e-4, rtol=5e-4, err_msg=f"d{name}"
+            )
