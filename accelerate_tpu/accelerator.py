@@ -101,7 +101,7 @@ class Accelerator:
     def __init__(
         self,
         *,
-        mixed_precision: str = "no",
+        mixed_precision: str | None = None,  # None -> ATX_MIXED_PRECISION env or "no"
         gradient_accumulation_steps: int = 1,
         gradient_accumulation_plugin: GradientAccumulationPlugin | None = None,
         mesh_config: MeshConfig | None = None,
@@ -122,7 +122,14 @@ class Accelerator:
                 num_steps=gradient_accumulation_steps if gradient_accumulation_steps > 1 else None
             )
         self.gradient_state = GradientState(gradient_accumulation_plugin.num_steps)
-        self.policy = MixedPrecisionPolicy.from_precision(mixed_precision)
+        self.policy = MixedPrecisionPolicy.from_precision(self.state.mixed_precision)
+        if strategy is None:
+            # Launcher env contract (ATX_SHARDING_STRATEGY) fallback.
+            import os
+
+            strategy = os.environ.get("ATX_SHARDING_STRATEGY") or None
+            if strategy in ("DATA_PARALLEL",):
+                strategy = None  # the default; avoid requiring rules
         self.strategy = ShardingStrategy.resolve(strategy, rules=tuple(sharding_rules))
         self.max_grad_norm = max_grad_norm
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()

@@ -1,0 +1,139 @@
+"""`accelerate-tpu estimate` — shape-only HBM memory calculator.
+
+Analog of `accelerate estimate-memory` (reference `commands/estimate.py`:
+meta-device model load :64, ≈4x-for-Adam training estimate :218, per-dtype
+table :253). Here the calculation is exact for the framework's model zoo via
+`jax.eval_shape` — no weights are ever materialized — and it understands
+sharding: pass a mesh factorization to see per-chip footprints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+from typing import Any
+
+_MODEL_PRESETS = {
+    "llama-tiny": ("llama", "tiny"),
+    "llama3-8b": ("llama", "llama3_8b"),
+    "llama3-70b": ("llama", "llama3_70b"),
+    "bert-base": ("bert", "bert_base"),
+    "bert-tiny": ("bert", "tiny"),
+}
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "estimate", help="Estimate HBM usage for a model family preset"
+    )
+    p.add_argument("model", choices=sorted(_MODEL_PRESETS), help="Model preset")
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--seq_len", type=int, default=2048)
+    p.add_argument("--precision", default="bf16", choices=["no", "bf16", "fp16"])
+    p.add_argument(
+        "--optimizer", default="adamw", choices=["adamw", "adam", "sgd", "adafactor"]
+    )
+    p.add_argument("--shards", type=int, default=1, help="FSDP/ZeRO shard count")
+    p.add_argument(
+        "--remat", action="store_true", help="Assume full activation rematerialization"
+    )
+    p.add_argument(
+        "--hbm_gb", type=float, default=16.0, help="Per-chip HBM (v5e=16, v4=32, v5p=95)"
+    )
+    p.set_defaults(func=run)
+
+
+def _human(n_bytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n_bytes) < 1024:
+            return f"{n_bytes:.2f} {unit}"
+        n_bytes /= 1024
+    return f"{n_bytes:.2f} PB"
+
+
+def estimate(model: str, batch_size: int, seq_len: int, precision: str,
+             optimizer: str, shards: int, remat: bool) -> dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from .. import models
+
+    family, preset = _MODEL_PRESETS[model]
+    module = getattr(models, family)
+    config = getattr(module.__dict__[f"{family.capitalize()}Config"], preset)()
+
+    # Exact parameter count via abstract evaluation — nothing materializes.
+    shapes = jax.eval_shape(lambda rng: module.init(rng, config), jax.random.PRNGKey(0))
+    n_params = sum(int(math.prod(x.shape)) for x in jax.tree.leaves(shapes))
+
+    compute_bytes = 2 if precision in ("bf16", "fp16") else 4
+    master_bytes = 4  # fp32 master params
+    moments = {"adamw": 2, "adam": 2, "sgd": 0, "adafactor": 1}[optimizer]
+
+    params_b = n_params * master_bytes / shards
+    compute_copy_b = n_params * compute_bytes / shards if precision != "no" else 0
+    grads_b = n_params * 4 / shards
+    opt_b = n_params * 4 * moments / shards
+
+    d_model = config.d_model
+    n_layers = config.n_layers
+    per_layer_act = batch_size * seq_len * d_model * compute_bytes
+    if remat:
+        # One residual stream per layer boundary + current-layer working set.
+        act_b = per_layer_act * (n_layers + 8)
+    else:
+        # ~8 saved tensors per block (attn+mlp intermediates incl. d_ff).
+        ff_ratio = getattr(config, "d_ff", 4 * d_model) / d_model
+        act_b = per_layer_act * n_layers * (6 + 2 * ff_ratio)
+    vocab = getattr(config, "vocab_size", 0)
+    logits_b = batch_size * seq_len * vocab * 4 if vocab else 0
+
+    total = params_b + compute_copy_b + grads_b + opt_b + act_b + logits_b
+    return {
+        "config": config,
+        "n_params": n_params,
+        "params": params_b,
+        "compute_copy": compute_copy_b,
+        "grads": grads_b,
+        "optimizer": opt_b,
+        "activations": act_b,
+        "logits": logits_b,
+        "total": total,
+        "inference_total": n_params * compute_bytes / shards
+        + per_layer_act * 4
+        + logits_b / 2,
+    }
+
+
+def run(args: argparse.Namespace) -> int:
+    r = estimate(
+        args.model, args.batch_size, args.seq_len, args.precision,
+        args.optimizer, args.shards, args.remat,
+    )
+    print(f"Model: {args.model}  ({r['n_params']:,} params)")
+    print(f"Assumptions: batch={args.batch_size} seq={args.seq_len} "
+          f"precision={args.precision} optimizer={args.optimizer} "
+          f"shards={args.shards} remat={args.remat}")
+    print()
+    rows = [
+        ("fp32 master params", r["params"]),
+        (f"{args.precision} compute copy", r["compute_copy"]),
+        ("gradients (fp32)", r["grads"]),
+        ("optimizer moments", r["optimizer"]),
+        ("activations", r["activations"]),
+        ("logits + loss (fp32)", r["logits"]),
+    ]
+    width = max(len(n) for n, _ in rows)
+    for name, val in rows:
+        print(f"  {name:<{width}}  {_human(val):>12}")
+    print(f"  {'-' * width}  {'-' * 12}")
+    print(f"  {'training total/chip':<{width}}  {_human(r['total']):>12}")
+    print(f"  {'inference total/chip':<{width}}  {_human(r['inference_total']):>12}")
+    hbm = args.hbm_gb * 1024**3
+    verdict = "FITS" if r["total"] <= hbm * 0.9 else "DOES NOT FIT"
+    print(f"\n{verdict} in {args.hbm_gb:g} GB HBM "
+          f"({100 * r['total'] / hbm:.0f}% of chip)")
+    if r["total"] > hbm * 0.9 and args.shards == 1:
+        need = math.ceil(r["total"] / (hbm * 0.7))
+        print(f"Hint: try --shards {need} (FSDP) or gradient accumulation with a smaller batch.")
+    return 0

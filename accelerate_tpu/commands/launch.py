@@ -1,0 +1,215 @@
+"""`accelerate-tpu launch` — spawn training processes with the env contract.
+
+Analog of the reference launcher (`commands/launch.py:142-1194`). Key shift
+(SURVEY.md §7): one process **per host**, not per device — JAX SPMD drives all
+local chips from a single process, so the reference's elastic-agent / 1-proc-
+per-GPU machinery collapses into three modes:
+
+- single host: exec the script in-place with the ``ATX_*`` env contract;
+- local multi-process (CPU simulation & single-host multi-proc testing):
+  spawn N children with ``ATX_COORDINATOR_ADDRESS/ATX_NUM_PROCESSES/
+  ATX_PROCESS_ID`` — the `jax.distributed.initialize` rendezvous analog of
+  MASTER_ADDR/RANK/WORLD_SIZE (`utils/launch.py:98-470`);
+- TPU pod: run the same command on every pod worker over
+  ``gcloud compute tpus tpu-vm ssh --worker=all`` (reference
+  `tpu_pod_launcher`, `commands/launch.py:909-965`), where each worker
+  self-discovers rank via TPU metadata.
+
+Env contract consumed by the library (`state.py`, `utils/dataclasses.py`):
+ATX_COORDINATOR_ADDRESS, ATX_NUM_PROCESSES, ATX_PROCESS_ID, ATX_MULTIHOST,
+ATX_MIXED_PRECISION, ATX_SHARDING_STRATEGY, ATX_MESH_{DATA,FSDP,TENSOR,
+SEQUENCE,EXPERT}, ATX_GRADIENT_ACCUMULATION_STEPS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+
+from .config import LaunchConfig, load_default_config
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "launch", help="Launch a training script on this host / a pod"
+    )
+    p.add_argument("--config_file", default=None, help="Launch config file")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--coordinator_address", default=None, help="host:port of process 0")
+    p.add_argument("--coordinator_port", type=int, default=None)
+    p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
+    p.add_argument(
+        "--strategy",
+        default=None,
+        help="DATA_PARALLEL | ZERO1 | FSDP | TENSOR_PARALLEL | HYBRID",
+    )
+    p.add_argument("--data", type=int, default=None, help="mesh data axis size")
+    p.add_argument("--fsdp", type=int, default=None, help="mesh fsdp axis size")
+    p.add_argument("--tensor", type=int, default=None, help="mesh tensor axis size")
+    p.add_argument("--sequence", type=int, default=None, help="mesh sequence axis size")
+    p.add_argument("--expert", type=int, default=None, help="mesh expert axis size")
+    p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    p.add_argument("--tpu_name", default=None, help="GCE TPU name (pod launch)")
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--tpu_project", default=None)
+    p.add_argument(
+        "--host_devices",
+        type=int,
+        default=None,
+        help="Simulate N CPU devices per process (sets "
+        "--xla_force_host_platform_device_count; testing without TPUs)",
+    )
+    p.add_argument("--dry_run", action="store_true", help="Print commands, don't run")
+    p.add_argument("script", help="Training script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER, help="Script arguments")
+    p.set_defaults(func=run)
+
+
+def _merge_config(args: argparse.Namespace) -> LaunchConfig:
+    """CLI > config file > defaults (reference `_validate_launch_command`,
+    `commands/launch.py:988-1167`)."""
+    if args.config_file:
+        cfg = LaunchConfig.load(args.config_file)
+    else:
+        cfg = load_default_config() or LaunchConfig()
+    overrides = {
+        "num_processes": args.num_processes,
+        "coordinator_address": args.coordinator_address,
+        "coordinator_port": args.coordinator_port,
+        "mixed_precision": args.mixed_precision,
+        "sharding_strategy": args.strategy,
+        "mesh_data": args.data,
+        "mesh_fsdp": args.fsdp,
+        "mesh_tensor": args.tensor,
+        "mesh_sequence": args.sequence,
+        "mesh_expert": args.expert,
+        "gradient_accumulation_steps": args.gradient_accumulation_steps,
+        "tpu_name": args.tpu_name,
+        "tpu_zone": args.tpu_zone,
+        "tpu_project": args.tpu_project,
+    }
+    for key, value in overrides.items():
+        if value is not None:
+            setattr(cfg, key, value)
+    return cfg
+
+
+def build_child_env(
+    cfg: LaunchConfig,
+    process_id: int | None = None,
+    *,
+    base: dict[str, str] | None = None,
+    host_devices: int | None = None,
+) -> dict[str, str]:
+    """The env contract a child process configures itself from."""
+    env = dict(os.environ if base is None else base)
+    env["ATX_MIXED_PRECISION"] = cfg.mixed_precision
+    env["ATX_SHARDING_STRATEGY"] = cfg.sharding_strategy
+    env["ATX_MESH_DATA"] = str(cfg.mesh_data)
+    env["ATX_MESH_FSDP"] = str(cfg.mesh_fsdp)
+    env["ATX_MESH_TENSOR"] = str(cfg.mesh_tensor)
+    env["ATX_MESH_SEQUENCE"] = str(cfg.mesh_sequence)
+    env["ATX_MESH_EXPERT"] = str(cfg.mesh_expert)
+    env["ATX_GRADIENT_ACCUMULATION_STEPS"] = str(cfg.gradient_accumulation_steps)
+    if cfg.num_processes > 1:
+        env["ATX_NUM_PROCESSES"] = str(cfg.num_processes)
+        if process_id is not None:
+            env["ATX_PROCESS_ID"] = str(process_id)
+        if cfg.coordinator_address:
+            env["ATX_COORDINATOR_ADDRESS"] = cfg.coordinator_address
+        else:
+            env["ATX_MULTIHOST"] = "1"  # TPU metadata autodetect
+    if host_devices:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={host_devices}".strip()
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+    env.update(cfg.extra_env)
+    return env
+
+
+def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
+    """Spawn num_processes children on this machine (rendezvous over
+    localhost) — the CPU-simulation / single-host-multi-proc path that the
+    reference covers with its gloo `debug_launcher` (`launchers.py:268`)."""
+    if not cfg.coordinator_address:
+        cfg.coordinator_address = f"127.0.0.1:{cfg.coordinator_port}"
+    procs: list[subprocess.Popen] = []
+    if args.dry_run:
+        for i in range(cfg.num_processes):
+            print(f"[proc {i}] {' '.join(shlex.quote(c) for c in cmd)}")
+        return 0
+    try:
+        for i in range(cfg.num_processes):
+            env = build_child_env(cfg, i, host_devices=args.host_devices)
+            procs.append(subprocess.Popen(cmd, env=env))
+        exit_code = 0
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    exit_code = ret
+                    # One worker died: tear the job down (the reference relies
+                    # on torch-elastic for this; here the launcher owns it).
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+            if procs:
+                time.sleep(0.2)
+        return exit_code
+    finally:
+        for p in procs:
+            p.kill()
+
+
+def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
+    """Run the training command on every pod worker via gcloud SSH
+    (reference `tpu_pod_launcher`, `commands/launch.py:909`)."""
+    env_exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in build_child_env(cfg, None, base={}).items()
+    )
+    remote = f"{env_exports} {' '.join(shlex.quote(c) for c in cmd)}"
+    gcloud = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        cfg.tpu_name,
+        f"--zone={cfg.tpu_zone}",
+        "--worker=all",
+        f"--command={remote}",
+    ]
+    if cfg.tpu_project:
+        gcloud.insert(5, f"--project={cfg.tpu_project}")
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in gcloud))
+        return 0
+    return subprocess.call(gcloud)
+
+
+def run(args: argparse.Namespace) -> int:
+    cfg = _merge_config(args)
+    cmd = [sys.executable, args.script, *args.script_args]
+
+    if cfg.tpu_name:
+        return _tpu_pod_launch(cfg, cmd, args)
+    if cfg.num_processes > 1:
+        return _local_multiprocess_launch(cfg, cmd, args)
+    # Single host process: exec in place with the env contract.
+    env = build_child_env(cfg, None, host_devices=args.host_devices)
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    os.environ.update(env)
+    os.execvpe(cmd[0], cmd, os.environ)
+    return 0  # pragma: no cover - execvpe does not return

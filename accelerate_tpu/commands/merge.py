@@ -1,0 +1,27 @@
+"""`accelerate-tpu merge` — consolidate a sharded checkpoint into one file.
+
+Analog of `accelerate merge-weights` (reference `commands/merge.py:26-61` →
+`merge_fsdp_weights`, `utils/fsdp_utils.py:247-329`). Works on any directory
+written by `save_pytree`/`save_state` (pass the ``train_state`` or ``model``
+subdirectory)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "merge", help="Merge a sharded checkpoint dir into a single .npz"
+    )
+    p.add_argument("checkpoint_dir", help="Directory containing shards_*.npz + index_*.json")
+    p.add_argument("output_path", help="Output .npz path")
+    p.set_defaults(func=run)
+
+
+def run(args: argparse.Namespace) -> int:
+    from ..checkpointing import consolidate_checkpoint
+
+    out = consolidate_checkpoint(args.checkpoint_dir, args.output_path)
+    print(f"Merged checkpoint written to {out}")
+    return 0

@@ -1,0 +1,40 @@
+"""`accelerate-tpu test` — run the bundled self-diagnostic under the current
+config (reference `commands/test.py:22-57`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "test", help="Run the bundled self-diagnostic script"
+    )
+    p.add_argument("--config_file", default=None)
+    p.add_argument(
+        "--host_devices",
+        type=int,
+        default=None,
+        help="Simulate N CPU devices (diagnostic without a TPU)",
+    )
+    p.set_defaults(func=run)
+
+
+def run(args: argparse.Namespace) -> int:
+    import accelerate_tpu.test_utils.diagnostic as diag
+
+    script = os.path.abspath(diag.__file__)
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.cli", "launch"]
+    if args.config_file:
+        cmd += ["--config_file", args.config_file]
+    if args.host_devices:
+        cmd += ["--host_devices", str(args.host_devices)]
+    cmd.append(script)
+    print(f"Running diagnostic: {' '.join(cmd)}")
+    result = subprocess.run(cmd)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return result.returncode

@@ -65,6 +65,24 @@ class MeshConfig:
     devices: Sequence[jax.Device] | None = None
     allow_split_physical_axes: bool = False
 
+    @classmethod
+    def from_env(cls) -> "MeshConfig | None":
+        """Mesh shape from the launcher env contract (``ATX_MESH_*``); None
+        when the launcher set nothing (reference pattern: plugins read
+        ``ACCELERATE_*`` in __post_init__, `utils/dataclasses.py:1123`)."""
+        import os
+
+        keys = ("DATA", "FSDP", "TENSOR", "SEQUENCE", "EXPERT")
+        values = {k: os.environ.get(f"ATX_MESH_{k}") for k in keys}
+        if all(v is None for v in values.values()):
+            return None
+        defaults = {"DATA": -1, "FSDP": 1, "TENSOR": 1, "SEQUENCE": 1, "EXPERT": 1}
+        resolved = {
+            k.lower(): int(v) if v is not None else defaults[k]
+            for k, v in values.items()
+        }
+        return cls(**resolved)
+
     def resolved_shape(self, n_devices: int) -> tuple[int, ...]:
         fixed = self.fsdp * self.tensor * self.sequence * self.expert
         data = self.data

@@ -293,7 +293,9 @@ class AcceleratorState:
         self.mixed_precision = mixed_precision or os.environ.get(
             "ATX_MIXED_PRECISION", "no"
         )
-        self._mesh_config = mesh_config
+        # Launcher env contract fallback (ATX_MESH_*), mirroring the reference
+        # plugins' ACCELERATE_* __post_init__ reads.
+        self._mesh_config = mesh_config if mesh_config is not None else MeshConfig.from_env()
         self._mesh: Mesh | None = None
         self._initialized = True
 

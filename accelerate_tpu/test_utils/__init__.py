@@ -1,0 +1,6 @@
+"""Shipped test utilities (reference `test_utils/`, 5,156 LoC: the bundled
+self-diagnostic + tiny fixtures pattern, SURVEY.md §2.6/§4)."""
+
+from .training import RegressionDataset, regression_init, regression_loss
+
+__all__ = ["RegressionDataset", "regression_init", "regression_loss"]

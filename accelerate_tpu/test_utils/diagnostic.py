@@ -1,0 +1,123 @@
+"""The bundled self-diagnostic driver script.
+
+Run by `accelerate-tpu test` (reference `commands/test.py:44` runs
+`test_utils/scripts/test_script.py`, 901 LoC). Exercises, under whatever
+topology the launcher configured: process init, collectives, dataloader
+sharding, the single-vs-distributed training-equivalence oracle (reference
+`training_check`, `test_utils/scripts/test_script.py:454`), and a checkpoint
+round trip. Exits non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def check(name: str, fn) -> bool:
+    try:
+        fn()
+    except Exception as e:  # noqa: BLE001 - diagnostic surface
+        print(f"  FAIL {name}: {type(e).__name__}: {e}")
+        return False
+    print(f"  ok   {name}")
+    return True
+
+
+def main() -> int:
+    import accelerate_tpu as atx
+    from accelerate_tpu.ops import collectives as ops
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_init,
+        regression_loss,
+    )
+
+    acc = atx.Accelerator()
+    acc.print(f"Diagnostic on {acc!r}")
+    acc.print(f"  devices={jax.device_count()} processes={acc.num_processes}")
+    results = []
+
+    def init_check():
+        assert acc.mesh.size == jax.device_count()
+        assert acc.process_index < acc.num_processes
+
+    results.append(check("initialization", init_check))
+
+    def collective_check():
+        x = jnp.full((4,), float(acc.process_index + 1))
+        g = ops.gather({"x": x})["x"]
+        assert g.shape[0] == 4 * max(acc.num_processes, 1)
+        r = ops.reduce({"x": x}, "sum")["x"]
+        assert np.allclose(np.asarray(r)[0], sum(range(1, acc.num_processes + 1)))
+
+    results.append(check("collectives (gather/reduce)", collective_check))
+
+    def dataloader_check():
+        data = RegressionDataset(64)
+        dl = acc.prepare_data_loader(data, batch_size=4, shuffle=True, seed=0)
+        batches = list(dl)
+        assert len(batches) == len(dl)
+        sizes = {int(b["x"].shape[0]) for b in batches}
+        assert sizes == {dl.total_batch_size}
+
+    results.append(check("dataloader sharding", dataloader_check))
+
+    def training_equivalence():
+        # Single-device oracle
+        tx = optax.sgd(0.05)
+        params0 = regression_init(jax.random.PRNGKey(0))
+        data = RegressionDataset(64)
+        xs = np.stack([d["x"] for d in data])
+        ys = np.stack([d["y"] for d in data])
+
+        def host_train(params):
+            for i in range(0, 64, 16):
+                batch = {"x": jnp.asarray(xs[i : i + 16]), "y": jnp.asarray(ys[i : i + 16])}
+                g = jax.grad(regression_loss)(params, batch)
+                params = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+            return params
+
+        expected = host_train(params0)
+
+        state = acc.create_train_state(regression_init, tx)
+        step = acc.make_train_step(regression_loss)
+        dl = acc.prepare_data_loader(data, batch_size=16 // max(acc.data_parallel_size, 1))
+        if 16 % max(acc.data_parallel_size, 1) != 0:
+            return  # topology cannot express the oracle batch; skip
+        for batch in dl:
+            state, _ = step(state, batch)
+        got = jax.device_get(state.params)
+        for key in ("a", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got[key]), np.asarray(expected[key]), atol=1e-4
+            )
+
+    results.append(check("training equivalence (distributed == single)", training_equivalence))
+
+    def checkpoint_round_trip():
+        state = acc.create_train_state(regression_init, optax.adam(1e-2))
+        with tempfile.TemporaryDirectory() as d:
+            acc.save_state(d, state)
+            restored = acc.load_state(d, state)
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(restored.params["a"])),
+                np.asarray(jax.device_get(state.params["a"])),
+            )
+
+    results.append(check("checkpoint round trip", checkpoint_round_trip))
+
+    if all(results):
+        acc.print("All diagnostics passed.")
+        return 0
+    acc.print(f"{results.count(False)} diagnostic(s) FAILED.")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Tiny fixtures for self-diagnostics and tests.
+
+Reference pattern: `test_utils/training.py:22-63` — a one-parameter
+`RegressionModel` + synthetic `RegressionDataset`; distributed correctness is
+asserted by training it under different topologies and comparing weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RegressionDataset:
+    """y = 2x + 1 with gaussian noise; sized + indexable."""
+
+    def __init__(self, length: int = 96, seed: int = 42) -> None:
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (2.0 * self.x + 1.0 + 0.05 * rng.normal(size=(length,))).astype(
+            np.float32
+        )
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_init(rng: jax.Array) -> dict[str, jax.Array]:
+    ka, kb = jax.random.split(rng)
+    return {
+        "a": jax.random.normal(ka, ()).astype(jnp.float32),
+        "b": jax.random.normal(kb, ()).astype(jnp.float32),
+    }
+
+
+def regression_loss(params: dict[str, jax.Array], batch: Any, rng: Any = None) -> jax.Array:
+    pred = params["a"] * batch["x"] + params["b"]
+    return jnp.mean(jnp.square(pred - batch["y"]))

@@ -1,0 +1,141 @@
+"""CLI tests (reference `tests/test_cli.py`, 545 LoC: runs the binaries)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.commands.cli import main as cli_main
+from accelerate_tpu.commands.config import LaunchConfig
+from accelerate_tpu.commands.launch import build_child_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestConfig:
+    def test_round_trip(self, tmp_path):
+        cfg = LaunchConfig(num_processes=4, mesh_fsdp=2, sharding_strategy="FSDP")
+        path = cfg.save(str(tmp_path / "cfg.yaml"))
+        loaded = LaunchConfig.load(path)
+        assert loaded == cfg
+
+    def test_default_flag_writes_file(self, tmp_path, capsys):
+        path = str(tmp_path / "cfg.yaml")
+        assert cli_main(["config", "--default", "--config_file", path]) == 0
+        assert os.path.exists(path)
+        assert LaunchConfig.load(path) == LaunchConfig()
+
+
+class TestLaunch:
+    def test_env_contract(self):
+        cfg = LaunchConfig(
+            num_processes=2,
+            coordinator_address="127.0.0.1:1234",
+            mesh_data=2,
+            mesh_fsdp=4,
+            mixed_precision="bf16",
+            sharding_strategy="FSDP",
+            gradient_accumulation_steps=3,
+        )
+        env = build_child_env(cfg, process_id=1, base={})
+        assert env["ATX_NUM_PROCESSES"] == "2"
+        assert env["ATX_PROCESS_ID"] == "1"
+        assert env["ATX_COORDINATOR_ADDRESS"] == "127.0.0.1:1234"
+        assert env["ATX_MESH_DATA"] == "2"
+        assert env["ATX_MESH_FSDP"] == "4"
+        assert env["ATX_MIXED_PRECISION"] == "bf16"
+        assert env["ATX_SHARDING_STRATEGY"] == "FSDP"
+        assert env["ATX_GRADIENT_ACCUMULATION_STEPS"] == "3"
+
+    def test_dry_run_single(self, capsys, tmp_path):
+        script = tmp_path / "t.py"
+        script.write_text("print('hi')")
+        assert cli_main(["launch", "--dry_run", str(script), "--flag"]) == 0
+        out = capsys.readouterr().out
+        assert str(script) in out and "--flag" in out
+
+    def test_dry_run_pod_assembles_gcloud(self, capsys, tmp_path):
+        script = tmp_path / "t.py"
+        script.write_text("")
+        assert (
+            cli_main(
+                [
+                    "launch", "--dry_run", "--tpu_name", "mypod", "--tpu_zone",
+                    "us-central2-b", "--num_processes", "4", str(script),
+                ]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "gcloud compute tpus tpu-vm ssh mypod" in out
+        assert "--worker=all" in out
+        assert "ATX_MULTIHOST=1" in out
+
+    def test_single_host_subprocess_env(self, tmp_path):
+        """Launch a real child that dumps its env contract."""
+        script = tmp_path / "dump.py"
+        script.write_text(
+            "import os, json; print(json.dumps({k: v for k, v in os.environ.items() if k.startswith('ATX_')}))"
+        )
+        result = subprocess.run(
+            [
+                sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+                "--mixed_precision", "fp16", "--strategy", "ZERO1",
+                "--data", "4", "--fsdp", "2", str(script),
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+            timeout=120,
+        )
+        assert result.returncode == 0, result.stderr
+        env = json.loads(result.stdout.strip().splitlines()[-1])
+        assert env["ATX_MIXED_PRECISION"] == "fp16"
+        assert env["ATX_SHARDING_STRATEGY"] == "ZERO1"
+        assert env["ATX_MESH_DATA"] == "4"
+        assert env["ATX_MESH_FSDP"] == "2"
+
+
+class TestEstimate:
+    def test_llama_tiny_fits(self, capsys):
+        assert cli_main(["estimate", "llama-tiny", "--batch_size", "2", "--seq_len", "64"]) == 0
+        out = capsys.readouterr().out
+        assert "FITS" in out and "params" in out
+
+    def test_llama_70b_does_not_fit_one_chip(self, capsys):
+        assert cli_main(["estimate", "llama3-70b"]) == 0
+        out = capsys.readouterr().out
+        assert "DOES NOT FIT" in out and "--shards" in out
+
+    def test_param_count_exact(self):
+        from accelerate_tpu.commands.estimate import estimate
+        from accelerate_tpu.models import llama
+
+        r = estimate("llama-tiny", 1, 64, "bf16", "adamw", 1, False)
+        assert r["n_params"] == llama.LlamaConfig.tiny().param_count()
+
+
+class TestMergeCommand:
+    def test_merge_cli(self, tmp_path):
+        import jax.numpy as jnp
+
+        from accelerate_tpu import checkpointing
+
+        d = str(tmp_path / "ck")
+        checkpointing.save_pytree({"w": jnp.arange(8.0)}, d)
+        out = str(tmp_path / "merged.npz")
+        assert cli_main(["merge", d, out]) == 0
+        data = np.load(out)
+        np.testing.assert_array_equal(data["w"], np.arange(8.0))
+
+
+class TestDiagnostic:
+    def test_diagnostic_passes_in_process(self):
+        """The bundled self-test must pass on the simulated 8-device mesh."""
+        from accelerate_tpu.test_utils import diagnostic
+
+        assert diagnostic.main() == 0
