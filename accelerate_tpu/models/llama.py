@@ -205,6 +205,72 @@ def forward(
     return jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
 
 
+# ---------------------------------------------------------------- KV cache
+def init_cache(
+    config: LlamaConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
+) -> dict[str, jax.Array]:
+    """Decode-time KV cache, layer-stacked to match the scan layout."""
+    shape = (config.n_layers, batch_size, max_len, config.num_kv_heads, config.resolved_head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jax.Array,
+    cache: dict[str, jax.Array],
+    config: LlamaConfig,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Incremental forward: append ``tokens`` (B, T_new) at ``cache['length']``
+    and attend against everything cached so far. Returns (logits, new_cache).
+
+    Serves both prefill (T_new = prompt length) and decode (T_new = 1); the
+    same jitted function handles either with static T_new.
+    """
+    B, T_new = tokens.shape
+    max_len = cache["k"].shape[2]
+    start = cache["length"]
+    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T_new))
+    cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+
+    # (B, T_new, max_len) attention mask: cached positions < start+1+i.
+    cache_pos = jnp.arange(max_len, dtype=jnp.int32)
+    mask = cache_pos[None, None, :] <= positions[:, :, None]
+
+    x = params["embed"][tokens]
+
+    def scan_body(carry, xs):
+        x = carry
+        block, k_cache, v_cache = xs
+        h = rms_norm(x, block["attn_norm"], config.norm_eps)
+        q, k, v = attention_qkv(block["attn"], h)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+        attn = dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
+        )
+        x = x + attention_out(block["attn"], attn)
+        h = rms_norm(x, block["mlp_norm"], config.norm_eps)
+        x = x + swiglu(block["mlp"], h)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    new_cache = {"k": new_k, "v": new_v, "length": start + T_new}
+    return logits, new_cache
+
+
 def loss_fn(
     params: Params,
     batch: dict[str, jax.Array],
