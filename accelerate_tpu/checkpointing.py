@@ -43,6 +43,8 @@ if TYPE_CHECKING:  # pragma: no cover
     from .accelerator import Accelerator, TrainState
 
 MODEL_DIR = "train_state"
+SHARDS_FILE = "shards_{proc}.npz"
+INDEX_FILE = "index_{proc}.json"
 RNG_FILE = "rng_state_{proc}.json"
 DATALOADER_FILE = "dataloaders.json"
 CUSTOM_FILE = "custom_checkpoint_{i}.pkl"
@@ -102,8 +104,8 @@ def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) 
         else:
             if proc == 0:
                 index[key] = {"value": _to_jsonable(leaf)}
-    np.savez(os.path.join(directory, f"shards_{proc}.npz"), **shard_data)
-    with open(os.path.join(directory, f"index_{proc}.json"), "w") as f:
+    np.savez(os.path.join(directory, SHARDS_FILE.format(proc=proc)), **shard_data)
+    with open(os.path.join(directory, INDEX_FILE.format(proc=proc)), "w") as f:
         json.dump(index, f)
 
 
@@ -286,6 +288,46 @@ def consolidate_checkpoint(directory: str, output_path: str) -> str:
     return output_path
 
 
+def _per_proc_pattern(template: str) -> str:
+    """Derive a cleanup regex from a ``*_{proc}`` filename template so the
+    writer and the stale-file cleaner can never drift apart."""
+    return re.escape(template).replace(re.escape("{proc}"), r"\d+")
+
+
+_SHARD_FILE_PATTERN = re.compile(
+    "^(" + "|".join(_per_proc_pattern(t) for t in (INDEX_FILE, SHARDS_FILE)) + ")$"
+)
+_STATE_FILE_PATTERN = re.compile(
+    "^("
+    + "|".join(
+        [_per_proc_pattern(RNG_FILE), re.escape(CUSTOM_FILE).replace(re.escape("{i}"), r"\d+")]
+    )
+    + ")$"
+)
+
+
+def _clear_stale_files(directory: str, pattern: re.Pattern) -> None:
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            if pattern.match(name):
+                os.remove(os.path.join(directory, name))
+
+
+def _clear_stale_shard_files(directory: str, process_state: Any | None = None) -> None:
+    """Remove shard/index files left by a previous save into ``directory``.
+
+    Without this, re-saving after the process count shrinks (the advertised
+    reshard workflow: save on 2 hosts, later on 1) would leave index_1.json /
+    shards_1.npz behind, and the reader — which merges ALL index files — would
+    silently mix old weights into the loaded state. Process 0 clears; everyone
+    barriers before writing.
+    """
+    if jax.process_index() == 0:
+        _clear_stale_files(directory, _SHARD_FILE_PATTERN)
+    if process_state is not None and jax.process_count() > 1:
+        process_state.wait_for_everyone()
+
+
 # ------------------------------------------------------------------- RNG state
 def _rng_state_bundle(accelerator: "Accelerator") -> dict[str, Any]:
     return {
@@ -431,8 +473,13 @@ def save_state(
     `accelerator.py:3106`): TrainState pytree (sharded), RNG bundle, step,
     dataloader iterator states, registered custom objects."""
     # Join any in-flight async save first: rotation must never delete a
-    # directory a background writer is still filling.
+    # directory a background writer is still filling. The local join is not
+    # enough on multi-host — process 0 must not rmtree an old checkpoint while
+    # ANOTHER host's previous async writer is still filling it — so barrier
+    # after every host has joined its own writer.
     wait_for_checkpoint()
+    if jax.process_count() > 1:
+        accelerator.process_state.wait_for_everyone()
     proc = jax.process_index()
     if proc == 0:
         save_dir = _resolve_save_dir(accelerator, output_dir)
@@ -445,6 +492,12 @@ def save_state(
 
         save_dir = broadcast_object_list([save_dir])[0]
     os.makedirs(save_dir, exist_ok=True)
+    # Same shrink-hosts staleness applies to per-process RNG files and
+    # per-index custom-object pickles: a 2-host save followed by a 1-host
+    # re-save must not leave rng_state_1.json for a later 2-host load.
+    if proc == 0:
+        _clear_stale_files(save_dir, _STATE_FILE_PATTERN)
+    _clear_stale_shard_files(os.path.join(save_dir, MODEL_DIR), accelerator.process_state)
 
     saveable = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
 
@@ -557,6 +610,7 @@ def save_model(
     """Inference checkpoint of params only (reference `save_model`,
     `accelerator.py:2963`). Sharded layout, optionally merged to one file."""
     model_dir = os.path.join(output_dir, "model")
+    _clear_stale_shard_files(model_dir, accelerator.process_state)
     save_pytree(params, model_dir)
     # Every host must finish writing its shard files before the merge reads.
     accelerator.process_state.wait_for_everyone()

@@ -181,6 +181,36 @@ class TestSaveLoadState:
         assert first.min() == 24  # resumes at sample index 3*8
 
 
+class TestStaleShardCleanup:
+    def test_resave_with_fewer_processes_drops_stale_shards(self, tmp_path):
+        """Re-saving into the same directory after the process count shrinks
+        must not leave a previous save's index_1/shards_1 files to be merged
+        into the loaded state."""
+        config = llama.LlamaConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        state = _tiny_state(acc, config)
+        d = str(tmp_path / "ck")
+        acc.save_state(d, state)
+
+        # Forge a stale second-process shard pair from a "previous" 2-host save
+        # whose weights differ from the current state.
+        model_dir = os.path.join(d, checkpointing.MODEL_DIR)
+        stale = {"params": jax.tree.map(lambda x: jnp.zeros_like(x) - 1.0, state.params)}
+        checkpointing.save_pytree(stale, str(tmp_path / "stale"), process_index=1)
+        for name in ("index_1.json", "shards_1.npz"):
+            os.replace(str(tmp_path / "stale" / name), os.path.join(model_dir, name))
+        with open(os.path.join(d, "rng_state_1.json"), "w") as f:
+            f.write("{}")
+
+        expected = jax.device_get(state.params)
+        acc.save_state(d, state)
+        assert not os.path.exists(os.path.join(model_dir, "index_1.json"))
+        assert not os.path.exists(os.path.join(model_dir, "shards_1.npz"))
+        assert not os.path.exists(os.path.join(d, "rng_state_1.json"))
+        restored = acc.load_state(d, state)
+        _assert_trees_equal(jax.device_get(restored.params), expected)
+
+
 class TestRotation:
     def test_automatic_naming_and_total_limit(self, tmp_path):
         from accelerate_tpu.utils.dataclasses import ProjectConfiguration
