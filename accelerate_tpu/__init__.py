@@ -21,6 +21,7 @@ from .utils import (
     FsdpPlugin,
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
+    ProfileKwargs,
     ProjectConfiguration,
     ShardingStrategyType,
     TensorParallelPlugin,

@@ -529,6 +529,22 @@ class Accelerator:
 
         return checkpointing.load_state(self, input_dir, state, **kwargs)
 
+    # -------------------------------------------------------------- profiling
+    def profile(self, profile_kwargs: Any = None):
+        """Capture a `jax.profiler` trace of the enclosed block (reference
+        `accelerator.profile()`, `accelerator.py:3614`). Trace files land in
+        ``profile_kwargs.output_trace_dir`` or ``<logging_dir>/atx_profile``;
+        open the directory with TensorBoard to see the device timeline.
+
+        Run warmup steps before entering — compilation inside the context
+        dominates the timeline otherwise.
+        """
+        from .utils import profiler as _profiler
+
+        return _profiler.profile(
+            profile_kwargs, logging_dir=self.project_config.logging_dir
+        )
+
     # ---------------------------------------------------------------- misc
     def autocast(self):
         """Context manager kept for API parity (reference `autocast`,

@@ -60,6 +60,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = False
+    # What the checkpointed block may keep instead of recomputing:
+    # "nothing" = full recompute (lowest memory); "dots" = keep every matmul
+    # output (backward at ~2x forward FLOPs but O(10GB) of residuals at
+    # bench scale); "block_outputs" = keep only the two residual-branch
+    # outputs per layer (attention out-proj + FFN down-proj) — the best
+    # recompute-FLOPs-avoided per byte (those are the highest-arithmetic-
+    # intensity matmuls) at ~64MB/layer for the bench shape.
+    remat_policy: str = "block_outputs"
     attention_impl: str = "dot"  # "dot" | "flash" | "ring"
     z_loss: float = 0.0
 
@@ -136,6 +144,30 @@ def init(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
     return params
 
 
+def _remat_policy(name: str):
+    """Resolve a remat policy name to a `jax.checkpoint` policy."""
+    if name == "nothing":
+        return None  # jax.checkpoint default: save nothing, recompute all
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "block_outputs":
+        return jax.checkpoint_policies.save_only_these_names("attn_out", "ffn_out")
+    if name == "attn_and_outputs":
+        # Additionally keep the rotated q/k/v so the backward skips the qkv
+        # projections + rope recompute. The flash forward kernel itself still
+        # re-runs (its lse residual is internal to the custom_vjp and can't be
+        # kept by a name policy), so this trades ~64MB/layer for only the qkv
+        # recompute — measured neutral at bench scale; useful when qkv is a
+        # larger fraction (big d_model, short S).
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "ffn_out", "q_rope", "k_rope", "v_proj"
+        )
+    raise ValueError(
+        f"Unknown remat_policy {name!r}; expected 'nothing', 'dots', "
+        "'block_outputs', or 'attn_and_outputs'"
+    )
+
+
 def _attention(config: LlamaConfig, q, k, v, mask):
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
@@ -162,14 +194,17 @@ def block_forward(
     positions: jax.Array,
     mask: jax.Array | None,
 ) -> jax.Array:
+    from jax.ad_checkpoint import checkpoint_name
+
     h = rms_norm(x, block["attn_norm"], config.norm_eps)
     q, k, v = attention_qkv(block["attn"], h)
-    q = apply_rope(q, cos, sin, positions)
-    k = apply_rope(k, cos, sin, positions)
+    q = checkpoint_name(apply_rope(q, cos, sin, positions), "q_rope")
+    k = checkpoint_name(apply_rope(k, cos, sin, positions), "k_rope")
+    v = checkpoint_name(v, "v_proj")
     attn = _attention(config, q, k, v, mask)
-    x = x + attention_out(block["attn"], attn)
+    x = x + checkpoint_name(attention_out(block["attn"], attn), "attn_out")
     h = rms_norm(x, block["mlp_norm"], config.norm_eps)
-    x = x + swiglu(block["mlp"], h)
+    x = x + checkpoint_name(swiglu(block["mlp"], h), "ffn_out")
     return x
 
 
@@ -194,7 +229,7 @@ def forward(
         block_forward, config=config, cos=cos, sin=sin, positions=positions, mask=mask
     )
     if config.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(config.remat_policy))
 
     def scan_body(carry, block):
         return body(block, carry), None

@@ -41,7 +41,10 @@ def _interpret_default() -> bool:
 # ------------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, h)
+    # Keep matmul operands in their native (bf16) dtype: the MXU runs bf16 x
+    # bf16 -> f32 at full rate, while f32 x f32 passes take a multiple of the
+    # time. Accumulation stays f32 via preferred_element_type.
+    q = q_ref[0, 0]  # (bq, h)
     bq = q.shape[0]
     head_dim = q.shape[1]
     q_start = qi * bq
@@ -51,11 +54,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, se
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)  # (bk, h)
-        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        k = k_ref[0, 0, pl.ds(j * block, block), :]  # (bk, h)
+        v = v_ref[0, 0, pl.ds(j * block, block), :]
+        s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
+        )  # (bq, bk) f32
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -67,8 +70,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, se
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p is cast to the kv dtype for the MXU (standard flash practice;
+        # p in [0,1] so bf16's relative precision is adequate).
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return m_new, l, acc
 
@@ -113,8 +118,8 @@ def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
 # ------------------------------------------------------------------ backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0]  # (bq, 1)
     delta = delta_ref[0, 0]
     bq, head_dim = q.shape
@@ -123,8 +128,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale
     hi = jnp.minimum((q_start + bq + block - 1) // block, n_blocks) if causal else n_blocks
 
     def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block, block), :]
+        v = v_ref[0, 0, pl.ds(j * block, block), :]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -139,7 +144,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         return dq + scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -150,8 +155,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid):
     j = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)  # (bk, h)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]  # (bk, h)
+    v = v_ref[0, 0]
     bk, head_dim = k.shape
     k_start = j * bk
     n_blocks = seq_len // block
@@ -159,8 +164,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(i * block, block), :]
+        do = do_ref[0, 0, pl.ds(i * block, block), :]
         lse = lse_ref[0, 0, pl.ds(i * block, block), :]  # (bq, 1)
         delta = delta_ref[0, 0, pl.ds(i * block, block), :]
         s = scale * jax.lax.dot_general(
@@ -173,14 +178,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         elif valid < seq_len:
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(cols < valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bk)
+        p = jnp.exp(s - lse)  # (bq, bk) f32
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -19,6 +19,13 @@ from .environment import (
     purge_framework_environment,
     str_to_bool,
 )
+from .profiler import (
+    ProfileKwargs,
+    annotate,
+    estimate_step_flops,
+    save_memory_profile,
+    step_annotation,
+)
 from .random import (
     key_for_process,
     key_for_step,

@@ -47,15 +47,20 @@ def main() -> None:
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu" or "TPU" in getattr(device, "device_kind", "")
     if on_tpu:
+        # head_dim 128 (not 64): the MXU contracts 128 lanes per pass, so
+        # h=64 attention dots run at half utilization — measured 37 vs 65
+        # TF/s on v5e for the same FLOPs. Param count is unchanged.
         config = llama.LlamaConfig(
             vocab_size=32000,
             d_model=1024,
             n_layers=24,
-            num_heads=16,
-            num_kv_heads=8,
+            num_heads=8,
+            num_kv_heads=4,
+            head_dim=128,
             d_ff=4096,
             max_seq_len=2048,
             remat=True,
+            remat_policy="block_outputs",
             attention_impl="flash",
         )
         batch_size, seq = 8, 2048

@@ -1,0 +1,57 @@
+"""Profiler subsystem tests (reference `ProfileKwargs` /
+`accelerator.profile()`, `accelerator.py:3614`)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu import Accelerator, MeshConfig, ProfileKwargs
+from accelerate_tpu.utils import profiler
+
+
+class TestProfile:
+    def test_trace_files_written(self, tmp_path):
+        acc = Accelerator(mesh_config=MeshConfig())
+        trace_dir = str(tmp_path / "traces")
+        seen = []
+        kwargs = ProfileKwargs(
+            output_trace_dir=trace_dir, on_trace_ready=lambda d: seen.append(d)
+        )
+        f = jax.jit(lambda x: jnp.sum(x * x))
+        f(jnp.ones((128, 128))).block_until_ready()  # compile outside the trace
+        with acc.profile(kwargs):
+            with profiler.step_annotation(0):
+                f(jnp.ones((128, 128))).block_until_ready()
+        assert seen == [trace_dir]
+        xplane = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+        assert xplane, f"no xplane trace written under {trace_dir}"
+
+    def test_default_dir_under_logging_dir(self, tmp_path):
+        from accelerate_tpu import ProjectConfiguration
+
+        acc = Accelerator(
+            mesh_config=MeshConfig(),
+            project_config=ProjectConfiguration(project_dir=str(tmp_path)),
+        )
+        with acc.profile():
+            jnp.sum(jnp.ones((8, 8))).block_until_ready()
+        assert os.path.isdir(os.path.join(str(tmp_path), profiler.PROFILE_DIR_DEFAULT))
+
+    def test_annotate_context(self):
+        with profiler.annotate("named-span"):
+            pass  # annotation outside a trace is a no-op, must not raise
+
+
+class TestStepFlops:
+    def test_estimate_step_flops(self):
+        f = jax.jit(lambda a, b: a @ b)
+        a = jnp.ones((64, 64))
+        lowered = f.lower(a, a)
+        compiled = lowered.compile()
+        flops = profiler.estimate_step_flops(compiled)
+        if flops is not None:
+            # 2*M*N*K matmul FLOPs, allow generous slack across backends.
+            assert flops >= 2 * 64 * 64 * 64 * 0.5
