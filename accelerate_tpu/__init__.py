@@ -10,7 +10,16 @@ checkpointing, trackers) mirrors the reference's feature set.
 __version__ = "0.1.0"
 
 from .accelerator import Accelerator, TrainState
+from .big_modeling import (
+    ShardingPlan,
+    infer_sharding_plan,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    offload_blocks,
+    streamed_scan,
+)
 from .data import DataLoader, prepare_data_loader, skip_first_batches
+from .generation import GenerationConfig, Generator, generate
 from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
 from .parallel.sharding import ShardingStrategy

@@ -2,9 +2,10 @@
 
 Analog of `accelerate estimate-memory` (reference `commands/estimate.py`:
 meta-device model load :64, ≈4x-for-Adam training estimate :218, per-dtype
-table :253). Here the calculation is exact for the framework's model zoo via
-`jax.eval_shape` — no weights are ever materialized — and it understands
-sharding: pass a mesh factorization to see per-chip footprints.
+table :253). The parameter count comes from `jax.eval_shape` and is exact
+(no weights materialize); activation/logit terms are documented heuristics.
+`--plan` runs the real HBM-budget sharding planner
+(`big_modeling.infer_sharding_plan`) and prints the resulting spec summary.
 """
 
 from __future__ import annotations
@@ -39,6 +40,12 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     )
     p.add_argument(
         "--hbm_gb", type=float, default=16.0, help="Per-chip HBM (v5e=16, v4=32, v5p=95)"
+    )
+    p.add_argument(
+        "--plan",
+        action="store_true",
+        help="Run the HBM-budget sharding planner over an N-device mesh "
+        "(N = --shards) and print the plan verdict",
     )
     p.set_defaults(func=run)
 
@@ -136,4 +143,42 @@ def run(args: argparse.Namespace) -> int:
     if r["total"] > hbm * 0.9 and args.shards == 1:
         need = math.ceil(r["total"] / (hbm * 0.7))
         print(f"Hint: try --shards {need} (FSDP) or gradient accumulation with a smaller batch.")
+    if args.plan:
+        print()
+        print(_plan_summary(args, r))
     return 0
+
+
+def _plan_summary(args: argparse.Namespace, r: dict[str, Any]) -> str:
+    """Shape-only sharding plan over a --shards-device mesh (the
+    `infer_auto_device_map` analog, reference `utils/modeling.py:1281`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import models
+    from ..big_modeling import infer_sharding_plan
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from ..parallel.tp import get_tp_plan, list_tp_plans
+
+    family, _ = _MODEL_PRESETS[args.model]
+    config = r["config"]
+    module = getattr(models, family)
+    shapes = jax.eval_shape(lambda rng: module.init(rng, config), jax.random.PRNGKey(0))
+    n = max(args.shards, 1)
+    if n == len(jax.devices()):
+        mesh = build_mesh(MeshConfig(data=1, fsdp=n))
+    else:
+        # Planning is shape-only; an abstract mesh over a replicated device
+        # list is enough to compute division factors (build_mesh would
+        # reject any n that differs from the local device count).
+        devices = (jax.devices() * n)[:n]
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices).reshape(1, n, 1, 1, 1),
+                    ("data", "fsdp", "tensor", "sequence", "expert"))
+    rules = get_tp_plan(family) if family in list_tp_plans() else ()
+    dtype = jnp.bfloat16 if args.precision in ("bf16", "fp16") else jnp.float32
+    budget = int(args.hbm_gb * 0.95 * 1024**3)
+    plan = infer_sharding_plan(shapes, mesh, hbm_budget=budget, rules=rules, dtype=dtype)
+    return f"Sharding plan over {n} device(s):\n{plan.summary()}"

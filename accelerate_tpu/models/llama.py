@@ -21,6 +21,7 @@ under the name ``"llama"``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any
 
@@ -304,6 +305,88 @@ def forward_with_cache(
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
     new_cache = {"k": new_k, "v": new_v, "length": start + T_new}
     return logits, new_cache
+
+
+@functools.lru_cache(maxsize=16)
+def _generator(config: LlamaConfig, generation_config: Any, jit_loop: bool):
+    from ..generation import Generator
+
+    return Generator(
+        lambda p, t, c: forward_with_cache(p, t, c, config),
+        lambda b, m: init_cache(config, b, m),
+        generation_config,
+        jit_loop=jit_loop,
+    )
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    config: LlamaConfig,
+    *,
+    generation_config: Any = None,
+    rng: jax.Array | None = None,
+    jit_loop: bool = True,
+) -> jax.Array:
+    """Autoregressive generation for this family. Jitted prefill/decode steps
+    are cached per (model config, generation config), so repeated calls skip
+    tracing (both configs are frozen dataclasses, hence hashable)."""
+    gen = _generator(config, generation_config, jit_loop)
+    total = prompt.shape[1] + gen.config.max_new_tokens
+    if total > config.max_seq_len:
+        # RoPE table gathers clamp out-of-range positions under jit, which
+        # would silently degrade instead of failing.
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({gen.config.max_new_tokens}) = {total} exceeds "
+            f"max_seq_len={config.max_seq_len}"
+        )
+    return gen(params, prompt, rng=rng)
+
+
+@functools.lru_cache(maxsize=16)
+def _offloaded_block_step(config: LlamaConfig):
+    """Jitted per-layer step for the offloaded path, cached per config so
+    repeated streamed forwards reuse the compilation."""
+
+    def step(block, x, cos, sin, positions):
+        return block_forward(
+            block, x, config=config, cos=cos, sin=sin, positions=positions, mask=None
+        )
+
+    return jax.jit(step)
+
+
+def forward_offloaded(
+    params: Params,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    *,
+    compute_dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Forward for over-HBM models: ``params['blocks']`` leaves may be
+    host-resident numpy (see `big_modeling.offload_blocks`); each layer
+    streams to the device one step ahead of compute
+    (`big_modeling.streamed_scan`). Non-block params must fit on device.
+    """
+    from ..big_modeling import streamed_scan
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)
+    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    embed = jnp.asarray(params["embed"]).astype(compute_dtype)
+    x = embed[tokens]
+
+    block_step = _offloaded_block_step(config)
+    x = streamed_scan(
+        lambda carry, block: block_step(block, carry, cos, sin, positions),
+        x, params["blocks"],
+        dtype=compute_dtype,
+    )
+    x = rms_norm(x, jnp.asarray(params["final_norm"]), config.norm_eps)
+    head = embed.T if config.tie_embeddings else jnp.asarray(params["lm_head"]).astype(compute_dtype)
+    return jnp.einsum("bsd,dv->bsv", x, head)
 
 
 def loss_fn(

@@ -1,0 +1,248 @@
+"""Big-model inference tests (reference `tests/test_big_modeling.py`,
+`test_modeling_utils.py` — device maps, offload, dispatch)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from accelerate_tpu import (
+    Accelerator,
+    GenerationConfig,
+    MeshConfig,
+    build_mesh,
+    checkpointing,
+    infer_sharding_plan,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    offload_blocks,
+)
+from accelerate_tpu import big_modeling
+from accelerate_tpu.models import llama
+from accelerate_tpu.parallel.tp import get_tp_plan
+
+GIB = 1 << 30
+
+
+class TestPlan:
+    def test_llama70b_plans_shape_only_on_8_device_mesh(self):
+        """The headline scenario: plan a 70B model that could never
+        materialize on this host — pure shapes in, specs out."""
+        config = llama.LlamaConfig.llama3_70b()
+        shapes = init_empty_weights(lambda: jax.eval_shape(
+            lambda r: llama.init(r, config), jax.random.PRNGKey(0)
+        ))
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        # 70B bf16 ≈ 131 GiB; 8 devices x 16 GiB with 95% budget.
+        plan = infer_sharding_plan(
+            shapes, mesh, hbm_budget=int(15.2 * GIB), rules=get_tp_plan("llama"),
+            dtype=jnp.bfloat16,
+        )
+        assert plan.total_bytes > 120 * GIB
+        assert plan.fits
+        assert plan.per_device_bytes <= int(15.2 * GIB)
+        # every big leaf must actually be sharded 8-ways
+        blocks_spec = plan.specs["blocks"]
+        assert any(s != PartitionSpec() for s in jax.tree.leaves(
+            blocks_spec, is_leaf=lambda x: isinstance(x, PartitionSpec)))
+
+    def test_budget_forces_offload(self):
+        config = llama.LlamaConfig.tiny()
+        shapes = jax.eval_shape(lambda r: llama.init(r, config), jax.random.PRNGKey(0))
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        total = sum(big_modeling.compute_leaf_sizes(shapes).values())
+        # Budget below total/8 forces pass 3 (host offload), embeddings pinned.
+        plan = infer_sharding_plan(
+            shapes, mesh, hbm_budget=total // 64,
+            no_offload_patterns=("embed",),
+        )
+        assert plan.offload
+        assert not any("embed" == k for k in plan.offload)
+        assert plan.streaming_bytes > 0
+
+    def test_impossible_budget_reports_not_fits(self):
+        config = llama.LlamaConfig.tiny()
+        shapes = jax.eval_shape(lambda r: llama.init(r, config), jax.random.PRNGKey(0))
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        plan = infer_sharding_plan(
+            shapes, mesh, hbm_budget=16,
+            no_offload_patterns=(".*",),  # nothing may offload
+        )
+        assert not plan.fits
+        assert "fits: False" in plan.summary()
+
+    def test_no_budget_keeps_rules_only(self):
+        config = llama.LlamaConfig.tiny()
+        shapes = jax.eval_shape(lambda r: llama.init(r, config), jax.random.PRNGKey(0))
+        mesh = build_mesh(MeshConfig(data=2, tensor=4))
+        plan = infer_sharding_plan(shapes, mesh, rules=get_tp_plan("llama"))
+        assert plan.fits and not plan.offload
+
+
+class TestLoadAndDispatch:
+    def _save_consolidated(self, tmp_path, params):
+        d = str(tmp_path / "sharded")
+        checkpointing.save_pytree(params, d)
+        return checkpointing.consolidate_checkpoint(d, str(tmp_path / "model"))
+
+    def test_stream_from_npz_into_sharded_buffers(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        path = self._save_consolidated(tmp_path, params)
+        shapes = jax.eval_shape(lambda: params)
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        plan = infer_sharding_plan(shapes, mesh, rules=get_tp_plan("llama"))
+        loaded = load_checkpoint_and_dispatch(shapes, path, plan)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            jax.device_get(loaded), jax.device_get(params),
+        )
+
+    def test_stream_from_sharded_dir(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        d = str(tmp_path / "sharded")
+        checkpointing.save_pytree(params, d)
+        shapes = jax.eval_shape(lambda: params)
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        plan = infer_sharding_plan(shapes, mesh)
+        loaded = load_checkpoint_and_dispatch(shapes, d, plan)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            jax.device_get(loaded), jax.device_get(params),
+        )
+
+    def test_stream_from_safetensors_with_key_map(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        arrays = {
+            "model.w1": np.arange(64, dtype=np.float32).reshape(8, 8),
+            "model.w2": np.ones((16, 4), np.float32),
+        }
+        path = str(tmp_path / "m.safetensors")
+        save_file(arrays, path)
+        shapes = {
+            "w1": jax.ShapeDtypeStruct((8, 8), jnp.float32),
+            "w2": jax.ShapeDtypeStruct((16, 4), jnp.float32),
+        }
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        plan = infer_sharding_plan(shapes, mesh, min_weight_size=1)
+        loaded = load_checkpoint_and_dispatch(
+            shapes, path, plan, key_map=lambda k: f"model.{k}"
+        )
+        np.testing.assert_array_equal(np.asarray(loaded["w1"]), arrays["model.w1"])
+        np.testing.assert_array_equal(np.asarray(loaded["w2"]), arrays["model.w2"])
+
+    def test_dtype_cast_on_load(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        path = self._save_consolidated(tmp_path, params)
+        shapes = jax.eval_shape(lambda: params)
+        mesh = build_mesh(MeshConfig())
+        plan = infer_sharding_plan(shapes, mesh)
+        loaded = load_checkpoint_and_dispatch(shapes, path, plan, dtype=jnp.bfloat16)
+        assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(loaded))
+
+    def test_offloaded_leaves_stay_on_host(self, tmp_path):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        path = self._save_consolidated(tmp_path, params)
+        shapes = jax.eval_shape(lambda: params)
+        mesh = build_mesh(MeshConfig())
+        total = sum(big_modeling.compute_leaf_sizes(shapes).values())
+        plan = infer_sharding_plan(shapes, mesh, hbm_budget=total // 16)
+        assert plan.offload
+        loaded = load_checkpoint_and_dispatch(shapes, path, plan)
+        flat, _ = jax.tree_util.tree_flatten_with_path(loaded)
+        from accelerate_tpu.parallel.sharding import _path_str
+        for p, leaf in flat:
+            if _path_str(p) in plan.offload:
+                assert isinstance(leaf, np.ndarray)
+            else:
+                assert isinstance(leaf, jax.Array)
+
+
+class TestStreamedForward:
+    def test_offloaded_forward_matches_resident(self):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size, jnp.int32)
+        resident = llama.forward(
+            jax.tree.map(lambda x: x.astype(jnp.bfloat16), params), tokens, config
+        )
+        host_params = dict(params)
+        host_params["blocks"] = offload_blocks(params["blocks"])
+        streamed = llama.forward_offloaded(host_params, tokens, config)
+        np.testing.assert_allclose(
+            np.asarray(resident, np.float32), np.asarray(streamed, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+class TestGenerate:
+    def _setup(self):
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, config.vocab_size, jnp.int32)
+        return config, params, prompt
+
+    def test_greedy_shapes_and_determinism(self):
+        config, params, prompt = self._setup()
+        gen = GenerationConfig(max_new_tokens=6)
+        out1 = llama.generate(params, prompt, config, generation_config=gen)
+        out2 = llama.generate(params, prompt, config, generation_config=gen)
+        assert out1.shape == (2, 8 + 6)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(out1[:, :8]), np.asarray(prompt))
+
+    def test_python_loop_matches_jit_loop_greedy(self):
+        config, params, prompt = self._setup()
+        gen = GenerationConfig(max_new_tokens=5)
+        fast = llama.generate(params, prompt, config, generation_config=gen)
+        slow = llama.generate(params, prompt, config, generation_config=gen, jit_loop=False)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+    def test_sampling_configs_run(self):
+        config, params, prompt = self._setup()
+        for gen in (
+            GenerationConfig(max_new_tokens=4, do_sample=True, temperature=0.7),
+            GenerationConfig(max_new_tokens=4, do_sample=True, top_k=5),
+            GenerationConfig(max_new_tokens=4, do_sample=True, top_p=0.9),
+            GenerationConfig(max_new_tokens=1),
+        ):
+            out = llama.generate(
+                params, prompt, config, generation_config=gen, rng=jax.random.PRNGKey(7)
+            )
+            assert out.shape == (2, 8 + gen.max_new_tokens)
+            assert int(np.asarray(out).min()) >= 0
+
+    def test_eos_rows_padded(self):
+        config, params, prompt = self._setup()
+        # Force EOS on the very first sampled token by making every token EOS:
+        # generate greedily, find what token row 0 produces, then re-run with
+        # that token as eos and assert the remainder of row 0 is pad.
+        first = llama.generate(
+            params, prompt, config, generation_config=GenerationConfig(max_new_tokens=1)
+        )
+        eos = int(np.asarray(first)[0, -1])
+        gen = GenerationConfig(max_new_tokens=5, eos_token_id=eos, pad_token_id=0)
+        out = np.asarray(llama.generate(params, prompt, config, generation_config=gen))
+        row = out[0, 8:]
+        assert row[0] == eos
+        assert (row[1:] == 0).all()
+
+    def test_prefill_matches_full_forward(self):
+        """The KV-cache incremental path must agree with the dense forward."""
+        config, params, prompt = self._setup()
+        cache = llama.init_cache(config, 2, 16, dtype=jnp.float32)
+        logits_inc, _ = jax.jit(
+            lambda p, t, c: llama.forward_with_cache(p, t, c, config)
+        )(params, prompt, cache)
+        logits_full = llama.forward(params, prompt, config)
+        np.testing.assert_allclose(
+            np.asarray(logits_inc, np.float32), np.asarray(logits_full, np.float32),
+            rtol=1e-3, atol=1e-3,
+        )
