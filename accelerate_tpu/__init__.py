@@ -24,6 +24,7 @@ from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
 from .parallel.sharding import ShardingStrategy
 from .state import AcceleratorState, GradientState, ProcessState
+from .tracking import GeneralTracker, JSONTracker, TensorBoardTracker, WandBTracker
 from .utils import (
     DataLoaderConfiguration,
     DistributedType,

@@ -482,6 +482,81 @@ class Accelerator:
             data = _ops.slice_tensors(data, slice(0, remainder))
         return data
 
+    # -------------------------------------------------------------- tracking
+    def init_trackers(
+        self,
+        project_name: str,
+        config: dict | None = None,
+        init_kwargs: dict | None = None,
+    ) -> None:
+        """Instantiate the trackers selected by ``log_with`` (reference
+        `accelerator.py:2804`). ``init_kwargs`` is keyed by tracker name."""
+        from . import tracking
+
+        init_kwargs = init_kwargs or {}
+        logging_dir = self.project_config.logging_dir
+        self.trackers = []
+        for entry in tracking.filter_trackers(self.log_with, logging_dir):
+            if isinstance(entry, tracking.GeneralTracker):
+                tracker = entry
+            else:
+                # Constructors have global side effects (run creation, open
+                # files): instantiate on the main process only, unless the
+                # tracker opts in to per-process runs (reference wandb
+                # `main_process_only = False`, `tracking.py:289`).
+                if entry.main_process_only and not self.is_main_process:
+                    continue
+                kwargs = dict(init_kwargs.get(entry.name, {}))
+                if entry.requires_logging_directory:
+                    kwargs.setdefault("logging_dir", logging_dir)
+                tracker = entry(project_name, **kwargs)
+            self.trackers.append(tracker)
+        if config is not None:
+            for tracker in self.trackers:
+                tracker.store_init_configuration(config)
+
+    def get_tracker(self, name: str, unwrap: bool = False) -> Any:
+        """Fetch one initialized tracker by name (reference
+        `accelerator.py:2850`); ``unwrap`` returns the raw library object."""
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(
+            f"Tracker {name!r} not found; initialized: "
+            f"{[t.name for t in self.trackers]} (did you call init_trackers?)"
+        )
+
+    def log(
+        self,
+        values: dict,
+        step: int | None = None,
+        log_kwargs: dict | None = None,
+    ) -> None:
+        """Log metrics to every tracker (reference `accelerator.py:2883`).
+
+        Device arrays (e.g. the metrics dict a compiled train step returned)
+        are synced to host scalars HERE, once, so trackers never touch jax.
+        """
+        log_kwargs = log_kwargs or {}
+        host_values = {
+            k: (float(v) if hasattr(v, "dtype") and getattr(v, "ndim", 1) == 0 else v)
+            for k, v in values.items()
+        }
+        if step is not None and hasattr(step, "item"):
+            step = int(step)
+        for tracker in self.trackers:
+            tracker.log(host_values, step=step, **log_kwargs.get(tracker.name, {}))
+
+    def end_training(self) -> None:
+        """Flush/close all trackers (reference `accelerator.py:2912`) and
+        join any in-flight async checkpoint writer."""
+        for tracker in self.trackers:
+            tracker.finish()
+        self.trackers = []
+        from . import checkpointing
+
+        checkpointing.wait_for_checkpoint()
+
     # -------------------------------------------------------------- triggers
     def set_trigger(self) -> None:
         """Cooperative cross-process abort flag (reference

@@ -145,7 +145,7 @@ def infer_sharding_plan(
     n_devices = int(np.prod(list(mesh.shape.values()))) or 1
     all_axes = tuple(mesh.shape.keys())
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
-    sizes = {_path_str(p): _leaf_bytes(l, dtype) for p, l in flat}
+    sizes = compute_leaf_sizes(shapes, dtype)
     total = sum(sizes.values())
 
     specs: dict[str, PartitionSpec] = {}

@@ -1,0 +1,568 @@
+"""Experiment trackers.
+
+Analog of the reference tracking subsystem (`tracking.py:91` `GeneralTracker`
+ABC + seven SaaS integrations, glued in `accelerator.py:2804-2932`). The TPU
+redesign keeps the same three-phase contract —
+
+    accelerator.init_trackers("project", config={...})
+    accelerator.log({"loss": ...}, step=...)
+    accelerator.end_training()
+
+— with two deliberate shifts:
+
+- metric values arriving from compiled steps are **device arrays**; the
+  Accelerator glue converts them to host scalars *once*, so individual
+  trackers never block on device sync;
+- a dependency-free :class:`JSONTracker` is the always-available default
+  (TPU VMs are frequently headless with no SaaS egress); the SaaS trackers
+  (`wandb`, `comet_ml`, `mlflow`, `aim`, `clearml`, `dvclive`) are
+  import-gated exactly like the reference's `is_wandb_available()` family.
+
+Every tracker implements: ``name``, ``requires_logging_directory``,
+``tracker`` (the raw underlying object, reference `tracking.py:98-106`),
+``store_init_configuration(values)``, ``log(values, step)``, ``finish()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+import json
+import os
+import time
+from typing import Any
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# --------------------------------------------------------------- availability
+def _available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ModuleNotFoundError, ValueError):
+        # find_spec raises (not returns None) when a dotted module's parent
+        # package is itself absent, e.g. "torch.utils.tensorboard" sans torch.
+        return False
+
+
+def is_tensorboard_available() -> bool:
+    # Only backends TensorBoardTracker can actually construct a writer from;
+    # the bare TF `tensorboard` package has no SummaryWriter we use.
+    return _available("torch.utils.tensorboard") or _available("tensorboardX")
+
+
+def is_wandb_available() -> bool:
+    return _available("wandb")
+
+
+def is_comet_ml_available() -> bool:
+    return _available("comet_ml")
+
+
+def is_mlflow_available() -> bool:
+    return _available("mlflow")
+
+
+def is_aim_available() -> bool:
+    return _available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _available("dvclive")
+
+
+def on_main_process(method):
+    """Run the wrapped tracker method only on the main process when the
+    tracker's ``main_process_only`` flag is set (reference `tracking.py:67`).
+
+    Process identity comes from `ProcessState` (jax.process_index) rather
+    than a torch process group.
+    """
+
+    @functools.wraps(method)
+    def wrapper(self, *args: Any, **kwargs: Any):
+        if getattr(self, "main_process_only", True):
+            from .state import ProcessState
+
+            if not ProcessState().is_main_process:
+                return None
+        return method(self, *args, **kwargs)
+
+    return wrapper
+
+
+# ------------------------------------------------------------------- base ABC
+class GeneralTracker:
+    """Base class for experiment trackers (reference `tracking.py:91`).
+
+    Subclasses must define class attributes ``name`` and
+    ``requires_logging_directory`` and implement ``tracker``,
+    ``store_init_configuration``, and ``log``.
+    """
+
+    main_process_only: bool = True
+
+    def __init__(self, _blank: bool = False) -> None:
+        if _blank:
+            return
+        missing = [
+            attr
+            for attr in ("name", "requires_logging_directory")
+            if not hasattr(self, attr)
+        ]
+        if missing:
+            raise NotImplementedError(
+                f"{type(self).__name__} must define class attribute(s): "
+                + ", ".join(f"`{m}`" for m in missing)
+            )
+
+    @property
+    def tracker(self) -> Any:
+        """The raw underlying run/writer object, for direct library access."""
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict) -> None:
+        raise NotImplementedError
+
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        raise NotImplementedError
+
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        raise NotImplementedError(f"{type(self).__name__} does not support images")
+
+    def finish(self) -> None:  # optional
+        pass
+
+
+# ---------------------------------------------------------------- JSONTracker
+class JSONTracker(GeneralTracker):
+    """Dependency-free tracker: JSONL metrics + a config JSON on disk, plus an
+    in-memory history for programmatic access (no reference analog — the TPU
+    replacement for "no tracker available on this VM").
+
+    Layout under ``logging_dir/run_name``:
+    - ``config.json``  — the `store_init_configuration` payload
+    - ``metrics.jsonl`` — one `{"step": .., "_timestamp": .., **values}` per log
+    """
+
+    name = "json"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs: Any) -> None:
+        super().__init__()
+        self.run_name = run_name
+        self.run_dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.history: list[dict] = []
+        self._fh = open(os.path.join(self.run_dir, "metrics.jsonl"), "a")
+        logger.debug("JSONTracker run at %s", self.run_dir)
+
+    @property
+    def tracker(self) -> Any:
+        return self.history
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        with open(os.path.join(self.run_dir, "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        record = {"step": step, "_timestamp": time.time(), **values}
+        self.history.append(record)
+        self._fh.write(json.dumps(record, default=float) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self._fh.close()
+
+
+# --------------------------------------------------------- TensorBoardTracker
+class TensorBoardTracker(GeneralTracker):
+    """TensorBoard event files (reference `tracking.py:165`), via
+    `torch.utils.tensorboard` or `tensorboardX` — whichever is installed."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: str, **kwargs: Any) -> None:
+        super().__init__()
+        try:
+            from torch.utils import tensorboard as _tb
+        except ImportError:  # pragma: no cover - environment dependent
+            import tensorboardX as _tb
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = _tb.SummaryWriter(self.logging_dir, **kwargs)
+        logger.debug("TensorBoard run at %s", self.logging_dir)
+
+    @property
+    def tracker(self) -> Any:
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        # hparams requires flat scalar/str values; project the config onto that.
+        flat = {
+            k: v if isinstance(v, (int, float, str, bool)) else str(v)
+            for k, v in values.items()
+        }
+        try:
+            self.writer.add_hparams(flat, metric_dict={})
+        except Exception:
+            self.writer.add_text("config", json.dumps(flat, default=str))
+        # Also keep a greppable copy next to the event files.
+        with open(os.path.join(self.logging_dir, "hparams.json"), "w") as f:
+            json.dump(flat, f, indent=2)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        for k, v in values.items():
+            if isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+            else:
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        for k, v in values.items():
+            self.writer.add_images(k, v, global_step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+# --------------------------------------------------------------- SaaS trackers
+class _GatedTracker(GeneralTracker):
+    """Shared shape for import-gated SaaS trackers: raise a clear error at
+    construction when the client library is absent (reference pattern:
+    `require_wandb` + `is_wandb_available`, `tracking.py:276`)."""
+
+    _module: str = ""
+
+    def _require(self) -> None:
+        if not _available(self._module):
+            raise ImportError(
+                f"{type(self).__name__} requires the `{self._module}` package, "
+                f"which is not installed in this environment. Install it or "
+                f'use log_with="json" / "tensorboard".'
+            )
+
+
+class WandBTracker(_GatedTracker):
+    """Weights & Biases (reference `tracking.py:276`)."""
+
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = False
+    _module = "wandb"
+
+    def __init__(self, run_name: str, **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        import wandb
+
+        self.run.log(
+            {k: [wandb.Image(img) for img in v] for k, v in values.items()},
+            step=step,
+            **kwargs,
+        )
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class MLflowTracker(_GatedTracker):
+    """MLflow (reference `tracking.py:579`)."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+    _module = "mlflow"
+
+    def __init__(self, run_name: str, **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        import mlflow
+
+        self.run_name = run_name
+        self.run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import mlflow
+
+        # mlflow caps param value length; stringify and truncate like the
+        # reference (`tracking.py:662-688`).
+        mlflow.log_params(
+            {k: str(v)[:500] for k, v in values.items()}
+        )
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        import mlflow
+
+        mlflow.end_run()
+
+
+class CometMLTracker(_GatedTracker):
+    """Comet ML (reference `tracking.py:399`)."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+    _module = "comet_ml"
+
+    def __init__(self, run_name: str, **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        import comet_ml
+
+        self.run_name = run_name
+        self.run = comet_ml.Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.run.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        if step is not None:
+            self.run.set_step(step)
+        self.run.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.end()
+
+
+class AimTracker(_GatedTracker):
+    """Aim (reference `tracking.py:480`)."""
+
+    name = "aim"
+    requires_logging_directory = True
+    _module = "aim"
+
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        from aim import Run
+
+        self.run_name = run_name
+        self.run = Run(repo=logging_dir, experiment=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.run["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        for k, v in values.items():
+            self.run.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.close()
+
+
+class ClearMLTracker(_GatedTracker):
+    """ClearML (reference `tracking.py:777`)."""
+
+    name = "clearml"
+    requires_logging_directory = False
+    _module = "clearml"
+
+    def __init__(self, run_name: str | None = None, **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        from clearml import Task
+
+        self.run_name = run_name
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        clogger = self.task.get_logger()
+        for k, v in values.items():
+            clogger.report_scalar(title=k, series=k, value=float(v), iteration=step or 0)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.task.close()
+
+
+class DVCLiveTracker(_GatedTracker):
+    """DVCLive (reference `tracking.py:929`)."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+    _module = "dvclive"
+
+    def __init__(self, run_name: str | None = None, live: Any = None, **kwargs: Any) -> None:
+        super().__init__()
+        self._require()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
+
+
+# ------------------------------------------------------------------ resolution
+LOGGER_TYPE_TO_CLASS: dict[str, type[GeneralTracker]] = {
+    "json": JSONTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+_AVAILABILITY = {
+    "json": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+}
+
+
+def get_available_trackers() -> list[str]:
+    """Names of trackers whose client library is importable (reference
+    `tracking.py:86`)."""
+    return [name for name, check in _AVAILABILITY.items() if check()]
+
+
+def filter_trackers(
+    log_with: Any,
+    logging_dir: str | None = None,
+) -> list[type[GeneralTracker] | GeneralTracker]:
+    """Resolve a `log_with` value into tracker classes/instances (reference
+    `tracking.py:1023` `filter_trackers`).
+
+    Accepts: ``"all"``, a tracker name, a `GeneralTracker` instance, a
+    class, or a list of any of those. Unavailable trackers are dropped with
+    a warning (matching reference behavior); names that require a logging
+    dir when none is configured raise.
+    """
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    out: list[Any] = []
+    for item in log_with:
+        if isinstance(item, GeneralTracker):
+            out.append(item)
+            continue
+        if isinstance(item, type) and issubclass(item, GeneralTracker):
+            out.append(item)
+            continue
+        name = str(item).lower()
+        if name == "all":
+            out.extend(
+                LOGGER_TYPE_TO_CLASS[n]
+                for n in get_available_trackers()
+                if not (LOGGER_TYPE_TO_CLASS[n].requires_logging_directory and logging_dir is None)
+            )
+            continue
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(
+                f"Unknown tracker {item!r}; expected one of "
+                f"{sorted(LOGGER_TYPE_TO_CLASS)} or 'all'"
+            )
+        if not _AVAILABILITY[name]():
+            logger.warning(
+                "Tracker %r requested but its library is not installed; skipping.",
+                name,
+            )
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[name]
+        if cls.requires_logging_directory and logging_dir is None:
+            raise ValueError(
+                f"Tracker {name!r} requires a logging directory: pass "
+                "`project_dir=` (or a ProjectConfiguration with logging_dir) "
+                "to Accelerator."
+            )
+        out.append(cls)
+    return out

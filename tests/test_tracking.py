@@ -1,0 +1,125 @@
+"""Tracker subsystem tests (reference `tests/test_tracking.py` strategy:
+instantiate real trackers against tmp dirs and assert the files/values)."""
+
+import glob
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu import tracking
+from accelerate_tpu.tracking import (
+    GeneralTracker,
+    JSONTracker,
+    TensorBoardTracker,
+    filter_trackers,
+    get_available_trackers,
+)
+
+
+def test_json_tracker_round_trip(tmp_path):
+    t = JSONTracker("run1", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 1e-3, "model": "llama"})
+    t.log({"loss": 2.5}, step=0)
+    t.log({"loss": 1.5, "acc": 0.9}, step=1)
+    t.finish()
+
+    run_dir = tmp_path / "run1"
+    config = json.loads((run_dir / "config.json").read_text())
+    assert config["lr"] == 1e-3
+    lines = [json.loads(l) for l in (run_dir / "metrics.jsonl").read_text().splitlines()]
+    assert [l["step"] for l in lines] == [0, 1]
+    assert lines[1]["acc"] == 0.9
+    assert t.history[0]["loss"] == 2.5
+
+
+@pytest.mark.skipif(not tracking.is_tensorboard_available(), reason="no tensorboard")
+def test_tensorboard_tracker_writes_event_files(tmp_path):
+    t = TensorBoardTracker("tbrun", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 3.0, "note": "hello"}, step=0)
+    t.finish()
+    events = glob.glob(str(tmp_path / "tbrun" / "**" / "events.out.tfevents.*"), recursive=True)
+    assert events, "no tensorboard event files written"
+    hparams = json.loads((tmp_path / "tbrun" / "hparams.json").read_text())
+    assert hparams["lr"] == 0.1
+
+
+def test_filter_trackers_resolution(tmp_path):
+    assert filter_trackers(None) == []
+    out = filter_trackers("json", logging_dir=str(tmp_path))
+    assert out == [JSONTracker]
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("not_a_tracker")
+    with pytest.raises(ValueError, match="logging directory"):
+        filter_trackers("json", logging_dir=None)
+    # unavailable SaaS tracker is dropped, not an error (reference behavior)
+    assert filter_trackers("wandb") == []
+    # instances and classes pass through
+    inst = JSONTracker("r", logging_dir=str(tmp_path))
+    assert filter_trackers([inst]) == [inst]
+
+
+def test_get_available_trackers_includes_native():
+    avail = get_available_trackers()
+    assert "json" in avail
+
+
+def test_accelerator_tracker_glue(tmp_path):
+    acc = Accelerator(log_with="json", project_dir=str(tmp_path))
+    acc.init_trackers("proj", config={"bs": 8})
+    # device-scalar metrics (what a compiled step returns) sync to floats
+    acc.log({"loss": jnp.float32(2.0)}, step=jnp.int32(3))
+    tracker = acc.get_tracker("json")
+    assert tracker.history[0]["loss"] == 2.0
+    assert tracker.history[0]["step"] == 3
+    raw = acc.get_tracker("json", unwrap=True)
+    assert raw is tracker.history
+    acc.end_training()
+    assert acc.trackers == []
+    lines = (tmp_path / "proj" / "metrics.jsonl").read_text().splitlines()
+    assert json.loads(lines[0])["loss"] == 2.0
+
+
+def test_accelerator_get_tracker_missing_raises(tmp_path):
+    acc = Accelerator(log_with="json", project_dir=str(tmp_path))
+    acc.init_trackers("proj")
+    with pytest.raises(ValueError, match="not found"):
+        acc.get_tracker("wandb")
+
+
+def test_custom_tracker_subclass(tmp_path):
+    class MyTracker(GeneralTracker):
+        name = "mine"
+        requires_logging_directory = False
+
+        def __init__(self):
+            super().__init__()
+            self.logged = []
+
+        @property
+        def tracker(self):
+            return self.logged
+
+        def store_init_configuration(self, values):
+            self.config = values
+
+        def log(self, values, step=None, **kwargs):
+            self.logged.append((step, values))
+
+    mine = MyTracker()
+    acc = Accelerator(log_with=mine)
+    acc.init_trackers("p", config={"a": 1})
+    acc.log({"x": 1.0}, step=0)
+    assert mine.config == {"a": 1}
+    assert mine.logged == [(0, {"x": 1.0})]
+
+
+def test_subclass_missing_attrs_raises():
+    class Bad(GeneralTracker):
+        pass
+
+    with pytest.raises(NotImplementedError, match="requires_logging_directory"):
+        Bad()
