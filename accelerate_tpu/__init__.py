@@ -9,7 +9,7 @@ checkpointing, trackers) mirrors the reference's feature set.
 
 __version__ = "0.1.0"
 
-from .accelerator import Accelerator, TrainState
+from .accelerator import Accelerator, DynamicLossScale, TrainState
 from .big_modeling import (
     ShardingPlan,
     infer_sharding_plan,
@@ -29,6 +29,8 @@ from .utils import (
     DataLoaderConfiguration,
     DistributedType,
     FsdpPlugin,
+    find_executable_batch_size,
+    release_memory,
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     ProfileKwargs,

@@ -66,11 +66,40 @@ from .utils.dataclasses import (
 from .utils.random import set_seed as _set_seed
 
 
+class DynamicLossScale(struct.PyTreeNode):
+    """fp16 dynamic loss-scale state — the GradScaler analog (reference
+    `utils/modeling.py:2054` `get_grad_scaler` + overflow-skip in
+    `optimizer.py:162-176`), carried functionally inside :class:`TrainState`
+    so the whole scaler lives in the compiled step.
+
+    Semantics per step: grads are taken of ``loss * scale`` and unscaled;
+    if any gradient is non-finite the parameter/optimizer update is skipped
+    and ``scale *= backoff_factor``; after ``growth_interval`` consecutive
+    finite steps ``scale *= growth_factor``.
+    """
+
+    scale: jax.Array  # f32 scalar
+    growth_counter: jax.Array  # i32 scalar
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**15, **kwargs: Any) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            growth_counter=jnp.zeros((), jnp.int32),
+            **kwargs,
+        )
+
+
 class TrainState(struct.PyTreeNode):
     """Functional train state: the pytree the jitted step transforms.
 
     Mirrors `flax.training.train_state.TrainState` in shape; owned by the
     framework so sharding/checkpoint logic controls its layout.
+    ``loss_scale`` is None except under fp16 mixed precision (None is an
+    empty pytree node, so every existing path is unaffected).
     """
 
     step: jax.Array
@@ -78,6 +107,7 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     apply_fn: Callable = struct.field(pytree_node=False, default=None)
     tx: optax.GradientTransformation = struct.field(pytree_node=False, default=None)
+    loss_scale: Any = None
 
     @classmethod
     def create(cls, *, params: Any, tx: optax.GradientTransformation, apply_fn: Callable | None = None) -> "TrainState":
@@ -274,13 +304,22 @@ class Accelerator:
 
     def state_shardings(self, state_shapes: "TrainState") -> "TrainState":
         """TrainState-shaped pytree of NamedShardings (for jit out_shardings)."""
+        replicated = NamedSharding(self.mesh, PartitionSpec())
         return TrainState(
-            step=NamedSharding(self.mesh, PartitionSpec()),
+            step=replicated,
             params=to_named_shardings(self._param_specs, self.mesh),
             opt_state=to_named_shardings(self._opt_specs, self.mesh),
             apply_fn=state_shapes.apply_fn,
             tx=state_shapes.tx,
+            loss_scale=jax.tree.map(lambda _: replicated, state_shapes.loss_scale),
         )
+
+    def _maybe_loss_scale(self) -> DynamicLossScale | None:
+        """fp16 compute requires a dynamic loss scaler (fp16's 5-bit exponent
+        underflows real gradients); bf16/fp32 need none."""
+        if self.policy.compute_dtype == jnp.float16:
+            return DynamicLossScale.create()
+        return None
 
     def create_train_state(
         self,
@@ -315,15 +354,20 @@ class Accelerator:
             opt_state=opt_state,
             apply_fn=apply_fn,
             tx=tx,
+            loss_scale=self._maybe_loss_scale(),
         )
 
     def prepare_train_state(self, state: TrainState) -> TrainState:
         """Shard an existing (host or single-device) TrainState onto the mesh."""
         params_shapes = jax.eval_shape(lambda: state.params)
         param_specs, opt_specs = self._resolve_specs(params_shapes, state.tx)
+        loss_scale = state.loss_scale
+        if loss_scale is None:
+            loss_scale = self._maybe_loss_scale()
         return state.replace(
             params=shard_pytree(state.params, param_specs, self.mesh),
             opt_state=shard_pytree(state.opt_state, opt_specs, self.mesh),
+            loss_scale=loss_scale,
         )
 
     def unwrap(self, state: TrainState) -> Any:
@@ -361,8 +405,9 @@ class Accelerator:
         accum = self.gradient_state.num_steps
         policy = self.policy
         max_grad_norm = self.max_grad_norm
+        use_scaler = policy.compute_dtype == jnp.float16
 
-        def compute_loss(params: Any, batch: Any, rng: jax.Array):
+        def compute_loss(params: Any, batch: Any, rng: jax.Array, scale: jax.Array):
             cparams = policy.cast_for_compute(params)
             cbatch = policy.cast_for_compute(batch)
             out = loss_fn(cparams, cbatch, rng)
@@ -370,12 +415,16 @@ class Accelerator:
                 loss, aux = out
             else:
                 loss, aux = out, None
-            return loss.astype(jnp.float32), aux
+            loss = loss.astype(jnp.float32)
+            # Differentiate the SCALED loss (fp16 grads underflow otherwise);
+            # scale == 1.0 outside fp16, so this is the identity there.
+            return loss * scale, (loss, aux)
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
         def step_fn(state: TrainState, batch: Any) -> tuple[TrainState, dict[str, jax.Array]]:
             rng = jax.random.fold_in(self.rng, state.step)
+            scale = state.loss_scale.scale if use_scaler else jnp.float32(1.0)
             if accum > 1:
                 def reshape(x):
                     b = x.shape[0]
@@ -394,8 +443,8 @@ class Accelerator:
                     g_acc, l_acc = carry
                     # Distinct rng per microbatch: otherwise dropout masks are
                     # identical across the accumulation window.
-                    (loss, aux), grads = grad_fn(
-                        state.params, mb, jax.random.fold_in(rng, mb_idx)
+                    (_, (loss, aux)), grads = grad_fn(
+                        state.params, mb, jax.random.fold_in(rng, mb_idx), scale
                     )
                     g_acc = jax.tree.map(jnp.add, g_acc, grads)
                     return (g_acc, l_acc + loss), aux
@@ -422,18 +471,57 @@ class Accelerator:
                         aux,
                     )
             else:
-                (loss, aux), grads = grad_fn(state.params, batch, rng)
+                (_, (loss, aux)), grads = grad_fn(state.params, batch, rng, scale)
 
             metrics: dict[str, jax.Array] = {"loss": loss}
+            if use_scaler:
+                grads = jax.tree.map(lambda g: g / scale, grads)
+                finite = jnp.all(
+                    jnp.stack(
+                        [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                    )
+                )
+                # Zero non-finite grads so the (discarded) optimizer update
+                # below computes on clean numbers either way.
+                grads = jax.tree.map(
+                    lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+                )
             if max_grad_norm is not None:
                 gnorm = global_norm(grads)
-                scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * scale, grads)
+                clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * clip, grads)
                 metrics["grad_norm"] = gnorm
             updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+            new_loss_scale = state.loss_scale
+            if use_scaler:
+                # Overflow: keep params/opt untouched, back the scale off.
+                # Finite: apply, and grow the scale every `growth_interval`
+                # consecutive finite steps (reference optimizer.py:162-176:
+                # `scaler.step` skips on inf, `scaler.update` adjusts).
+                keep_new = lambda new, old: jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new, old
+                )
+                new_params = keep_new(new_params, state.params)
+                new_opt_state = keep_new(new_opt_state, state.opt_state)
+                ls = state.loss_scale
+                counter = jnp.where(finite, ls.growth_counter + 1, 0)
+                grow = counter >= ls.growth_interval
+                new_scale = jnp.where(
+                    finite,
+                    jnp.where(grow, scale * ls.growth_factor, scale),
+                    scale * ls.backoff_factor,
+                )
+                new_loss_scale = ls.replace(
+                    scale=new_scale, growth_counter=jnp.where(grow, 0, counter)
+                )
+                metrics["loss_scale"] = new_scale
+                metrics["grads_finite"] = finite
             new_state = state.replace(
-                step=state.step + 1, params=new_params, opt_state=new_opt_state
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                loss_scale=new_loss_scale,
             )
             if extra_metrics_fn is not None:
                 metrics.update(extra_metrics_fn(new_state, aux))
@@ -517,10 +605,18 @@ class Accelerator:
 
     def get_tracker(self, name: str, unwrap: bool = False) -> Any:
         """Fetch one initialized tracker by name (reference
-        `accelerator.py:2850`); ``unwrap`` returns the raw library object."""
+        `accelerator.py:2850`); ``unwrap`` returns the raw library object.
+
+        On non-main processes (where main-only trackers were never
+        instantiated) a blank no-op tracker is returned, so user code can
+        call this unguarded everywhere (reference :2878-2881)."""
+        from . import tracking
+
         for tracker in self.trackers:
             if tracker.name == name:
                 return tracker.tracker if unwrap else tracker
+        if not self.is_main_process:
+            return tracking.GeneralTracker(_blank=True)
         raise ValueError(
             f"Tracker {name!r} not found; initialized: "
             f"{[t.name for t in self.trackers]} (did you call init_trackers?)"

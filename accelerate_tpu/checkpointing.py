@@ -222,6 +222,20 @@ class _ShardReader:
         self._array_cache.clear()
 
 
+def _index_has_prefix(directory: str, prefix: str) -> bool:
+    """Does any leaf key in the checkpoint's merged index start with ``prefix``?
+    (Cheap: reads only the JSON index files, no shard data.)"""
+    if not os.path.isdir(directory):
+        return False
+    for name in os.listdir(directory):
+        if not re.match(r"^index_(\d+)\.json$", name):
+            continue
+        with open(os.path.join(directory, name)) as f:
+            if any(key.startswith(prefix) for key in json.load(f)):
+                return True
+    return False
+
+
 def load_pytree(target: Any, directory: str) -> Any:
     """Restore a pytree saved with `save_pytree` into ``target``'s structure.
 
@@ -500,6 +514,8 @@ def save_state(
     _clear_stale_shard_files(os.path.join(save_dir, MODEL_DIR), accelerator.process_state)
 
     saveable = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
+    if state.loss_scale is not None:
+        saveable["loss_scale"] = state.loss_scale
 
     if async_save:
         # Synchronously snapshot device data to host, write files off-thread
@@ -571,8 +587,13 @@ def load_state(
     """Restore a `save_state` checkpoint into ``state``'s shardings
     (reference `load_state`, `accelerator.py:3272`)."""
     wait_for_checkpoint()
+    model_dir = os.path.join(input_dir, MODEL_DIR)
     target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
-    restored = load_pytree(target, os.path.join(input_dir, MODEL_DIR))
+    if state.loss_scale is not None and _index_has_prefix(model_dir, "loss_scale"):
+        # Only restore the scaler when the checkpoint has one: an fp16 resume
+        # from a pre-scaler (or bf16-trained) checkpoint keeps the fresh scaler.
+        target["loss_scale"] = state.loss_scale
+    restored = load_pytree(target, model_dir)
 
     rng_path = os.path.join(input_dir, RNG_FILE.format(proc=jax.process_index()))
     if not os.path.exists(rng_path):
@@ -596,7 +617,10 @@ def load_state(
                 obj.load_state_dict(pickle.load(f))
 
     return state.replace(
-        step=restored["step"], params=restored["params"], opt_state=restored["opt_state"]
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        loss_scale=restored.get("loss_scale", state.loss_scale),
     )
 
 

@@ -442,7 +442,10 @@ class ClearMLTracker(_GatedTracker):
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
         clogger = self.task.get_logger()
         for k, v in values.items():
-            clogger.report_scalar(title=k, series=k, value=float(v), iteration=step or 0)
+            if isinstance(v, (int, float)):
+                clogger.report_scalar(title=k, series=k, value=float(v), iteration=step or 0)
+            else:
+                clogger.report_text(f"{k}: {v}", print_console=False)
 
     @on_main_process
     def finish(self) -> None:

@@ -19,6 +19,13 @@ from .environment import (
     purge_framework_environment,
     str_to_bool,
 )
+from .memory import (
+    clear_device_cache,
+    find_executable_batch_size,
+    get_memory_stats,
+    release_memory,
+    should_reduce_batch_size,
+)
 from .profiler import (
     ProfileKwargs,
     annotate,

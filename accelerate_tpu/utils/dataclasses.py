@@ -82,7 +82,6 @@ _DTYPES = {
     PrecisionType.NO: jnp.float32,
     PrecisionType.BF16: jnp.bfloat16,
     PrecisionType.FP16: jnp.float16,
-    PrecisionType.FP8: jnp.float8_e4m3fn,
 }
 
 
@@ -91,8 +90,10 @@ class MixedPrecisionPolicy:
     """Dtype policy: fp32 master params, low-precision compute.
 
     Replaces torch autocast + GradScaler (reference `accelerator.py:528-577`,
-    `utils/modeling.py:2011-2054`): on TPU bf16 compute needs no loss scaling,
-    so the policy is just three dtypes applied functionally.
+    `utils/modeling.py:2011-2054`): bf16 is the TPU-native choice and needs
+    no loss scaling; fp16 is supported and automatically paired with a
+    dynamic loss scaler inside the train step (`DynamicLossScale`,
+    accelerator.py).
     """
 
     param_dtype: Any = jnp.float32
@@ -102,6 +103,15 @@ class MixedPrecisionPolicy:
     @classmethod
     def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
         precision = PrecisionType(precision)
+        if precision == PrecisionType.FP8:
+            # A blanket e4m3 cast would silently produce garbage; real fp8
+            # needs per-tensor scaling (delayed-scaling recipe) that this
+            # framework does not implement yet. Refuse rather than corrupt.
+            raise NotImplementedError(
+                "mixed_precision='fp8' is not implemented: fp8 matmuls need "
+                "per-tensor scaling, not a blanket cast. Use 'bf16' (the "
+                "TPU-native choice) or 'fp16'."
+            )
         if precision == PrecisionType.NO:
             return cls()
         compute = _DTYPES[precision]

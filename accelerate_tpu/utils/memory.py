@@ -1,0 +1,138 @@
+"""Memory utilities: OOM-retry batch-size search + HBM introspection.
+
+Analog of the reference `utils/memory.py` (`find_executable_batch_size`,
+:120-177; `release_memory` :52; `should_reduce_batch_size` :98). The CUDA
+OOM story translates to XLA as follows: an over-HBM allocation surfaces as an
+`XlaRuntimeError` whose message carries ``RESOURCE_EXHAUSTED`` — it can be
+raised at compile time (XLA's static memory planner rejects the program) or
+at execution time (transient allocations). Both are caught; both are retried
+at half the batch size after dropping compiled-executable caches (each cached
+executable pins its workspace reservation).
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Any, Callable
+
+import jax
+
+
+def _logger():
+    # Deferred: utils is imported by state.py, and logging.py imports state —
+    # a top-level import here would close that cycle.
+    from ..logging import get_logger
+
+    return get_logger(__name__)
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop jit caches (and their pinned workspace reservations); optionally
+    run the host GC first so dead device buffers are freed too."""
+    if garbage_collection:
+        gc.collect()
+    jax.clear_caches()
+
+
+def release_memory(*objects: Any) -> list[Any]:
+    """Sever references so device buffers can be freed (reference
+    `utils/memory.py:52`): ``a, b = release_memory(a, b)``."""
+    out = [None for _ in objects]
+    del objects
+    clear_device_cache(garbage_collection=True)
+    return out
+
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "Resource exhausted",
+    "exceeds the limit",  # XLA static planner: "allocation ... exceeds the limit"
+)
+
+
+def should_reduce_batch_size(exception: BaseException) -> bool:
+    """Is this exception an out-of-memory condition worth retrying smaller?
+    (reference `should_reduce_batch_size`, `utils/memory.py:98`)."""
+    if isinstance(exception, MemoryError):
+        return True
+    # XLA OOM surfaces as jax.errors.JaxRuntimeError (a RuntimeError
+    # subclass); compile-time rejections can arrive as ValueError. Either
+    # way the status string carries RESOURCE_EXHAUSTED.
+    if isinstance(exception, (RuntimeError, ValueError)):
+        msg = str(exception)
+        return any(marker in msg for marker in _OOM_MARKERS)
+    return False
+
+
+def find_executable_batch_size(
+    function: Callable | None = None,
+    starting_batch_size: int = 128,
+) -> Callable:
+    """Decorator: run ``function(batch_size, ...)``, halving ``batch_size``
+    on every XLA OOM until it executes or reaches zero (reference
+    `find_executable_batch_size`, `utils/memory.py:120`).
+
+    The wrapped function must take ``batch_size`` as its first parameter —
+    the decorator injects it, callers pass only the remaining arguments::
+
+        @find_executable_batch_size(starting_batch_size=512)
+        def train(batch_size, state):
+            loader = acc.prepare_data_loader(ds, batch_size=batch_size)
+            ...
+
+    Each retry clears compiled caches first: the failed compile's workspace
+    reservation would otherwise still be held during the smaller attempt.
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size, starting_batch_size=starting_batch_size
+        )
+
+    batch_size = starting_batch_size
+    params = list(inspect.signature(function).parameters.keys())
+    if not params or params[0] == "self":
+        # Bound methods would receive batch_size in the `self` slot.
+        raise TypeError(
+            f"{function.__name__} must be a plain function taking `batch_size` "
+            "as its first parameter to use find_executable_batch_size"
+        )
+
+    @functools.wraps(function)
+    def wrapper(*args: Any, **kwargs: Any):
+        nonlocal batch_size
+        while True:
+            if batch_size == 0:
+                raise RuntimeError(
+                    "No executable batch size found: reached zero after "
+                    f"halving from {starting_batch_size}."
+                )
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if not should_reduce_batch_size(e):
+                    raise
+                _logger().warning(
+                    "Batch size %d hit device OOM (%s); retrying with %d",
+                    batch_size,
+                    type(e).__name__,
+                    batch_size // 2,
+                )
+                batch_size //= 2
+                clear_device_cache(garbage_collection=True)
+
+    return wrapper
+
+
+def get_memory_stats(device: jax.Device | None = None) -> dict[str, int]:
+    """Per-device HBM stats from the PJRT client (`bytes_in_use`,
+    `peak_bytes_in_use`, `bytes_limit`, ...). Empty dict on backends that
+    don't expose them (CPU)."""
+    device = device if device is not None else jax.local_devices()[0]
+    try:
+        return dict(device.memory_stats() or {})
+    except Exception:
+        return {}

@@ -1,0 +1,88 @@
+"""Memory utility tests (reference `tests/test_memory_utils.py` strategy:
+synthetic OOM-raising callables drive the retry loop)."""
+
+import pytest
+
+from accelerate_tpu.utils.memory import (
+    clear_device_cache,
+    find_executable_batch_size,
+    get_memory_stats,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+def _oom(message: str = "RESOURCE_EXHAUSTED: Out of memory allocating 1 bytes"):
+    import jax
+
+    try:
+        return jax.errors.JaxRuntimeError(message)
+    except TypeError:  # pragma: no cover - non-constructible in some versions
+        return RuntimeError(message)
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(_oom())
+    assert should_reduce_batch_size(MemoryError())
+    assert should_reduce_batch_size(RuntimeError("Resource exhausted: HBM"))
+    assert not should_reduce_batch_size(ValueError("shape mismatch"))
+    assert not should_reduce_batch_size(KeyError("x"))
+
+
+def test_find_executable_batch_size_halves_until_fit():
+    calls = []
+
+    @find_executable_batch_size(starting_batch_size=128)
+    def run(batch_size, tag):
+        calls.append(batch_size)
+        if batch_size > 32:
+            raise _oom()
+        return batch_size, tag
+
+    result = run("ok")
+    assert result == (32, "ok")
+    assert calls == [128, 64, 32]
+
+
+def test_find_executable_batch_size_non_oom_propagates():
+    @find_executable_batch_size(starting_batch_size=16)
+    def run(batch_size):
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError, match="not an OOM"):
+        run()
+
+
+def test_find_executable_batch_size_exhausted():
+    @find_executable_batch_size(starting_batch_size=4)
+    def run(batch_size):
+        raise _oom()
+
+    with pytest.raises(RuntimeError, match="No executable batch size"):
+        run()
+
+
+def test_find_executable_batch_size_sticky_across_calls():
+    # A second invocation starts from the last working size, not from scratch
+    # (reference behavior: the closure keeps `batch_size`).
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=64)
+    def run(batch_size):
+        attempts.append(batch_size)
+        if batch_size > 16:
+            raise _oom()
+        return batch_size
+
+    assert run() == 16
+    assert run() == 16
+    assert attempts == [64, 32, 16, 16]
+
+
+def test_release_memory_and_stats():
+    a, b = object(), object()
+    a, b = release_memory(a, b)
+    assert a is None and b is None
+    clear_device_cache(garbage_collection=True)
+    stats = get_memory_stats()
+    assert isinstance(stats, dict)  # may be empty on CPU backend

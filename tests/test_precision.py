@@ -1,0 +1,154 @@
+"""Mixed-precision tests: fp16 dynamic loss scaling (the GradScaler analog),
+bf16 policy, fp8 refusal. Reference semantics under test: grads of the scaled
+loss, unscale, skip-update + backoff on overflow, growth after N finite steps
+(`optimizer.py:162-176`, `utils/modeling.py:2054`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator, DynamicLossScale, TrainState
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_init,
+    regression_loss,
+)
+from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+
+def _train(precision: str, steps: int = 80, lr: float = 0.05) -> dict:
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()  # allow two precisions in one test
+    acc = Accelerator(mixed_precision=precision, seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(lr))
+    step = acc.make_train_step(regression_loss)
+    ds = RegressionDataset(length=64)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    return {"params": jax.tree.map(np.asarray, state.params), "metrics": metrics, "state": state}
+
+
+def test_fp16_attaches_loss_scale():
+    acc = Accelerator(mixed_precision="fp16", seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+    assert isinstance(state.loss_scale, DynamicLossScale)
+    assert float(state.loss_scale.scale) == 2.0**15
+
+
+def test_bf16_and_fp32_have_no_scaler():
+    for precision in ("no", "bf16"):
+        acc = Accelerator(mixed_precision=precision, seed=0)
+        state = acc.create_train_state(regression_init, optax.sgd(0.1))
+        assert state.loss_scale is None
+
+
+def test_fp16_matches_fp32_on_regression():
+    ref = _train("no")
+    fp16 = _train("fp16")
+    # fp16 compute on a tiny well-conditioned problem: same optimum.
+    np.testing.assert_allclose(fp16["params"]["a"], ref["params"]["a"], atol=2e-2)
+    np.testing.assert_allclose(fp16["params"]["b"], ref["params"]["b"], atol=2e-2)
+    assert bool(fp16["metrics"]["grads_finite"])
+    assert float(fp16["metrics"]["loss_scale"]) > 0
+
+
+def test_fp16_overflow_skips_update_and_backs_off():
+    acc = Accelerator(mixed_precision="fp16", seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+
+    def loss_fn(params, batch, rng):
+        # batch["boom"] == 1 -> overflow: fp16 max is 65504, squaring 1e4
+        # in fp16 compute produces inf in the gradient path.
+        return jnp.mean(
+            jnp.square(params["a"] * batch["x"] * batch["boom"] + params["b"] - batch["y"])
+        )
+
+    step = acc.make_train_step(loss_fn)
+    good = {"x": jnp.ones((8,)), "y": jnp.zeros((8,)), "boom": jnp.ones(())}
+    bad = {"x": jnp.full((8,), 1e4), "y": jnp.zeros((8,)), "boom": jnp.full((), 1e4)}
+
+    before = jax.tree.map(np.asarray, state.params)
+    scale0 = float(state.loss_scale.scale)
+    state, metrics = step(state, bad)
+    assert not bool(metrics["grads_finite"])
+    # params untouched, scale halved, step still advances
+    after = jax.tree.map(np.asarray, state.params)
+    np.testing.assert_array_equal(after["a"], before["a"])
+    np.testing.assert_array_equal(after["b"], before["b"])
+    assert float(state.loss_scale.scale) == scale0 * 0.5
+    assert int(state.step) == 1
+
+    state, metrics = step(state, good)
+    assert bool(metrics["grads_finite"])
+    after2 = jax.tree.map(np.asarray, state.params)
+    assert after2["a"] != after["a"]  # finite step applied
+
+
+def test_fp16_scale_grows_after_interval():
+    acc = Accelerator(mixed_precision="fp16", seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.01))
+    # Tiny growth interval so the test runs in a handful of steps.
+    state = state.replace(
+        loss_scale=DynamicLossScale.create(init_scale=8.0, growth_interval=3)
+    )
+    step = acc.make_train_step(regression_loss)
+    batch = {"x": jnp.ones((8,)), "y": jnp.ones((8,))}
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    assert float(state.loss_scale.scale) == 16.0
+    assert int(state.loss_scale.growth_counter) == 0
+
+
+def test_fp16_with_grad_accumulation():
+    acc = Accelerator(mixed_precision="fp16", gradient_accumulation_steps=4, seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.05))
+    step = acc.make_train_step(regression_loss)
+    ds = RegressionDataset(length=64)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+    for _ in range(60):
+        state, metrics = step(state, batch)
+    assert bool(metrics["grads_finite"])
+    np.testing.assert_allclose(np.asarray(state.params["a"]), 2.0, atol=0.1)
+
+
+def test_fp8_refused():
+    with pytest.raises(NotImplementedError, match="fp8"):
+        MixedPrecisionPolicy.from_precision("fp8")
+    with pytest.raises(NotImplementedError, match="fp8"):
+        Accelerator(mixed_precision="fp8")
+
+
+def test_fp16_resume_from_scalerless_checkpoint(tmp_path):
+    # A checkpoint written without a scaler (bf16 run, or pre-scaler format)
+    # must load into an fp16 state keeping the fresh scaler, not crash.
+    from accelerate_tpu.state import AcceleratorState
+
+    acc = Accelerator(mixed_precision="bf16", seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+    acc.save_state(str(tmp_path / "ckpt"), state)
+
+    AcceleratorState._reset_state()
+    acc2 = Accelerator(mixed_precision="fp16", seed=0)
+    fresh = acc2.create_train_state(regression_init, optax.sgd(0.1))
+    restored = acc2.load_state(str(tmp_path / "ckpt"), fresh)
+    assert isinstance(restored.loss_scale, DynamicLossScale)
+    assert float(restored.loss_scale.scale) == 2.0**15
+
+
+def test_loss_scale_survives_checkpoint(tmp_path):
+    acc = Accelerator(mixed_precision="fp16", seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+    step = acc.make_train_step(regression_loss)
+    batch = {"x": jnp.ones((8,)), "y": jnp.ones((8,))}
+    state, _ = step(state, batch)
+    acc.save_state(str(tmp_path / "ckpt"), state)
+
+    acc2 = Accelerator(mixed_precision="fp16", seed=0)
+    fresh = acc2.create_train_state(regression_init, optax.sgd(0.1))
+    restored = acc2.load_state(str(tmp_path / "ckpt"), fresh)
+    assert float(restored.loss_scale.scale) == float(state.loss_scale.scale)
+    assert int(restored.loss_scale.growth_counter) == int(state.loss_scale.growth_counter)
