@@ -20,6 +20,12 @@ from .big_modeling import (
 )
 from .data import DataLoader, prepare_data_loader, skip_first_batches
 from .generation import GenerationConfig, Generator, generate
+from .local_sgd import (
+    LocalSGD,
+    make_local_sgd_step,
+    stack_train_state,
+    unstack_train_state,
+)
 from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
 from .parallel.sharding import ShardingStrategy

@@ -725,19 +725,6 @@ class Accelerator:
 
         return contextlib.nullcontext()
 
-    def local_sgd_average(self, state: TrainState) -> TrainState:
-        """Average params across the batch axes (LocalSGD's periodic merge,
-        reference `local_sgd.py:103-106`)."""
-        spec_tree = jax.tree.map(lambda _: PartitionSpec(), state.params)
-        # Params are either replicated (DP) or sharded (FSDP); a psum-mean over
-        # data axes is an average of identical copies under DP — cheap no-op —
-        # and this API is only meaningful for DP/LocalSGD setups.
-        mean_params = jax.jit(
-            lambda p: jax.tree.map(lambda x: x, p),
-            out_shardings=to_named_shardings(spec_tree, self.mesh),
-        )(state.params)
-        return state.replace(params=mean_params)
-
     def __repr__(self) -> str:
         return (
             f"Accelerator(mesh={dict(self.mesh.shape)}, "

@@ -122,19 +122,23 @@ class GeneralTracker:
                 + ", ".join(f"`{m}`" for m in missing)
             )
 
+    # Base implementations are NO-OPS (reference `tracking.py:132-157`): a
+    # `GeneralTracker(_blank=True)` instance is the safe do-nothing tracker
+    # that `Accelerator.get_tracker` hands to non-main processes, so user
+    # code can log through it unguarded anywhere.
     @property
     def tracker(self) -> Any:
         """The raw underlying run/writer object, for direct library access."""
-        raise NotImplementedError
+        return None
 
     def store_init_configuration(self, values: dict) -> None:
-        raise NotImplementedError
+        pass
 
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
-        raise NotImplementedError
+        pass
 
     def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
-        raise NotImplementedError(f"{type(self).__name__} does not support images")
+        pass
 
     def finish(self) -> None:  # optional
         pass

@@ -45,12 +45,12 @@ def release_memory(*objects: Any) -> list[Any]:
     return out
 
 
+# Exact XLA status strings only — broad phrases would misclassify unrelated
+# user errors (e.g. "sequence length exceeds the limit") as retryable OOMs.
 _OOM_MARKERS = (
     "RESOURCE_EXHAUSTED",
     "Out of memory",
-    "out of memory",
     "Resource exhausted",
-    "exceeds the limit",  # XLA static planner: "allocation ... exceeds the limit"
 )
 
 
@@ -59,10 +59,9 @@ def should_reduce_batch_size(exception: BaseException) -> bool:
     (reference `should_reduce_batch_size`, `utils/memory.py:98`)."""
     if isinstance(exception, MemoryError):
         return True
-    # XLA OOM surfaces as jax.errors.JaxRuntimeError (a RuntimeError
-    # subclass); compile-time rejections can arrive as ValueError. Either
-    # way the status string carries RESOURCE_EXHAUSTED.
-    if isinstance(exception, (RuntimeError, ValueError)):
+    # XLA OOM surfaces as jax.errors.JaxRuntimeError, a RuntimeError
+    # subclass, with RESOURCE_EXHAUSTED in the status message.
+    if isinstance(exception, RuntimeError):
         msg = str(exception)
         return any(marker in msg for marker in _OOM_MARKERS)
     return False
@@ -104,17 +103,19 @@ def find_executable_batch_size(
     @functools.wraps(function)
     def wrapper(*args: Any, **kwargs: Any):
         nonlocal batch_size
+        last_oom: Exception | None = None
         while True:
             if batch_size == 0:
                 raise RuntimeError(
                     "No executable batch size found: reached zero after "
                     f"halving from {starting_batch_size}."
-                )
+                ) from last_oom
             try:
                 return function(batch_size, *args, **kwargs)
             except Exception as e:
                 if not should_reduce_batch_size(e):
                     raise
+                last_oom = e
                 _logger().warning(
                     "Batch size %d hit device OOM (%s); retrying with %d",
                     batch_size,

@@ -117,6 +117,16 @@ def test_custom_tracker_subclass(tmp_path):
     assert mine.logged == [(0, {"x": 1.0})]
 
 
+def test_blank_tracker_is_noop():
+    # What get_tracker hands to non-main processes: every method safe.
+    blank = GeneralTracker(_blank=True)
+    blank.store_init_configuration({"a": 1})
+    blank.log({"loss": 1.0}, step=0)
+    blank.log_images({"img": None})
+    blank.finish()
+    assert blank.tracker is None
+
+
 def test_subclass_missing_attrs_raises():
     class Bad(GeneralTracker):
         pass
