@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .accelerator import TrainState
+from .accelerator import TrainState, global_norm
 from .parallel.mesh import BATCH_AXES, data_parallel_size
 
 
@@ -64,13 +64,21 @@ def stack_train_state(state: TrainState, mesh) -> TrainState:
     n = data_parallel_size(mesh)
     sharding = _stacked_sharding(mesh)
 
-    def tile(x):
-        x = jnp.asarray(x)
-        return jax.device_put(jnp.broadcast_to(x[None], (n,) + x.shape), sharding)
+    def tile_tree(tree):
+        # Compile the broadcast with sharded out-shardings so each replica's
+        # copy materializes directly on its own devices — an eager broadcast
+        # would transiently hold the n-times-sized array on one device.
+        shardings = jax.tree.map(lambda _: sharding, tree)
+        return jax.jit(
+            lambda t: jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (n,) + jnp.shape(x)), t
+            ),
+            out_shardings=shardings,
+        )(tree)
 
     return state.replace(
-        params=jax.tree.map(tile, state.params),
-        opt_state=jax.tree.map(tile, state.opt_state),
+        params=tile_tree(state.params),
+        opt_state=tile_tree(state.opt_state),
     )
 
 
@@ -147,16 +155,14 @@ def make_local_sgd_step(
 
         def one_replica(params, opt_state, mb, r):
             (loss, _aux), grads = grad_fn(params, mb, r)
+            gnorm = global_norm(grads)
             if max_grad_norm is not None:
-                gnorm = jnp.sqrt(
-                    sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(grads))
-                )
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * clip, grads)
             updates, new_opt = state.tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_opt, loss
+            return optax.apply_updates(params, updates), new_opt, loss, gnorm
 
-        new_params, new_opt, losses = jax.vmap(one_replica)(
+        new_params, new_opt, losses, gnorms = jax.vmap(one_replica)(
             state.params, state.opt_state, rbatch, rngs
         )
         new_step = state.step + 1
@@ -165,6 +171,8 @@ def make_local_sgd_step(
         # and the cond keeps it OFF the program path on non-sync steps.
         new_params = jax.lax.cond(do_sync, _merge_params, lambda p: p, new_params)
         metrics = {"loss": jnp.mean(losses), "synced": do_sync}
+        if max_grad_norm is not None:
+            metrics["grad_norm"] = jnp.mean(gnorms)
         return (
             state.replace(step=new_step, params=new_params, opt_state=new_opt),
             metrics,

@@ -53,6 +53,19 @@ def maybe_initialize_jax_distributed() -> None:
     with _init_lock:
         if _jax_distributed_initialized:
             return
+        # The env contract must win over a latched platform config: site
+        # hooks (e.g. a TPU-tunnel sitecustomize) may have set jax_platforms
+        # at interpreter start, in which case a child launched with
+        # JAX_PLATFORMS=cpu would silently attach the parent's TPU backend.
+        env_platforms = os.environ.get("JAX_PLATFORMS")
+        if env_platforms:
+            try:
+                from jax._src import xla_bridge as _xb
+
+                if not _xb._backends:  # backends not yet latched
+                    jax.config.update("jax_platforms", env_platforms)
+            except Exception:  # pragma: no cover - private-API move
+                pass
         coordinator = get_str_from_env(
             ("ATX_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"), ""
         )

@@ -109,6 +109,7 @@ class GeneralTracker:
     main_process_only: bool = True
 
     def __init__(self, _blank: bool = False) -> None:
+        self._blank = _blank
         if _blank:
             return
         missing = [
@@ -122,23 +123,31 @@ class GeneralTracker:
                 + ", ".join(f"`{m}`" for m in missing)
             )
 
-    # Base implementations are NO-OPS (reference `tracking.py:132-157`): a
-    # `GeneralTracker(_blank=True)` instance is the safe do-nothing tracker
-    # that `Accelerator.get_tracker` hands to non-main processes, so user
-    # code can log through it unguarded anywhere.
+    # A `GeneralTracker(_blank=True)` instance is the safe do-nothing tracker
+    # that `Accelerator.get_tracker` hands to non-main processes (reference
+    # `accelerator.py:2878-2881`), so user code can log through it unguarded.
+    # Real subclasses that forget to implement a method still fail loudly.
     @property
     def tracker(self) -> Any:
         """The raw underlying run/writer object, for direct library access."""
-        return None
+        if getattr(self, "_blank", False):
+            return None
+        raise NotImplementedError
 
     def store_init_configuration(self, values: dict) -> None:
-        pass
+        if getattr(self, "_blank", False):
+            return
+        raise NotImplementedError
 
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
-        pass
+        if getattr(self, "_blank", False):
+            return
+        raise NotImplementedError
 
     def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
-        pass
+        if getattr(self, "_blank", False):
+            return
+        raise NotImplementedError(f"{type(self).__name__} does not support images")
 
     def finish(self) -> None:  # optional
         pass

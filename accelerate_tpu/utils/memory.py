@@ -59,9 +59,10 @@ def should_reduce_batch_size(exception: BaseException) -> bool:
     (reference `should_reduce_batch_size`, `utils/memory.py:98`)."""
     if isinstance(exception, MemoryError):
         return True
-    # XLA OOM surfaces as jax.errors.JaxRuntimeError, a RuntimeError
-    # subclass, with RESOURCE_EXHAUSTED in the status message.
-    if isinstance(exception, RuntimeError):
+    # Execution OOM surfaces as jax.errors.JaxRuntimeError (a RuntimeError
+    # subclass); compile-time rejections from the static memory planner can
+    # arrive as ValueError. Both carry the RESOURCE_EXHAUSTED status string.
+    if isinstance(exception, (RuntimeError, ValueError)):
         msg = str(exception)
         return any(marker in msg for marker in _OOM_MARKERS)
     return False

@@ -1,0 +1,165 @@
+"""Driver script for REAL multi-process tests (jax.process_count() > 1).
+
+Launched by tests/test_multiprocess.py via `accelerate-tpu launch
+--num_processes N --host_devices K` on CPU — the analog of the reference's
+subprocess-launched distributed scripts (`test_utils/scripts/test_script.py`,
+driven from `tests/test_multigpu.py:50` with `accelerate launch`).
+
+Modes:
+- (default)   full check battery: identity, barriers, collectives, object
+              channel, split_between_processes, end-to-end sharded training,
+              multi-process checkpoint save/load.
+- --mode mismatch   with ATX_DEBUG_MODE=1: feeds shape-mismatched inputs to a
+              collective and asserts `verify_operation` catches it.
+
+Every process must print its final OK line; the pytest wrapper asserts one
+per rank plus exit code 0.
+"""
+
+import argparse
+import os
+import sys
+
+# The launcher execs this file directly; put the repo root on the path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.ops import collectives as ops
+from accelerate_tpu.state import ProcessState
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_init,
+    regression_loss,
+)
+
+
+def check_identity_and_barrier(ps: ProcessState) -> None:
+    n_expected = int(os.environ["ATX_NUM_PROCESSES"])
+    assert ps.num_processes == n_expected, (ps.num_processes, n_expected)
+    assert ps.process_index == int(os.environ["ATX_PROCESS_ID"])
+    assert jax.process_count() == n_expected
+    assert ps.is_main_process == (ps.process_index == 0)
+    ps.wait_for_everyone()
+
+
+def check_collectives(ps: ProcessState) -> None:
+    n, rank = ps.num_processes, ps.process_index
+
+    g = ops.gather(np.full((2, 3), rank, np.float32))
+    assert g.shape == (2 * n, 3), g.shape
+    for r in range(n):
+        assert (g[2 * r : 2 * r + 2] == r).all(), (r, g)
+
+    r_sum = ops.reduce(np.float32([rank + 1.0]), "sum")
+    assert float(r_sum[0]) == n * (n + 1) / 2
+
+    r_mean = ops.reduce({"v": np.float32([2.0 * rank])}, "mean")
+    assert float(r_mean["v"][0]) == float(np.mean([2.0 * i for i in range(n)]))
+
+    b = ops.broadcast(
+        np.arange(4, dtype=np.float32) * (1.0 if rank == 0 else -7.0)
+    )
+    assert (b == np.arange(4, dtype=np.float32)).all(), b
+
+    b1 = ops.broadcast(np.full((3,), float(rank), np.float32), from_process=1)
+    assert (b1 == 1.0).all(), b1
+
+    padded = ops.pad_across_processes(np.ones((rank + 1, 2), np.float32))
+    assert padded.shape == (n, 2), padded.shape
+
+
+def check_object_channel(ps: ProcessState) -> None:
+    n, rank = ps.num_processes, ps.process_index
+
+    objs = ops.gather_object([{"rank": rank, "tag": f"p{rank}"}])
+    assert [o["rank"] for o in objs] == list(range(n)), objs
+
+    lst = ops.broadcast_object_list([f"root-payload-{rank}", rank * 10])
+    assert lst == ["root-payload-0", 0], lst
+
+
+def check_split_between_processes(ps: ProcessState) -> None:
+    n, rank = ps.num_processes, ps.process_index
+    items = list(range(2 * n + 1))
+    with ps.split_between_processes(items) as chunk:
+        local = list(chunk)
+    sizes = ops.gather_object([len(local)])
+    assert sum(sizes) == len(items), (sizes, items)
+    flat = [x for part in ops.gather_object([local]) for x in part]
+    assert flat == items, flat
+
+
+def check_training_and_checkpoint(ps: ProcessState, ckpt_dir: str) -> None:
+    acc = atx.Accelerator(seed=0)
+    assert acc.num_processes == ps.num_processes
+    state = acc.create_train_state(regression_init, optax.sgd(0.05))
+    step = acc.make_train_step(regression_loss, donate=False)
+    loader = acc.prepare_data_loader(RegressionDataset(length=64), batch_size=16)
+
+    losses = []
+    for epoch in range(4):
+        for batch in loader:
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # Params replicated under DP: every process must hold identical values.
+    a_all = ops.gather_object([float(np.asarray(state.params["a"]))])
+    assert max(a_all) - min(a_all) < 1e-6, a_all
+
+    # Multi-process checkpoint round trip into one shared directory.
+    acc.save_state(ckpt_dir, state)
+    acc.wait_for_everyone()
+    state2 = acc.create_train_state(regression_init, optax.sgd(0.05))
+    state2 = acc.load_state(ckpt_dir, state2)
+    assert int(state2.step) == int(state.step)
+    np.testing.assert_allclose(
+        np.asarray(state2.params["a"]), np.asarray(state.params["a"]), rtol=1e-6
+    )
+    gathered_metric = acc.gather(jnp.ones((2,)) * ps.process_index)
+    assert gathered_metric.shape[0] >= ps.num_processes * 2
+
+
+def run_mismatch_mode(ps: ProcessState) -> None:
+    assert ps.debug, "mismatch mode requires ATX_DEBUG_MODE=1"
+    shape = (2,) if ps.process_index == 0 else (3,)
+    try:
+        ops.gather(np.ones(shape, np.float32))
+    except ops.DistributedOperationException as e:
+        assert "Mismatch" in str(e)
+        print(f"[proc {ps.process_index}] MISMATCH DETECTED OK", flush=True)
+        return
+    raise AssertionError("verify_operation failed to flag a shape mismatch")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", default="all", choices=["all", "mismatch"])
+    parser.add_argument("--ckpt_dir", default="")
+    args = parser.parse_args()
+
+    ps = ProcessState()
+    if args.mode == "mismatch":
+        run_mismatch_mode(ps)
+        return 0
+
+    check_identity_and_barrier(ps)
+    check_collectives(ps)
+    check_object_channel(ps)
+    check_split_between_processes(ps)
+    if args.ckpt_dir:
+        check_training_and_checkpoint(ps, args.ckpt_dir)
+    ps.wait_for_everyone()
+    print(f"[proc {ps.process_index}] ALL OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
