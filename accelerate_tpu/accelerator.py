@@ -633,6 +633,10 @@ class Accelerator:
         Device arrays (e.g. the metrics dict a compiled train step returned)
         are synced to host scalars HERE, once, so trackers never touch jax.
         """
+        if not self.trackers:
+            # No device->host sync when nothing consumes the metrics — the
+            # fetch would serialize dispatch on TPU.
+            return
         log_kwargs = log_kwargs or {}
         host_values = {
             k: (float(v) if hasattr(v, "dtype") and getattr(v, "ndim", 1) == 0 else v)

@@ -80,21 +80,7 @@ def main() -> None:
     }
     batch = jax.device_put(batch)
 
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    # A device->host scalar fetch is the only reliable barrier on every
-    # platform (block_until_ready is a no-op through the axon PJRT tunnel);
-    # measure its round-trip once and subtract it from the timed loop.
-    float(metrics["loss"])
-    t0 = time.perf_counter()
-    float(metrics["loss"])
-    fetch_latency = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    dt = max(time.perf_counter() - t0 - fetch_latency, 1e-9)
+    state, metrics, dt, fetch_latency = _timed_steps(step, state, batch, steps, warmup)
 
     tokens_per_step = batch_size * (seq - 1)  # loss_fn shifts by one
     tokens_per_sec = tokens_per_step * steps / dt
@@ -105,6 +91,12 @@ def main() -> None:
     model_flops_per_sec = tokens_per_sec * flops_per_token
     peak = _peak_flops(device)
     mfu = model_flops_per_sec / peak if peak else 0.0
+
+    # Free the Llama state/opt buffers before the BERT measurement — both
+    # would not fit HBM together.
+    final_loss = round(float(metrics["loss"]), 4)
+    state, batch, metrics = acc.free_memory(state, batch, metrics)
+    bert_stats = _bench_bert(on_tpu, fetch_latency)
 
     print(
         json.dumps(
@@ -117,10 +109,71 @@ def main() -> None:
                 "step_time_ms": round(1000 * dt / steps, 2),
                 "params": n_params,
                 "device": getattr(device, "device_kind", str(device)),
-                "loss": round(float(metrics["loss"]), 4),
+                "loss": final_loss,
+                **bert_stats,
             }
         )
     )
+
+
+def _timed_steps(step, state, batch, steps: int, warmup: int, fetch_latency: float | None = None):
+    """Warm up, then time `steps` train steps.
+
+    A device->host scalar fetch is the only reliable barrier on every
+    platform (block_until_ready is a no-op through the axon PJRT tunnel);
+    its round trip is measured once and subtracted from the timed loop.
+    Returns (state, metrics, dt_seconds, fetch_latency).
+    """
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    if fetch_latency is None:
+        t0 = time.perf_counter()
+        float(metrics["loss"])
+        fetch_latency = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    dt = max(time.perf_counter() - t0 - fetch_latency, 1e-9)
+    return state, metrics, dt, fetch_latency
+
+
+def _bench_bert(on_tpu: bool, fetch_latency: float) -> dict:
+    """BERT-base training throughput — the `nlp_example` config BASELINE.md
+    tracks (samples/sec/chip, bf16, seq 128). Returned as extra fields on the
+    bench's single JSON line."""
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.models import bert
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    if on_tpu:
+        config = bert.BertConfig.bert_base()
+        batch_size, seq, steps, warmup = 128, 128, 10, 3
+    else:
+        config = bert.BertConfig.tiny()
+        batch_size, seq, steps, warmup = 8, 32, 3, 1
+
+    acc = atx.Accelerator(mixed_precision="bf16", seed=0, max_grad_norm=1.0)
+    state = acc.create_train_state(lambda r: bert.init(r, config), optax.adamw(3e-5))
+    step = acc.make_train_step(lambda p, b, r: bert.loss_fn(p, b, config, r))
+    rng = jax.random.PRNGKey(2)
+    batch = {
+        "input_ids": jax.random.randint(rng, (batch_size, seq), 3, config.vocab_size, jnp.int32),
+        "attention_mask": jnp.ones((batch_size, seq), jnp.int32),
+        "token_type_ids": jnp.zeros((batch_size, seq), jnp.int32),
+        "labels": jax.random.randint(rng, (batch_size,), 0, config.num_labels, jnp.int32),
+    }
+    batch = jax.device_put(batch)
+    state, metrics, dt, _ = _timed_steps(step, state, batch, steps, warmup, fetch_latency)
+    return {
+        "bert_samples_per_sec": round(batch_size * steps / dt, 1),
+        "bert_step_time_ms": round(1000 * dt / steps, 2),
+        "bert_params": config.param_count(),
+    }
 
 
 if __name__ == "__main__":

@@ -1,0 +1,177 @@
+"""Image classification — the framework's `cv_example`.
+
+TPU-native analog of the reference `examples/cv_example.py` (resnet50 on a
+pets folder): same training shape — image batches, data-parallel training,
+per-epoch eval accuracy — with a small convnet defined inline in example
+code (conv stacks map straight onto the MXU via `lax.conv_general_dilated`)
+and synthetic data (no network egress for an image dataset here).
+
+Task: 4-way classification of which quadrant of a noisy 32x32 image holds a
+bright 8x8 patch — learnable only through spatial feature extraction, so a
+working conv pipeline is demonstrated, not label memorization.
+
+Run:
+    python examples/cv_example.py
+    accelerate-tpu launch examples/cv_example.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+
+
+class QuadrantDataset:
+    """Noisy images with one bright patch; label = quadrant index (0-3)."""
+
+    def __init__(self, size: int, image_size: int, seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        s, p = image_size, image_size // 4
+        images = rng.normal(0.0, 0.3, size=(size, s, s, 1)).astype(np.float32)
+        labels = rng.integers(0, 4, size=size).astype(np.int32)
+        half = s // 2
+        for i in range(size):
+            qy, qx = divmod(int(labels[i]), 2)
+            y = rng.integers(0, half - p) + qy * half
+            x = rng.integers(0, half - p) + qx * half
+            images[i, y : y + p, x : x + p, 0] += 2.0
+        self.images, self.labels = images, labels
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        return {"image": self.images[i], "label": self.labels[i]}
+
+
+def init_convnet(rng: jax.Array, image_size: int = 32, channels=(16, 32), num_labels: int = 4):
+    keys = jax.random.split(rng, len(channels) + 1)
+    params, c_in = [], 1
+    for k, c_out in zip(keys[:-1], channels):
+        params.append(
+            {
+                "w": jax.random.normal(k, (3, 3, c_in, c_out)) * (2.0 / (9 * c_in)) ** 0.5,
+                "b": jnp.zeros((c_out,)),
+            }
+        )
+        c_in = c_out
+    # Flatten, not global-average-pool: the label IS a spatial property
+    # (which quadrant), so the head must see feature positions.
+    side = image_size
+    for _ in channels:
+        side = -(-side // 2)  # SAME padding, stride 2 -> ceil
+    feat = side * side * c_in
+    head = {
+        "w": jax.random.normal(keys[-1], (feat, num_labels)) * (1.0 / feat) ** 0.5,
+        "b": jnp.zeros((num_labels,)),
+    }
+    return {"convs": params, "head": head}
+
+
+def convnet_logits(params, images: jax.Array) -> jax.Array:
+    x = images
+    for layer in params["convs"]:
+        x = jax.lax.conv_general_dilated(
+            x,
+            layer["w"].astype(x.dtype),
+            window_strides=(2, 2),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.relu(x + layer["b"].astype(x.dtype))
+    x = x.reshape(x.shape[0], -1)
+    return x @ params["head"]["w"].astype(x.dtype) + params["head"]["b"].astype(x.dtype)
+
+
+def loss_fn(params, batch, rng=None) -> jax.Array:
+    logits = convnet_logits(params, batch["image"]).astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logprobs, batch["label"][:, None], axis=-1))
+
+
+def training_function(args) -> float:
+    accelerator = atx.Accelerator(
+        mixed_precision=args.mixed_precision,
+        # batch_size below is the GLOBAL batch (reference example semantics);
+        # split_batches divides it across the data-parallel replicas.
+        dataloader_config=atx.DataLoaderConfiguration(split_batches=True),
+        log_with="json" if args.project_dir else None,
+        project_dir=args.project_dir or None,
+        seed=args.seed,
+    )
+    train_dl = accelerator.prepare_data_loader(
+        QuadrantDataset(args.train_size, args.image_size, seed=0),
+        batch_size=args.batch_size,
+        shuffle=True,
+        seed=42,
+    )
+    eval_dl = accelerator.prepare_data_loader(
+        QuadrantDataset(args.eval_size, args.image_size, seed=1),
+        batch_size=args.batch_size,
+    )
+
+    tx = optax.adam(args.lr)
+    state = accelerator.create_train_state(
+        lambda r: init_convnet(r, image_size=args.image_size), tx
+    )
+    train_step = accelerator.make_train_step(loss_fn)
+    eval_step = accelerator.make_eval_step(
+        lambda params, batch: jnp.argmax(convnet_logits(params, batch["image"]), axis=-1)
+    )
+    if accelerator.log_with:
+        accelerator.init_trackers("cv_example", config=vars(args))
+
+    accuracy = 0.0
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            state, metrics = train_step(state, batch)
+            accelerator.log(metrics, step=state.step)
+        correct = total = 0
+        for batch in eval_dl:
+            preds = eval_step(state, batch)
+            preds, labels = accelerator.gather_for_metrics((preds, batch["label"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accuracy = correct / max(total, 1)
+        accelerator.print(
+            f"epoch {epoch}: accuracy {accuracy:.3f} "
+            f"(train loss {float(metrics['loss']):.4f})"
+        )
+        accelerator.log({"eval_accuracy": accuracy, "epoch": epoch}, step=state.step)
+
+    accelerator.end_training()
+    return accuracy
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--num_epochs", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--image_size", type=int, default=32)
+    parser.add_argument("--train_size", type=int, default=512)
+    parser.add_argument("--eval_size", type=int, default=128)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--project_dir", default="")
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> float:
+    return training_function(parse_args(argv))
+
+
+if __name__ == "__main__":
+    acc = main()
+    print(f"final_accuracy={acc:.3f}")

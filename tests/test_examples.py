@@ -1,0 +1,88 @@
+"""Example-script tests (reference `tests/test_examples.py` strategy: run the
+example mains with small args and assert they learn)."""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO_ROOT, "examples")
+
+
+def _load(name: str):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(EXAMPLES, f"{name}.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_nlp_example_learns(tmp_path):
+    m = _load("nlp_example")
+    accuracy = m.main(
+        [
+            "--num_epochs", "4",
+            "--lr", "3e-3",
+            "--train_size", "1024",
+            "--eval_size", "128",
+            "--batch_size", "64",
+            "--seq_len", "32",
+            "--vocab_size", "32",
+            "--project_dir", str(tmp_path),
+            "--checkpoint_dir", str(tmp_path / "ckpt"),
+        ]
+    )
+    assert accuracy > 0.7, accuracy
+    # trackers wrote the loss curve; checkpoint written
+    metrics = (tmp_path / "nlp_example" / "metrics.jsonl").read_text().splitlines()
+    assert any("eval_accuracy" in json.loads(l) for l in metrics)
+    assert (tmp_path / "ckpt").exists()
+
+
+def test_cv_example_learns(tmp_path):
+    m = _load("cv_example")
+    accuracy = m.main(
+        [
+            "--num_epochs", "2",
+            "--train_size", "256",
+            "--eval_size", "128",
+            "--batch_size", "64",
+            "--project_dir", str(tmp_path),
+        ]
+    )
+    assert accuracy > 0.85, accuracy
+
+
+@pytest.mark.multiprocess
+def test_nlp_example_under_launcher_two_processes():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS") and not k.startswith("ATX_")
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+            "--num_processes", "2",
+            "--host_devices", "1",
+            "--coordinator_address", f"127.0.0.1:{port}",
+            "--mixed_precision", "no",
+            os.path.join(EXAMPLES, "nlp_example.py"),
+            "--num_epochs", "1",
+            "--train_size", "128",
+            "--eval_size", "64",
+            "--batch_size", "32",
+            "--seq_len", "32",
+            "--vocab_size", "32",
+        ],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "epoch 0" in proc.stdout
