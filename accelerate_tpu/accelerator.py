@@ -143,7 +143,6 @@ class Accelerator:
         project_dir: str | None = None,
         log_with: Any = None,
         seed: int | None = None,
-        step_scheduler_with_optimizer: bool = True,
     ) -> None:
         self.state = AcceleratorState(mesh_config=mesh_config, mixed_precision=mixed_precision)
         self.process_state = ProcessState()
@@ -164,7 +163,6 @@ class Accelerator:
         self.max_grad_norm = max_grad_norm
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
         self.project_config = project_config or ProjectConfiguration(project_dir=project_dir)
-        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self.rng = _set_seed(seed) if seed is not None else jax.random.PRNGKey(0)
         self.trackers: list[Any] = []
         self.log_with = log_with
@@ -406,6 +404,11 @@ class Accelerator:
         policy = self.policy
         max_grad_norm = self.max_grad_norm
         use_scaler = policy.compute_dtype == jnp.float16
+        if self.strategy.fsdp.activation_checkpointing:
+            # Rematerialize the whole forward during backward (FSDP plugin
+            # `activation_checkpointing`, reference `dataclasses.py:1515`);
+            # models with internal per-block remat flags need no plugin help.
+            loss_fn = jax.checkpoint(loss_fn)
 
         def compute_loss(params: Any, batch: Any, rng: jax.Array, scale: jax.Array):
             cparams = policy.cast_for_compute(params)
@@ -703,6 +706,18 @@ class Accelerator:
         from . import checkpointing
 
         return checkpointing.load_state(self, input_dir, state, **kwargs)
+
+    def save_model(self, params: Any, output_dir: str, **kwargs: Any) -> str:
+        """Params-only inference checkpoint (reference `save_model`,
+        `accelerator.py:3020`). Layout follows the FSDP plugin's
+        ``state_dict_type``: FULL_STATE_DICT consolidates to one file,
+        SHARDED_STATE_DICT keeps per-process shards."""
+        from . import checkpointing
+
+        kwargs.setdefault(
+            "consolidate", self.strategy.fsdp.state_dict_type == "FULL_STATE_DICT"
+        )
+        return checkpointing.save_model(self, params, output_dir, **kwargs)
 
     # -------------------------------------------------------------- profiling
     def profile(self, profile_kwargs: Any = None):

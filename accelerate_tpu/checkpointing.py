@@ -638,6 +638,12 @@ def save_model(
     save_pytree(params, model_dir)
     # Every host must finish writing its shard files before the merge reads.
     accelerator.process_state.wait_for_everyone()
-    if consolidate and jax.process_index() == 0:
-        return consolidate_checkpoint(model_dir, os.path.join(output_dir, "model.npz"))
-    return model_dir
+    if not consolidate:
+        return model_dir
+    merged = os.path.join(output_dir, "model.npz")
+    if jax.process_index() == 0:
+        consolidate_checkpoint(model_dir, merged)
+    # All ranks return the same (fully written) merged path: barrier after
+    # the merge so rank>0 never sees a missing/partial model.npz.
+    accelerator.process_state.wait_for_everyone()
+    return merged

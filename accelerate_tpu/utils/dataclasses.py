@@ -148,13 +148,16 @@ class GradientAccumulationPlugin:
 
 @dataclass
 class DataLoaderConfiguration:
-    """Reference `DataLoaderConfiguration` (`dataclasses.py:762`)."""
+    """Reference `DataLoaderConfiguration` (`dataclasses.py:762`).
+
+    Two reference knobs intentionally have no analog here: samplers are
+    always deterministic-seedable (`use_seedable_sampler` is permanently on
+    by construction, `data/sampler.py`), and host->device prefetch is always
+    asynchronous (`non_blocking`)."""
 
     split_batches: bool = False
     dispatch_batches: bool | None = None
     even_batches: bool = True
-    use_seedable_sampler: bool = True
-    non_blocking: bool = True  # device prefetch is always async on TPU
     prefetch_size: int = 2
 
 
@@ -184,24 +187,32 @@ class FsdpPlugin:
 
     ``min_weight_size`` mirrors size-based auto-wrap: tensors smaller than
     this stay replicated (sharding tiny params wastes collective latency).
-    ``state_dict_type`` chooses consolidated vs sharded checkpoint layout
-    (reference FULL_STATE_DICT / SHARDED_STATE_DICT, `constants.py:39`).
+    ``state_dict_type`` chooses consolidated vs sharded layout for
+    `Accelerator.save_model` (reference FULL_STATE_DICT / SHARDED_STATE_DICT,
+    `constants.py:39`). ``activation_checkpointing`` wraps the loss in
+    `jax.checkpoint`, rematerializing the forward during backward.
+
+    Reference knobs with no analog: ``reshard_after_forward`` (XLA owns the
+    gather/reshard schedule under GSPMD — there is no user-visible
+    FULL_SHARD vs SHARD_GRAD_OP choice) and training-time ``cpu_offload``
+    (host offload exists for inference in `big_modeling.offload_blocks`).
     """
 
-    reshard_after_forward: bool = True  # FULL_SHARD vs SHARD_GRAD_OP analog
     min_weight_size: int = 2**11
     state_dict_type: str = "SHARDED_STATE_DICT"
-    cpu_offload: bool = False
     activation_checkpointing: bool = False
 
     def __post_init__(self) -> None:
-        if parse_flag_from_env("ATX_FSDP_CPU_OFFLOAD"):
-            self.cpu_offload = True
         if parse_flag_from_env("ATX_FSDP_ACTIVATION_CHECKPOINTING"):
             self.activation_checkpointing = True
         env_sdt = os.environ.get("ATX_FSDP_STATE_DICT_TYPE")
         if env_sdt:
             self.state_dict_type = env_sdt
+        if self.state_dict_type not in ("SHARDED_STATE_DICT", "FULL_STATE_DICT"):
+            raise ValueError(
+                f"state_dict_type must be SHARDED_STATE_DICT or FULL_STATE_DICT, "
+                f"got {self.state_dict_type!r}"
+            )
 
 
 @dataclass

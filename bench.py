@@ -96,7 +96,10 @@ def main() -> None:
     # would not fit HBM together.
     final_loss = round(float(metrics["loss"]), 4)
     state, batch, metrics = acc.free_memory(state, batch, metrics)
-    bert_stats = _bench_bert(on_tpu, fetch_latency)
+    try:
+        bert_stats = _bench_bert(on_tpu, fetch_latency)
+    except Exception as e:  # never lose the headline MFU number
+        bert_stats = {"bert_error": f"{type(e).__name__}: {e}"[:200]}
 
     print(
         json.dumps(

@@ -1,0 +1,71 @@
+"""Shared helpers for tests that launch real multi-process jobs through the
+framework's CLI launcher (used by test_multiprocess.py and test_examples.py)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def clean_env(extra: dict | None = None) -> dict:
+    """Parent pytest simulates an 8-device TPU (conftest.py); launched
+    children must build their own world from the launcher contract alone."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS") and not k.startswith("ATX_")
+    }
+    env.update(extra or {})
+    return env
+
+
+def launch(
+    script: str,
+    *script_args: str,
+    num_processes: int = 2,
+    host_devices: int = 1,
+    env_extra: dict | None = None,
+    timeout: int = 240,
+) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable,
+        "-m",
+        "accelerate_tpu.commands.cli",
+        "launch",
+        "--num_processes",
+        str(num_processes),
+        "--host_devices",
+        str(host_devices),
+        "--coordinator_address",
+        f"127.0.0.1:{free_port()}",
+        "--mixed_precision",
+        "no",
+        script,
+        *script_args,
+    ]
+    return subprocess.run(
+        cmd,
+        cwd=REPO_ROOT,
+        env=clean_env(env_extra),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def assert_all_ranks(proc: subprocess.CompletedProcess, marker: str, n: int) -> None:
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    for rank in range(n):
+        assert f"[proc {rank}] {marker}" in proc.stdout, (
+            f"missing '{marker}' from proc {rank}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )

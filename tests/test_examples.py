@@ -4,13 +4,11 @@ example mains with small args and assert they learn)."""
 import importlib.util
 import json
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from launch_helpers import REPO_ROOT, launch
+
 EXAMPLES = os.path.join(REPO_ROOT, "examples")
 
 
@@ -59,30 +57,17 @@ def test_cv_example_learns(tmp_path):
 
 @pytest.mark.multiprocess
 def test_nlp_example_under_launcher_two_processes():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS") and not k.startswith("ATX_")
-    }
-    proc = subprocess.run(
-        [
-            sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
-            "--num_processes", "2",
-            "--host_devices", "1",
-            "--coordinator_address", f"127.0.0.1:{port}",
-            "--mixed_precision", "no",
-            os.path.join(EXAMPLES, "nlp_example.py"),
-            "--num_epochs", "1",
-            "--train_size", "128",
-            "--eval_size", "64",
-            "--batch_size", "32",
-            "--seq_len", "32",
-            "--vocab_size", "32",
-        ],
-        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=600,
+    proc = launch(
+        os.path.join(EXAMPLES, "nlp_example.py"),
+        "--num_epochs", "1",
+        "--train_size", "128",
+        "--eval_size", "64",
+        "--batch_size", "32",
+        "--seq_len", "32",
+        "--vocab_size", "32",
+        num_processes=2,
+        host_devices=1,
+        timeout=600,
     )
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "epoch 0" in proc.stdout

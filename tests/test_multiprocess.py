@@ -10,99 +10,52 @@ mismatch detection) cannot run under the in-process 8-device simulation.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from launch_helpers import REPO_ROOT, assert_all_ranks, clean_env, free_port, launch
+
 DRIVER = os.path.join(REPO_ROOT, "tests", "scripts", "distributed_checks.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _launch(
-    *script_args: str,
-    num_processes: int = 2,
-    host_devices: int = 1,
-    env_extra: dict | None = None,
-    timeout: int = 240,
-) -> subprocess.CompletedProcess:
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        # The pytest process simulates an 8-device TPU (conftest.py); children
-        # must build their own world from the launcher contract alone.
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS") and not k.startswith("ATX_")
-    }
-    env.update(env_extra or {})
-    cmd = [
-        sys.executable,
-        "-m",
-        "accelerate_tpu.commands.cli",
-        "launch",
-        "--num_processes",
-        str(num_processes),
-        "--host_devices",
-        str(host_devices),
-        "--coordinator_address",
-        f"127.0.0.1:{_free_port()}",
-        "--mixed_precision",
-        "no",
-        DRIVER,
-        *script_args,
-    ]
-    return subprocess.run(
-        cmd, cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=timeout
-    )
-
-
-def _assert_ok(proc: subprocess.CompletedProcess, marker: str, n: int) -> None:
-    assert proc.returncode == 0, f"rc={proc.returncode}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
-    for rank in range(n):
-        assert f"[proc {rank}] {marker}" in proc.stdout, (
-            f"missing '{marker}' from proc {rank}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
-        )
 
 
 @pytest.mark.multiprocess
 def test_two_process_collectives_and_checkpoint(tmp_path):
-    proc = _launch(
+    proc = launch(
+        DRIVER,
         "--ckpt_dir",
         str(tmp_path / "ckpt"),
         num_processes=2,
         host_devices=2,
     )
-    _assert_ok(proc, "ALL OK", 2)
+    assert_all_ranks(proc, "ALL OK", 2)
 
 
 @pytest.mark.multiprocess
 def test_four_process_collectives(tmp_path):
-    proc = _launch(
+    proc = launch(
+        DRIVER,
         "--ckpt_dir",
         str(tmp_path / "ckpt"),
         num_processes=4,
         host_devices=1,
         timeout=360,
     )
-    _assert_ok(proc, "ALL OK", 4)
+    assert_all_ranks(proc, "ALL OK", 4)
 
 
 @pytest.mark.multiprocess
 def test_debug_mode_flags_collective_mismatch():
-    proc = _launch(
+    proc = launch(
+        DRIVER,
         "--mode",
         "mismatch",
         num_processes=2,
         host_devices=1,
         env_extra={"ATX_DEBUG_MODE": "1"},
     )
-    _assert_ok(proc, "MISMATCH DETECTED OK", 2)
+    assert_all_ranks(proc, "MISMATCH DETECTED OK", 2)
 
 
 @pytest.mark.multiprocess
@@ -119,11 +72,6 @@ def test_failed_worker_tears_down_job(tmp_path):
         "    sys.exit(17)\n"
         "ps.wait_for_everyone()\n" % REPO_ROOT
     )
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS") and not k.startswith("ATX_")
-    }
     cmd = [
         sys.executable,
         "-m",
@@ -134,10 +82,10 @@ def test_failed_worker_tears_down_job(tmp_path):
         "--host_devices",
         "1",
         "--coordinator_address",
-        f"127.0.0.1:{_free_port()}",
+        f"127.0.0.1:{free_port()}",
         str(crasher),
     ]
     proc = subprocess.run(
-        cmd, cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=180
+        cmd, cwd=REPO_ROOT, env=clean_env(), capture_output=True, text=True, timeout=180
     )
     assert proc.returncode != 0
