@@ -28,6 +28,7 @@ from .local_sgd import (
 )
 from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
+from .parallel.pipeline import Pipeline, llama_pipeline
 from .parallel.sharding import ShardingStrategy
 from .state import AcceleratorState, GradientState, ProcessState
 from .tracking import GeneralTracker, JSONTracker, TensorBoardTracker, WandBTracker

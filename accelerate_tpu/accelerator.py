@@ -404,11 +404,6 @@ class Accelerator:
         policy = self.policy
         max_grad_norm = self.max_grad_norm
         use_scaler = policy.compute_dtype == jnp.float16
-        if self.strategy.fsdp.activation_checkpointing:
-            # Rematerialize the whole forward during backward (FSDP plugin
-            # `activation_checkpointing`, reference `dataclasses.py:1515`);
-            # models with internal per-block remat flags need no plugin help.
-            loss_fn = jax.checkpoint(loss_fn)
 
         def compute_loss(params: Any, batch: Any, rng: jax.Array, scale: jax.Array):
             cparams = policy.cast_for_compute(params)

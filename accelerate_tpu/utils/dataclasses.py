@@ -189,22 +189,25 @@ class FsdpPlugin:
     this stay replicated (sharding tiny params wastes collective latency).
     ``state_dict_type`` chooses consolidated vs sharded layout for
     `Accelerator.save_model` (reference FULL_STATE_DICT / SHARDED_STATE_DICT,
-    `constants.py:39`). ``activation_checkpointing`` wraps the loss in
-    `jax.checkpoint`, rematerializing the forward during backward.
+    `constants.py:39`).
 
-    Reference knobs with no analog: ``reshard_after_forward`` (XLA owns the
-    gather/reshard schedule under GSPMD — there is no user-visible
-    FULL_SHARD vs SHARD_GRAD_OP choice) and training-time ``cpu_offload``
-    (host offload exists for inference in `big_modeling.offload_blocks`).
+    Reference knobs with no analog here:
+    - ``reshard_after_forward``: XLA owns the gather/reshard schedule under
+      GSPMD — there is no user-visible FULL_SHARD vs SHARD_GRAD_OP choice.
+    - training-time ``cpu_offload``: host offload exists for inference in
+      `big_modeling.offload_blocks`.
+    - ``activation_checkpointing``: activation remat must be segmented
+      per block *inside* the layer scan to reduce peak memory (one
+      `jax.checkpoint` around the whole loss recomputes everything while
+      changing peak HBM ~not at all); it is therefore a model-structure
+      concern — set ``remat=True`` (and ``remat_policy``) on the model
+      config (`LlamaConfig.remat`, `BertConfig.remat`).
     """
 
     min_weight_size: int = 2**11
     state_dict_type: str = "SHARDED_STATE_DICT"
-    activation_checkpointing: bool = False
 
     def __post_init__(self) -> None:
-        if parse_flag_from_env("ATX_FSDP_ACTIVATION_CHECKPOINTING"):
-            self.activation_checkpointing = True
         env_sdt = os.environ.get("ATX_FSDP_STATE_DICT_TYPE")
         if env_sdt:
             self.state_dict_type = env_sdt

@@ -16,32 +16,32 @@ from accelerate_tpu.test_utils.training import regression_init, regression_loss
 from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration, FsdpPlugin
 
 
-def _train(plugin: FsdpPlugin | None, steps: int = 5):
-    from accelerate_tpu.state import AcceleratorState
+def test_model_level_remat_is_the_activation_checkpointing_path():
+    # The FsdpPlugin deliberately has NO activation_checkpointing knob: remat
+    # must be segmented per block inside the layer scan to cut peak memory,
+    # so it lives on the model config. Assert the wiring is real: remat=True
+    # changes the compiled program, numerics stay identical.
+    from accelerate_tpu.models import llama
 
-    AcceleratorState._reset_state()
-    acc = Accelerator(seed=0, strategy=plugin or "FSDP")
-    state = acc.create_train_state(regression_init, optax.sgd(0.1))
-    step = acc.make_train_step(regression_loss)
-    batch = {"x": jnp.arange(8.0), "y": 2.0 * jnp.arange(8.0) + 1.0}
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    return jax.tree.map(np.asarray, state.params), float(metrics["loss"])
+    config_plain = llama.LlamaConfig.tiny(remat=False)
+    config_remat = llama.LlamaConfig.tiny(remat=True)
+    params = llama.init(jax.random.PRNGKey(0), config_plain)
+    tokens = jnp.zeros((2, 8), jnp.int32)
 
+    def grads(config):
+        def loss(p):
+            return llama.loss_fn(p, {"input_ids": tokens}, config)
 
-def test_activation_checkpointing_is_numerically_transparent():
-    base_params, base_loss = _train(FsdpPlugin(activation_checkpointing=False))
-    remat_params, remat_loss = _train(FsdpPlugin(activation_checkpointing=True))
-    np.testing.assert_allclose(remat_params["a"], base_params["a"], rtol=1e-6)
-    assert remat_loss == pytest.approx(base_loss, rel=1e-6)
+        return jax.grad(loss)(params)
 
-
-def test_activation_checkpointing_env_contract():
-    os.environ["ATX_FSDP_ACTIVATION_CHECKPOINTING"] = "1"
-    try:
-        assert FsdpPlugin().activation_checkpointing
-    finally:
-        del os.environ["ATX_FSDP_ACTIVATION_CHECKPOINTING"]
+    jaxpr_plain = str(jax.make_jaxpr(lambda: grads(config_plain))())
+    jaxpr_remat = str(jax.make_jaxpr(lambda: grads(config_remat))())
+    assert "remat" not in jaxpr_plain
+    assert "remat" in jaxpr_remat
+    g1, g2 = grads(config_plain), grads(config_remat)
+    np.testing.assert_allclose(
+        np.asarray(g1["embed"]), np.asarray(g2["embed"]), rtol=1e-5, atol=1e-6
+    )
 
 
 def test_state_dict_type_drives_save_model_layout(tmp_path):
@@ -67,6 +67,8 @@ def test_removed_knobs_are_gone():
         FsdpPlugin(reshard_after_forward=False)
     with pytest.raises(TypeError):
         FsdpPlugin(cpu_offload=True)
+    with pytest.raises(TypeError):
+        FsdpPlugin(activation_checkpointing=True)
     with pytest.raises(TypeError):
         DataLoaderConfiguration(use_seedable_sampler=False)
     with pytest.raises(TypeError):
