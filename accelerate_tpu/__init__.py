@@ -20,6 +20,7 @@ from .big_modeling import (
 )
 from .data import DataLoader, prepare_data_loader, skip_first_batches
 from .generation import GenerationConfig, Generator, generate
+from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import (
     LocalSGD,
     make_local_sgd_step,

@@ -170,6 +170,27 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
             p.kill()
 
 
+def build_tpu_ssh_command(
+    tpu_name: str, tpu_zone: str, tpu_project: str | None, remote: str
+) -> list[str]:
+    """`gcloud compute tpus tpu-vm ssh --worker=all` invocation shared by
+    `launch` (pod training) and `tpu-config` (pod setup)."""
+    gcloud = [
+        "gcloud",
+        "compute",
+        "tpus",
+        "tpu-vm",
+        "ssh",
+        tpu_name,
+        f"--zone={tpu_zone}",
+        "--worker=all",
+        f"--command={remote}",
+    ]
+    if tpu_project:
+        gcloud.insert(5, f"--project={tpu_project}")
+    return gcloud
+
+
 def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     """Run the training command on every pod worker via gcloud SSH
     (reference `tpu_pod_launcher`, `commands/launch.py:909`)."""
@@ -178,19 +199,7 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
         for k, v in build_child_env(cfg, None, base={}).items()
     )
     remote = f"{env_exports} {' '.join(shlex.quote(c) for c in cmd)}"
-    gcloud = [
-        "gcloud",
-        "compute",
-        "tpus",
-        "tpu-vm",
-        "ssh",
-        cfg.tpu_name,
-        f"--zone={cfg.tpu_zone}",
-        "--worker=all",
-        f"--command={remote}",
-    ]
-    if cfg.tpu_project:
-        gcloud.insert(5, f"--project={cfg.tpu_project}")
+    gcloud = build_tpu_ssh_command(cfg.tpu_name, cfg.tpu_zone, cfg.tpu_project, remote)
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in gcloud))
         return 0

@@ -1,0 +1,100 @@
+"""`accelerate-tpu tpu-config` — run setup commands on every pod worker.
+
+Analog of the reference `commands/tpu.py` (`tpu_command_launcher`: gcloud
+ssh --worker=all to prepare a pod before `accelerate launch`). Commands are
+joined with `;` and executed on each worker; `--install_accelerate_tpu`
+prepends the framework install. `--debug` prints instead of running.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+
+from .config import load_default_config
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "tpu-config", help="Run setup commands on all TPU pod workers"
+    )
+    p.add_argument("--config_file", default=None, help="Launch config file with tpu_name/zone")
+    p.add_argument("--tpu_name", default=None, help="GCE TPU name")
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--tpu_project", default=None)
+    p.add_argument(
+        "--command",
+        action="append",
+        dest="worker_commands",  # `command` is the CLI subparser dest
+        default=None,
+        help="Command to run on each worker; repeatable",
+    )
+    p.add_argument(
+        "--command_file",
+        default=None,
+        help="File with one command per line to run on each worker",
+    )
+    p.add_argument(
+        "--install_accelerate_tpu",
+        action="store_true",
+        help="Prepend a pip install of this framework",
+    )
+    p.add_argument(
+        "--accelerate_tpu_version",
+        default="latest",
+        help="Version to install ('latest' = upgrade to newest release)",
+    )
+    p.add_argument(
+        "--debug", action="store_true", help="Print the gcloud command, don't run it"
+    )
+    p.set_defaults(func=run)
+
+
+def build_gcloud_command(args: argparse.Namespace) -> tuple[list[str], str]:
+    cfg = None
+    if args.config_file:
+        from .config import LaunchConfig
+
+        cfg = LaunchConfig.load(args.config_file)
+    else:
+        cfg = load_default_config()
+
+    tpu_name = args.tpu_name or (cfg.tpu_name if cfg else None)
+    tpu_zone = args.tpu_zone or (cfg.tpu_zone if cfg else None)
+    tpu_project = args.tpu_project or (cfg.tpu_project if cfg else None)
+    if not tpu_name or not tpu_zone:
+        raise ValueError(
+            "tpu-config needs --tpu_name and --tpu_zone (or a config file "
+            "that sets them)"
+        )
+
+    commands: list[str] = []
+    if args.install_accelerate_tpu:
+        if args.accelerate_tpu_version == "latest":
+            commands.append("pip install -U accelerate-tpu")
+        else:
+            commands.append(f"pip install accelerate-tpu=={args.accelerate_tpu_version}")
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands.extend(line.strip() for line in f if line.strip())
+    if args.worker_commands:
+        commands.extend(args.worker_commands)
+    if not commands:
+        raise ValueError(
+            "Nothing to run: pass --command / --command_file / --install_accelerate_tpu"
+        )
+
+    remote = "; ".join(commands)
+    from .launch import build_tpu_ssh_command
+
+    return build_tpu_ssh_command(tpu_name, tpu_zone, tpu_project, remote), tpu_name
+
+
+def run(args: argparse.Namespace) -> int:
+    gcloud, tpu_name = build_gcloud_command(args)
+    if args.debug:
+        print(" ".join(shlex.quote(c) for c in gcloud))
+        return 0
+    print(f"Running {gcloud[-1][len('--command='):]!r} on all workers of {tpu_name}")
+    return subprocess.call(gcloud)

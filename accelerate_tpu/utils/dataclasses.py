@@ -208,6 +208,15 @@ class FsdpPlugin:
     state_dict_type: str = "SHARDED_STATE_DICT"
 
     def __post_init__(self) -> None:
+        if parse_flag_from_env("ATX_FSDP_ACTIVATION_CHECKPOINTING"):
+            # Fail loudly instead of silently dropping remat from a run that
+            # used the old env contract.
+            raise ValueError(
+                "ATX_FSDP_ACTIVATION_CHECKPOINTING is no longer consumed: "
+                "activation remat is a model-structure concern — set "
+                "remat=True on the model config (LlamaConfig.remat / "
+                "BertConfig.remat) instead."
+            )
         env_sdt = os.environ.get("ATX_FSDP_STATE_DICT_TYPE")
         if env_sdt:
             self.state_dict_type = env_sdt

@@ -1,0 +1,27 @@
+"""Driver: debug_launcher must fork a working 2-process rendezvous from a
+process that has not yet initialized JAX backends (the notebook scenario)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from accelerate_tpu.launchers import debug_launcher
+
+
+def train() -> None:
+    import numpy as np
+
+    from accelerate_tpu.ops import collectives as ops
+    from accelerate_tpu.state import ProcessState
+
+    ps = ProcessState()
+    assert ps.num_processes == 2, ps.num_processes
+    total = ops.reduce({"x": np.float32([ps.process_index + 1.0])}, "sum")
+    assert float(total["x"][0]) == 3.0
+    print(f"[proc {ps.process_index}] NOTEBOOK OK", flush=True)
+
+
+if __name__ == "__main__":
+    debug_launcher(train, num_processes=2)
+    print("LAUNCHER DONE", flush=True)

@@ -12,7 +12,7 @@ import pytest
 
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.parallel.sharding import ShardingStrategy
-from accelerate_tpu.test_utils.training import regression_init, regression_loss
+from accelerate_tpu.test_utils.training import regression_init
 from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration, FsdpPlugin
 
 

@@ -1,0 +1,97 @@
+"""Notebook/debug launcher + tpu-config command tests."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands import cli
+from accelerate_tpu.launchers import debug_launcher, notebook_launcher
+from launch_helpers import REPO_ROOT, clean_env
+
+
+def test_notebook_launcher_single_process_runs_inline():
+    seen = {}
+
+    def fn(a, b):
+        seen["args"] = (a, b)
+        seen["precision"] = os.environ.get("ATX_MIXED_PRECISION")
+        return a + b
+
+    result = notebook_launcher(fn, (1, 2), mixed_precision="bf16")
+    assert result == 3
+    assert seen["args"] == (1, 2)
+    assert seen["precision"] == "bf16"
+    # env patch rolled back after the call
+    assert os.environ.get("ATX_MIXED_PRECISION") != "bf16" or "ATX_MIXED_PRECISION" not in os.environ
+
+
+def test_debug_launcher_refuses_with_live_backends():
+    import jax
+
+    jax.devices()  # ensure backends are initialized in this process
+    with pytest.raises(RuntimeError, match="already initialized"):
+        debug_launcher(lambda: None, num_processes=2)
+
+
+@pytest.mark.multiprocess
+def test_debug_launcher_forks_working_rendezvous():
+    script = os.path.join(REPO_ROOT, "tests", "scripts", "notebook_launcher_check.py")
+    proc = subprocess.run(
+        [sys.executable, script],
+        cwd=REPO_ROOT,
+        env=clean_env(),
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    for rank in range(2):
+        assert f"[proc {rank}] NOTEBOOK OK" in proc.stdout, proc.stdout
+    assert "LAUNCHER DONE" in proc.stdout
+
+
+def test_tpu_config_debug_prints_gcloud(capsys):
+    rc = cli.main(
+        [
+            "tpu-config",
+            "--debug",
+            "--tpu_name", "my-pod",
+            "--tpu_zone", "us-central2-b",
+            "--command", "echo hello",
+            "--command", "uptime",
+            "--install_accelerate_tpu",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--worker=all" in out
+    assert "pip install -U accelerate-tpu; echo hello; uptime" in out
+
+
+def test_tpu_config_requires_name_and_commands(tmp_path):
+    with pytest.raises(ValueError, match="tpu_name"):
+        cli.main(["tpu-config", "--debug", "--command", "x"])
+    with pytest.raises(ValueError, match="Nothing to run"):
+        cli.main(["tpu-config", "--debug", "--tpu_name", "p", "--tpu_zone", "z"])
+
+
+def test_tpu_config_command_file(tmp_path, capsys):
+    f = tmp_path / "cmds.txt"
+    f.write_text("echo a\n\necho b\n")
+    rc = cli.main(
+        [
+            "tpu-config",
+            "--debug",
+            "--tpu_name", "pod",
+            "--tpu_zone", "z",
+            "--tpu_project", "proj",
+            "--command_file", str(f),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "echo a; echo b" in out
+    assert "--project=proj" in out
