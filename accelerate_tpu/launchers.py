@@ -46,6 +46,19 @@ def _child_entry(
     os.environ.update(env)
     os.environ["ATX_PROCESS_ID"] = str(index)
     function(*args)
+    # Exit barrier: rank 0 hosts the coordination service — if it exits
+    # while peers are still mid-run, their next RPC fails with a gRPC
+    # "Socket closed" and a successful job reports as crashed.
+    try:
+        if "jax" in sys.modules:
+            from jax._src import distributed
+
+            if distributed.global_state.client is not None:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("atx_launcher_exit")
+    except Exception:  # pragma: no cover - best effort on teardown
+        pass
 
 
 def notebook_launcher(
@@ -121,6 +134,7 @@ def _fork_workers(
     # rendezvous completes, the survivors block on the coordinator forever —
     # tear the job down like the CLI launcher does (commands/launch.py).
     failed: list[tuple[int, int]] = []
+    tearing_down = False
     try:
         live = list(enumerate(procs))
         while live:
@@ -128,8 +142,12 @@ def _fork_workers(
                 if p.is_alive():
                     continue
                 live.remove((i, p))
-                if p.exitcode != 0:
+                if p.exitcode != 0 and not tearing_down:
+                    # Report only the original failure; survivors we
+                    # SIGTERM below would otherwise show up as phantom
+                    # "exited -15" failures.
                     failed.append((i, p.exitcode))
+                    tearing_down = True
                     for _, q in live:
                         q.terminate()
             if live:

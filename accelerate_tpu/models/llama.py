@@ -177,7 +177,12 @@ def _attention(config: LlamaConfig, q, k, v, mask):
     if config.attention_impl == "ring":
         from ..ops.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, causal=True)
+        if mask is not None and mask.ndim != 2:
+            raise NotImplementedError(
+                "attention_impl='ring' supports (B, S) key-padding masks "
+                "only; full (B, S, T) masks need 'flash' or 'dot'."
+            )
+        return ring_attention(q, k, v, causal=True, kv_mask=mask)
     if config.attention_impl != "dot":
         raise ValueError(
             f"Unknown attention_impl {config.attention_impl!r}; expected 'dot', 'flash', or 'ring'"

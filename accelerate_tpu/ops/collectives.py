@@ -209,13 +209,14 @@ def broadcast(tree: Any, from_process: int = 0) -> Any:
         return tree
     from jax.experimental import multihost_utils
 
-    if from_process != 0:
-        # broadcast_one_to_all sources from process 0; route through an
-        # object gather for non-zero roots (rare path, host-sized data).
-        gathered = gather_object([to_host(tree)])
-        return gathered[from_process]
+    # True one-to-all (O(payload) per link, not the O(world) all-gather this
+    # once was): any root via is_source.
+    is_source = state.process_index == from_process
     return jax.tree.map(
-        lambda x: np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x))), tree
+        lambda x: np.asarray(
+            multihost_utils.broadcast_one_to_all(np.asarray(x), is_source=is_source)
+        ),
+        tree,
     )
 
 
@@ -295,12 +296,32 @@ def gather_object(objects: list[Any]) -> list[Any]:
 
 def broadcast_object_list(objects: list[Any], from_process: int = 0) -> list[Any]:
     """Broadcast picklable objects from one process (reference
-    `broadcast_object_list`, `operations.py:560`)."""
+    `broadcast_object_list`, `operations.py:560`).
+
+    A real one-to-all: only the root's payload moves (two rounds — size,
+    then bytes). The previous all-gather implementation shipped every
+    process's (possibly None) payload to everyone, O(world) bandwidth on
+    the dispatch_batches hot path.
+    """
     state = ProcessState()
     if state.num_processes == 1:
         return list(objects)
-    everything = gather_object([list(objects)])
-    return everything[from_process]
+    from jax.experimental import multihost_utils
+
+    is_source = state.process_index == from_process
+    payload = (
+        _object_to_bytes_array(list(objects))
+        if is_source
+        else np.zeros(0, dtype=np.uint8)
+    )
+    length = multihost_utils.broadcast_one_to_all(
+        np.asarray([payload.size], dtype=np.int64), is_source=is_source
+    )
+    buf = np.zeros(int(length[0]), dtype=np.uint8)
+    if is_source:
+        buf[: payload.size] = payload
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(bytes(np.asarray(data, dtype=np.uint8)))
 
 
 def copy_tensor_to_devices(tree: Any, mesh: Mesh, spec: PartitionSpec | None = None) -> Any:

@@ -4,13 +4,18 @@ The reference has no attention kernels at all — fused attention arrives via
 torch SDPA / Megatron CUDA kernels (SURVEY.md §2.2: "fused softmax" listed as
 a native dependency to replace). Here it is a first-class TPU kernel:
 
-- forward: online-softmax over KV blocks, O(S) memory (never materializes the
-  S×S score matrix), fp32 accumulation, saves per-row logsumexp;
-- backward: custom VJP with two Pallas kernels (dq over KV blocks, dk/dv over
-  Q blocks) using the saved logsumexp + delta trick;
+- forward: online-softmax with BOTH Q and KV blocked through the grid —
+  VMEM use is O(block²), independent of sequence length, so the kernel
+  compiles at the long-context lengths flash attention exists for. The
+  softmax running state (m, l, acc) lives in VMEM scratch carried across
+  the innermost (KV) grid axis;
+- backward: custom VJP with two Pallas kernels (dq accumulated over KV
+  blocks, dk/dv accumulated over Q blocks), same blocked-grid structure,
+  using the saved logsumexp + delta trick;
 - GQA: query heads map onto kv heads via index maps (no kv replication in
   HBM); backward folds group gradients outside the kernel;
-- causal masking by block skipping (upper-triangle blocks never touched).
+- causal masking by block skipping (upper-triangle blocks are visited but
+  skipped with `pl.when` — no FLOPs, no VMEM traffic beyond the prefetch).
 
 Layouts follow the framework convention (B, S, H, h); kernels run in
 (B, H, S, h). Falls back to the XLA reference implementation
@@ -27,19 +32,68 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 # 512 empirically: ~3-7x faster than 128 on v5e at S=2048 (loop/semaphore
-# overhead amortizes; s-matrix VMEM stays well under budget at (512, 512) f32).
+# overhead amortizes; the (512, 512) f32 s-matrix stays well under VMEM).
 DEFAULT_BLOCK = 512
+# Staged-K+V byte budget for the resident-KV kernels: below this the whole
+# KV sequence stays in VMEM per (B, H) program (fastest — no KV re-fetch per
+# Q block, measured ~8% whole-model MFU at S=2048); above it the blocked
+# kernels keep VMEM O(block^2) so arbitrarily long sequences compile.
+_RESIDENT_KV_BUDGET = 4 * 1024 * 1024
+
+
+def _use_resident(S: int, h: int, dtype) -> bool:
+    return 2 * S * h * jnp.dtype(dtype).itemsize <= _RESIDENT_KV_BUDGET
 
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# ------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid):
+def _compiler_params():
+    """Mark (B, H, Q-blocks) parallel, KV-blocks sequential (the scratch
+    carry). Best-effort across pallas versions."""
+    try:
+        return pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
+    except Exception:  # pragma: no cover - version dependent
+        return None
+
+
+def _call_kwargs(interpret):
+    kwargs = {"interpret": interpret}
+    params = _compiler_params()
+    if params is not None and not interpret:
+        kwargs["compiler_params"] = params
+    return kwargs
+
+
+
+
+def _block_live(q_start, block_q, k_start, *, causal, valid):
+    """Should this (Q-block, KV-block) tile be computed at all?"""
+    return (q_start + block_q - 1 >= k_start) if causal else (k_start < valid)
+
+
+def _mask_scores(s, q_start, k_start, *, causal, valid):
+    """Apply causal / padded-column masking to a (bq, bk) score tile."""
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(rows >= cols, s, _NEG_INF)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(cols < valid, s, _NEG_INF)
+
+
+# ---------------------------------------------------- resident-KV kernels
+# Original single-pass kernels: K/V for the whole sequence stay staged in
+# VMEM while one Q block loops over them — fastest when they fit (short/
+# medium S), used below _RESIDENT_KV_BUDGET bytes of staged KV.
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid):
     qi = pl.program_id(2)
     # Keep matmul operands in their native (bf16) dtype: the MXU runs bf16 x
     # bf16 -> f32 at full rate, while f32 x f32 passes take a multiple of the
@@ -86,13 +140,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, se
     lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)  # (bq, 1)
 
 
-def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
+
+def _fwd_resident(q, k, v, *, scale, block, causal, interpret, valid):
     B, H, S, h = q.shape
     K = k.shape[1]
     group = H // K
     grid = (B, H, S // block)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid
+        _fwd_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -115,8 +170,8 @@ def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
     return o, lse
 
 
-# ------------------------------------------------------------------ backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid):
+
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
@@ -153,7 +208,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid):
+
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid):
     j = pl.program_id(2)
     k = k_ref[0, 0]  # (bk, h)
     v = v_ref[0, 0]
@@ -200,7 +256,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, block, causal, interpret, valid, residuals, g):
+
+def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
     q, k, v, o, lse = residuals
     B, H, S, h = q.shape
     K = k.shape[1]
@@ -210,7 +267,7 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
 
     grid = (B, H, S // block)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        functools.partial(_dq_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
@@ -227,7 +284,7 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
 
     grid_kv = (B, H, S // block)
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        functools.partial(_dkv_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
         grid=grid_kv,
         in_specs=[
             pl.BlockSpec((1, 1, S, h), lambda b, hh, j: (b, hh, 0, 0)),
@@ -246,6 +303,256 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
             jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
         ],
         interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        # Fold query-head-group gradients onto the shared kv heads.
+        dk = dk_h.reshape(B, K, group, S, h).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(B, K, group, S, h).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+    return dq, dk, dv
+
+
+
+
+# ------------------------------------------------------------------- forward
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, scale, block_q, block_k, causal, valid,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks entirely above the diagonal contribute nothing.
+    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+
+    @pl.when(run)
+    def _block():
+        # Keep matmul operands in their native (bf16) dtype: the MXU runs
+        # bf16 x bf16 -> f32 at full rate; accumulation stays f32 via
+        # preferred_element_type.
+        q = q_ref[0, 0]  # (bq, h)
+        k = k_ref[0, 0]  # (bk, h)
+        v = v_ref[0, 0]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk) f32
+        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p cast to the kv dtype for the MXU (standard flash practice; p in
+        # [0,1] so bf16 relative precision is adequate).
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
+    B, H, S, h = q.shape
+    if _use_resident(S, h, k.dtype):
+        return _fwd_resident(
+            q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid
+        )
+    K = k.shape[1]
+    group = H // K
+    grid = (B, H, S // block, S // block)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),   # m
+            pltpu.VMEM((block, 1), jnp.float32),   # l
+            pltpu.VMEM((block, h), jnp.float32),   # acc
+        ],
+        **_call_kwargs(interpret),
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------------------------ backward
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, scale, block_q, block_k, causal, valid,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_acc_ref[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale, block_q, block_k, causal, valid,
+):
+    # Grid: (B, H, KV-blocks, Q-blocks) — Q is the innermost carried axis.
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    k_start = ki * block_k
+    q_start = qi * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        p = jnp.exp(s - lse)  # (bq, bk) f32
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc_ref[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _bwd(scale, block, causal, interpret, valid, residuals, g):
+    q, k, v, o, lse = residuals
+    B, H, S, h = q.shape
+    if _use_resident(S, h, k.dtype):
+        return _bwd_resident(scale, block, causal, interpret, valid, residuals, g)
+    K = k.shape[1]
+    group = H // K
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+
+    grid = (B, H, S // block, S // block)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block, h), jnp.float32)],
+        **_call_kwargs(interpret),
+    )(q, k, v, do, lse, delta)
+
+    grid_kv = (B, H, S // block, S // block)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+        ),
+        grid=grid_kv,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, 1), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, h), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, h), jnp.float32),
+            pltpu.VMEM((block, h), jnp.float32),
+        ],
+        **_call_kwargs(interpret),
     )(q, k, v, do, lse, delta)
 
     if group > 1:

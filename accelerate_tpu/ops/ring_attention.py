@@ -45,8 +45,10 @@ def _chunk_attention(q, k, v, *, scale, mask):
     s = jnp.einsum("bskgh,btkh->bkgst", qg.astype(jnp.float32), k.astype(jnp.float32))
     s = s * scale
     if mask is not None:
-        # mask: (S, C) True = attend
-        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+        # mask: (S, C) True = attend, or (B, S, C) when it carries padding
+        if mask.ndim == 2:
+            mask = mask[None]
+        s = jnp.where(mask[:, None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # (B,K,g,S)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)  # (B,K,g,S)
@@ -73,12 +75,17 @@ def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    kv_mask: jax.Array | None,
     *,
     axis_name: str,
     causal: bool,
     scale: float,
 ) -> jax.Array:
-    """Body run per-device under shard_map: local q against the rotating kv."""
+    """Body run per-device under shard_map: local q against the rotating kv.
+
+    ``kv_mask`` is this device's (B, S_local) key-padding chunk (True =
+    attend); it rotates around the ring with its k/v chunk.
+    """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, S, H, h = q.shape
@@ -91,7 +98,7 @@ def _ring_attention_local(
     o0 = jnp.zeros((B, S, H, h), jnp.float32)
 
     def step(t, carry):
-        acc, kk, vv = carry
+        acc, kk, vv, mm = carry
         src = (my - t) % n  # which chunk is visiting this step
         if causal:
             # chunk-level causality: future chunk -> all masked; own chunk ->
@@ -100,6 +107,9 @@ def _ring_attention_local(
             mask = (rows + offset) >= cols
         else:
             mask = None
+        if mm is not None:
+            pad = mm[:, None, :]  # (B, 1, C) keys of the visiting chunk
+            mask = pad if mask is None else jnp.logical_and(mask[None], pad)
 
         def attend(acc):
             return _merge(acc, _chunk_attention(q, kk, vv, scale=scale, mask=mask))
@@ -112,9 +122,11 @@ def _ring_attention_local(
             acc = attend(acc)
         kk = jax.lax.ppermute(kk, axis_name, perm)
         vv = jax.lax.ppermute(vv, axis_name, perm)
-        return acc, kk, vv
+        if mm is not None:
+            mm = jax.lax.ppermute(mm, axis_name, perm)
+        return acc, kk, vv, mm
 
-    (o, m, l), _, _ = jax.lax.fori_loop(0, n, step, ((o0, m0, l0), k, v))
+    (o, m, l), _, _, _ = jax.lax.fori_loop(0, n, step, ((o0, m0, l0), k, v, kv_mask))
     l = jnp.maximum(l, 1e-30)
     return (o / l[..., None]).astype(q.dtype)
 
@@ -125,6 +137,7 @@ def ring_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    kv_mask: jax.Array | None = None,
     scale: float | None = None,
     mesh: Mesh | None = None,
     axis_name: str = SEQUENCE_AXIS,
@@ -134,7 +147,9 @@ def ring_attention(
 
     Shards S over ``axis_name`` and B over ``batch_axes`` with shard_map;
     call inside or outside jit. With an unsharded/absent sequence axis this
-    degrades to one local chunk (exact attention)."""
+    degrades to one local chunk (exact attention). ``kv_mask`` is a (B, S)
+    key-padding mask (True/1 = attend), sequence-sharded like k/v — each
+    chunk's mask rotates around the ring with it."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if mesh is None:
@@ -148,14 +163,17 @@ def ring_attention(
     # with a small batch on a large mesh) — sequence sharding still applies.
     use_batch = tuple(batch_axes) if batch_group > 1 and q.shape[0] % batch_group == 0 else None
     spec = P(use_batch, axis_name, None, None)
+    mask_spec = P(use_batch, axis_name)
     fn = functools.partial(
         _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(bool)
     shard_fn = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, mask_spec if kv_mask is not None else P()),
         out_specs=spec,
         check_vma=False,
     )
-    return shard_fn(q, k, v)
+    return shard_fn(q, k, v, kv_mask)
