@@ -46,4 +46,5 @@ from .utils import (
     ShardingStrategyType,
     TensorParallelPlugin,
     set_seed,
+    tqdm,
 )

@@ -71,6 +71,14 @@ class LlamaConfig:
     remat_policy: str = "block_outputs"
     attention_impl: str = "dot"  # "dot" | "flash" | "ring"
     z_loss: float = 0.0
+    # Mixture-of-Experts: n_experts > 0 replaces every block's FFN with a
+    # top-k routed expert layer (ops/moe.py); expert weights shard over the
+    # `expert` mesh axis via the "llama" plan.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_z_weight: float = 1e-3
 
     @property
     def resolved_head_dim(self) -> int:
@@ -107,8 +115,11 @@ class LlamaConfig:
     def param_count(self) -> int:
         h = self.resolved_head_dim
         attn = self.d_model * h * (2 * self.num_heads + 2 * self.num_kv_heads)
-        mlp = 3 * self.d_model * self.d_ff
-        block = attn + mlp + 2 * self.d_model
+        if self.n_experts:
+            ffn = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
+        else:
+            ffn = 3 * self.d_model * self.d_ff
+        block = attn + ffn + 2 * self.d_model
         embed = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
         return self.n_layers * block + embed + self.d_model
 
@@ -119,12 +130,18 @@ class LlamaConfig:
 
 def init_block(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
     ka, km = jax.random.split(rng)
-    return {
+    block = {
         "attn_norm": jnp.zeros((config.d_model,), dtype),
         "attn": init_attention(ka, config.attention_spec, dtype),
         "mlp_norm": jnp.zeros((config.d_model,), dtype),
-        "mlp": init_swiglu(km, config.d_model, config.d_ff, dtype),
     }
+    if config.n_experts:
+        from ..ops.moe import init_moe
+
+        block["moe"] = init_moe(km, config.d_model, config.d_ff, config.n_experts, dtype)
+    else:
+        block["mlp"] = init_swiglu(km, config.d_model, config.d_ff, dtype)
+    return block
 
 
 def init(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
@@ -210,8 +227,35 @@ def block_forward(
     attn = _attention(config, q, k, v, mask)
     x = x + checkpoint_name(attention_out(block["attn"], attn), "attn_out")
     h = rms_norm(x, block["mlp_norm"], config.norm_eps)
-    x = x + checkpoint_name(swiglu(block["mlp"], h), "ffn_out")
-    return x
+    ffn_out, aux = _ffn(block, h, config)
+    x = x + checkpoint_name(ffn_out, "ffn_out")
+    return x, aux
+
+
+def _maybe_dequantize(block: Params, dtype: Any) -> Params:
+    """Transparent weight-only int8 support (utils/quantization.py): when a
+    block carries quantized leaves, dequantize them to the compute dtype here
+    — per layer, inside the scan — so HBM holds int8 while matmuls see the
+    compute dtype."""
+    from ..utils.quantization import dequantize_pytree, has_quantized
+
+    if has_quantized(block):
+        return dequantize_pytree(block, dtype)
+    return block
+
+
+def _ffn(block: Params, h: jax.Array, config: LlamaConfig):
+    """Dense swiglu or routed MoE; returns (out, aux-losses-or-None)."""
+    if config.n_experts:
+        from ..ops.moe import moe_forward
+
+        return moe_forward(
+            block["moe"],
+            h,
+            top_k=config.moe_top_k,
+            capacity_factor=config.moe_capacity_factor,
+        )
+    return swiglu(block["mlp"], h), None
 
 
 def forward(
@@ -221,8 +265,12 @@ def forward(
     *,
     positions: jax.Array | None = None,
     mask: jax.Array | None = None,
+    return_aux: bool = False,
 ) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    """tokens (B, S) int32 -> logits (B, S, vocab).
+
+    With ``return_aux`` (MoE training) returns ``(logits, aux)`` where aux
+    holds the per-layer-averaged router losses."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -238,12 +286,21 @@ def forward(
         body = jax.checkpoint(body, policy=_remat_policy(config.remat_policy))
 
     def scan_body(carry, block):
-        return body(block, carry), None
+        new_x, aux = body(_maybe_dequantize(block, carry.dtype), carry)
+        return new_x, aux
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x, aux_stacked = jax.lax.scan(scan_body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    return jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    if not return_aux:
+        return logits
+    aux = (
+        jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stacked)
+        if aux_stacked is not None
+        else {}
+    )
+    return logits, aux
 
 
 # ---------------------------------------------------------------- KV cache
@@ -288,6 +345,7 @@ def forward_with_cache(
     def scan_body(carry, xs):
         x = carry
         block, k_cache, v_cache = xs
+        block = _maybe_dequantize(block, x.dtype)
         h = rms_norm(x, block["attn_norm"], config.norm_eps)
         q, k, v = attention_qkv(block["attn"], h)
         q = apply_rope(q, cos, sin, positions)
@@ -299,7 +357,8 @@ def forward_with_cache(
         )
         x = x + attention_out(block["attn"], attn)
         h = rms_norm(x, block["mlp_norm"], config.norm_eps)
-        x = x + swiglu(block["mlp"], h)
+        ffn_out, _ = _ffn(block, h, config)  # aux unused at inference
+        x = x + ffn_out
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -355,9 +414,10 @@ def _offloaded_block_step(config: LlamaConfig):
     repeated streamed forwards reuse the compilation."""
 
     def step(block, x, cos, sin, positions):
-        return block_forward(
+        x, _aux = block_forward(
             block, x, config=config, cos=cos, sin=sin, positions=positions, mask=None
         )
+        return x
 
     return jax.jit(step)
 
@@ -405,6 +465,9 @@ def loss_fn(
     tokens = batch["input_ids"]
     labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
+    moe = config.n_experts > 0
+    out = forward(params, tokens, config, mask=attn_mask, return_aux=moe)
+    logits, aux = out if moe else (out, {})
     if labels is None:
         # Run the forward at full S and drop the last logit instead of
         # slicing the tokens: keeps the sequence length at its (power-of-two,
@@ -412,8 +475,14 @@ def loss_fn(
         # path are preserved; one wasted position is noise.
         labels = tokens[:, 1:]
         loss_mask = attn_mask[:, 1:] if attn_mask is not None else None
-        logits = forward(params, tokens, config, mask=attn_mask)[:, :-1]
+        logits = logits[:, :-1]
     else:
         loss_mask = attn_mask
-        logits = forward(params, tokens, config, mask=attn_mask)
-    return cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)
+    loss = cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)
+    if moe:
+        loss = (
+            loss
+            + config.moe_aux_weight * aux["moe_load_balance"]
+            + config.moe_z_weight * aux["moe_z_loss"]
+        )
+    return loss

@@ -202,7 +202,13 @@ def reduce(tree: Any, reduction: str = "mean") -> Any:
 
 def broadcast(tree: Any, from_process: int = 0) -> Any:
     """Broadcast a pytree of arrays from one process to all (reference
-    `broadcast`, `operations.py:539`)."""
+    `broadcast`, `operations.py:539`).
+
+    Contract (same as the reference/torch): EVERY process passes a tree of
+    identical structure, shapes, and dtypes — non-source values are shape
+    templates (`ATX_DEBUG_MODE=1` verifies agreement). For source-only
+    payloads of arbitrary shape use `broadcast_object_list`.
+    """
     verify_operation("broadcast", tree)
     state = ProcessState()
     if state.num_processes == 1:

@@ -1,0 +1,179 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``expert`` axis.
+
+The reference has no MoE support (Megatron-LM integration exposes none of it
+through accelerate); this fills the framework's ``expert`` mesh axis —
+declared in `parallel/mesh.py:MESH_AXES` — with a real consumer. The design
+is the GShard/Switch capacity-based dispatch, which is THE TPU-native MoE
+construction (static shapes, einsum dispatch, XLA inserts the all-to-alls):
+
+- router: tokens -> softmax logits over E experts, top-k choice;
+- capacity: each expert processes at most C = ceil(k*N/E * capacity_factor)
+  tokens; overflow tokens are dropped (their combine weight is zero and the
+  residual connection carries them through unchanged — standard Switch
+  behavior);
+- dispatch/combine are one-hot einsum contractions, so the whole layer is
+  three matmuls + the expert FFN — no sorting, no dynamic shapes;
+- expert weights carry a leading [E] axis; sharding it over the ``expert``
+  mesh axis (see `llama.tp_plan`) makes XLA lower the dispatch einsum to an
+  all-to-all over ICI — expert parallelism without any explicit collective
+  in this file;
+- aux losses: load-balance (Switch eq. 4) + router z-loss, returned for the
+  model's loss function to weight in.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.layers import truncated_normal_init
+
+Params = Any
+
+
+def init_moe(
+    rng: jax.Array,
+    d_model: int,
+    d_ff: int,
+    n_experts: int,
+    dtype=jnp.float32,
+) -> Params:
+    """Router + E parallel swiglu experts (leading [E] axis on every weight)."""
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    std_in = 1.0 / np.sqrt(d_model)
+    std_out = 1.0 / np.sqrt(d_ff)
+    return {
+        "router": truncated_normal_init(kr, (d_model, n_experts), std_in, dtype),
+        "w_gate": truncated_normal_init(kg, (n_experts, d_model, d_ff), std_in, dtype),
+        "w_up": truncated_normal_init(ku, (n_experts, d_model, d_ff), std_in, dtype),
+        "w_down": truncated_normal_init(kd, (n_experts, d_ff, d_model), std_out, dtype),
+    }
+
+
+def _n_groups(n_tokens: int, tokens_per_group: int) -> int:
+    """Smallest divisor of ``n_tokens`` keeping groups <= tokens_per_group."""
+    for g in range(1, n_tokens + 1):
+        if n_tokens % g == 0 and n_tokens // g <= tokens_per_group:
+            return g
+    return n_tokens
+
+
+def _group_moe(params: Params, xt: jax.Array, *, top_k: int, capacity: int):
+    """Dispatch/FFN/combine for ONE token group. xt: (n, d)."""
+    n, d = xt.shape
+    E = params["router"].shape[-1]
+    # Router in fp32: tiny FLOPs, and logit precision decides expert choice.
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)  # (n, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-k selection (static k) with per-round masking.
+    remaining = probs
+    dispatch = jnp.zeros((n, E, capacity), xt.dtype)
+    combine = jnp.zeros((n, E, capacity), jnp.float32)
+    # Track per-expert fill across rounds so round 2 continues where 1 ended.
+    fill = jnp.zeros((E,), jnp.int32)
+    importance = jnp.zeros((E,), jnp.float32)  # fraction routed per expert
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)  # (n,)
+        gate = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (n, E)
+        # Position of each token within its chosen expert's buffer.
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) + fill[None, :]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # (n,)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, 0), capacity, dtype=jnp.float32)
+        contrib = (
+            onehot.astype(jnp.float32)[:, :, None]
+            * pos_oh[:, None, :]
+            * keep.astype(jnp.float32)[:, None, None]
+        )
+        dispatch = dispatch + contrib.astype(xt.dtype)
+        combine = combine + contrib * gate[:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        importance = importance + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        remaining = remaining * (1.0 - onehot.astype(probs.dtype))
+
+    # Dispatch -> expert FFN -> combine.
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xt)  # (E, C, d)
+    gate_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"].astype(xt.dtype))
+    up_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(xt.dtype))
+    hidden = jax.nn.silu(gate_h) * up_h
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden, params["w_down"].astype(xt.dtype))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(xt.dtype), expert_out)
+
+    # Renormalize: dropped tokens keep whatever gate mass survived; the usual
+    # top-k renorm divides by the sum of kept gates (guarded for full drops).
+    gate_sum = jnp.sum(combine, axis=(1, 2))  # (n,)
+    out = out / jnp.maximum(gate_sum, 1e-9)[:, None].astype(out.dtype)
+
+    # Aux stats. Load balance (Switch eq. 4): E * sum_e f_e * P_e where f_e
+    # is the routed fraction and P_e the mean router prob. z-loss keeps
+    # logits from drifting to fp32-hostile magnitudes.
+    mean_prob = jnp.mean(probs, axis=0)  # (E,)
+    load_balance = E * jnp.sum((importance / top_k) * mean_prob)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    kept = jnp.sum(dispatch.astype(jnp.float32))
+    return out, load_balance, z_loss, kept
+
+
+def moe_forward(
+    params: Params,
+    x: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    tokens_per_group: int = 2048,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """(B, S, d) -> (B, S, d) plus aux losses.
+
+    Tokens are split into groups of at most ``tokens_per_group`` with
+    per-group expert capacity (the GShard group axis): the dispatch/combine
+    one-hots are then O(N * top_k * capacity_factor * tokens_per_group / E)
+    — linear in total tokens — instead of the O(N^2) a single global
+    capacity would cost at training sequence lengths.
+    """
+    B, S, d = x.shape
+    E = params["router"].shape[-1]
+    N = B * S
+    G = _n_groups(N, tokens_per_group)
+    n = N // G
+    capacity = max(int(math.ceil(top_k * n / E * capacity_factor)), 1)
+
+    xg = x.reshape(G, n, d)
+    out, load_balance, z_loss, kept = jax.vmap(
+        lambda xt: _group_moe(params, xt, top_k=top_k, capacity=capacity)
+    )(xg)
+    aux = {
+        "moe_load_balance": jnp.mean(load_balance).astype(jnp.float32),
+        "moe_z_loss": jnp.mean(z_loss).astype(jnp.float32),
+        # Fraction of token-slots dropped by capacity limits (diagnostic).
+        "moe_drop_fraction": 1.0 - jnp.sum(kept) / (top_k * N),
+    }
+    return out.reshape(B, S, d), aux
+
+
+def moe_reference(params: Params, x: jax.Array, *, top_k: int = 2) -> jax.Array:
+    """Oracle: per-token dense computation of the same top-k mixture with
+    unlimited capacity (for tests)."""
+    B, S, d = x.shape
+    xt = x.reshape(-1, d)
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    E = probs.shape[-1]
+    _, topk_idx = jax.lax.top_k(probs, top_k)
+
+    def one_expert(e):
+        gate = xt @ params["w_gate"][e].astype(xt.dtype)
+        up = xt @ params["w_up"][e].astype(xt.dtype)
+        return (jax.nn.silu(gate) * up) @ params["w_down"][e].astype(xt.dtype)
+
+    all_out = jnp.stack([one_expert(e) for e in range(E)], axis=1)  # (N, E, d)
+    mask = jax.nn.one_hot(topk_idx, E).sum(axis=1)  # (N, E)
+    weights = probs * mask
+    weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-9)
+    out = jnp.einsum("ne,ned->nd", weights.astype(xt.dtype), all_out)
+    return out.reshape(B, S, d)

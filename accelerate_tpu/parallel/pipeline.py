@@ -200,7 +200,8 @@ def llama_pipeline(
         )
 
         def scan_body(carry, block):
-            return body(block, carry), None
+            new_x, _aux = body(block, carry)  # MoE aux unused at inference
+            return new_x, None
 
         x, _ = jax.lax.scan(scan_body, x, stage_blocks)
         return x

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+from .mesh import EXPERT_AXIS as E
 from .mesh import FSDP_AXIS as F
 from .mesh import TENSOR_AXIS as T
 
@@ -57,6 +58,12 @@ register_tp_plan(
         (r"blocks/attn/wo$", P(None, T, None, F)),
         (r"blocks/mlp/w_(gate|up)$", P(None, F, T)),
         (r"blocks/mlp/w_down$", P(None, T, F)),
+        # MoE (present when LlamaConfig.n_experts > 0): experts shard over
+        # the `expert` axis — the dispatch einsum then lowers to an
+        # all-to-all; within each expert, megatron column/row split as above.
+        (r"blocks/moe/router$", P()),
+        (r"blocks/moe/w_(gate|up)$", P(None, E, F, T)),
+        (r"blocks/moe/w_down$", P(None, E, T, F)),
         (r"^embed$", P(T, F)),
         (r"^lm_head$", P(F, T)),
         (r"norm", P()),

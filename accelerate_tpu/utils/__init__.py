@@ -33,6 +33,11 @@ from .profiler import (
     save_memory_profile,
     step_annotation,
 )
+from .quantization import (
+    dequantize_pytree,
+    quantize_pytree,
+)
+from .tqdm import tqdm
 from .random import (
     key_for_process,
     key_for_step,

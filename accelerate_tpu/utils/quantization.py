@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization for inference.
+
+Analog of the reference bitsandbytes integration (`utils/bnb.py:44`
+`load_and_quantize_model`: 8-bit weight storage, compute in higher
+precision). The TPU-native translation: symmetric per-channel int8 with an
+fp32 scale per output channel, stored as a small ``{"__quant__", "scale"}``
+pytree node; weights dequantize to the compute dtype AT USE — per layer,
+inside the scan — so HBM holds int8 (2x less than bf16, 4x less than fp32)
+while the MXU still sees bf16 operands (TPU int8 matmul would need
+activation quantization too; weight-only is the accuracy-safe default, same
+trade as bnb's int8 with fp16 compute).
+
+Not a training path: quantize AFTER training / at load, for inference.
+`models/llama.py` dequantizes transparently when it sees quantized blocks.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+_QUANT_KEY = "__quant__"
+
+# Leaves that stay full precision: cheap, sensitive, integer-indexed, or
+# consumed outside the per-block dequant (embedding lookup / head matmul).
+DEFAULT_SKIP_PATTERNS = (
+    r"norm",
+    r"scale",
+    r"bias",
+    r"router",
+    r"(^|/)b$",
+    r"embed",
+    r"head",
+    r"pooler",
+    r"classifier",
+)
+
+
+def is_quantized(x: Any) -> bool:
+    return isinstance(x, dict) and _QUANT_KEY in x
+
+
+def quantize_array(w: jax.Array) -> dict[str, jax.Array]:
+    """Symmetric int8, one fp32 scale per output channel (last axis) — and
+    per leading-axis slice for stacked scan-over-layers weights (ndim >= 3),
+    so every layer keeps its own scales."""
+    w32 = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(range(1 if w32.ndim >= 3 else 0, w32.ndim - 1))
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {_QUANT_KEY: q, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize_array(d: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.Array:
+    return (d[_QUANT_KEY].astype(jnp.float32) * d["scale"]).astype(dtype)
+
+
+def quantize_pytree(
+    tree: Any,
+    *,
+    skip_patterns: tuple[str, ...] = DEFAULT_SKIP_PATTERNS,
+    min_size: int = 4096,
+) -> Any:
+    """Quantize eligible float leaves (big matmul weights); embeddings and
+    anything matching ``skip_patterns`` stay full precision."""
+
+    def visit(path, leaf):
+        path_s = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if any(re.search(pat, path_s) for pat in skip_patterns):
+            return leaf
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        if leaf.size < min_size or leaf.ndim < 2:
+            return leaf
+        return quantize_array(leaf)
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def dequantize_pytree(tree: Any, dtype: Any = jnp.bfloat16) -> Any:
+    return jax.tree.map(
+        lambda x: dequantize_array(x, dtype) if is_quantized(x) else x,
+        tree,
+        is_leaf=is_quantized,
+    )
+
+
+def has_quantized(tree: Any) -> bool:
+    found = False
+
+    def check(x):
+        nonlocal found
+        if is_quantized(x):
+            found = True
+        return x
+
+    jax.tree.map(check, tree, is_leaf=is_quantized)
+    return found
+
+
+def quantized_nbytes(tree: Any) -> int:
+    """Total bytes of the (possibly partially quantized) pytree."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

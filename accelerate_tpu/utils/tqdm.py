@@ -1,0 +1,35 @@
+"""Rank-aware tqdm (reference `utils/tqdm.py`): progress bars render on the
+main process only, so an N-host job prints one bar instead of N interleaved
+ones. Usage matches the reference::
+
+    from accelerate_tpu.utils import tqdm
+    for batch in tqdm(loader, desc="train"):
+        ...
+
+Pass ``main_process_only=False`` to show a bar on every process (each
+prefixed with its rank via ``position``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def tqdm(*args: Any, main_process_only: bool = True, **kwargs: Any):
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError as e:  # pragma: no cover - env dependent
+        raise ImportError(
+            "tqdm is not installed; `pip install tqdm` to use the progress bar"
+        ) from e
+
+    from ..state import ProcessState
+
+    state = ProcessState()
+    if main_process_only and not state.is_main_process:
+        kwargs["disable"] = True
+    elif not main_process_only and state.num_processes > 1:
+        kwargs.setdefault("position", state.process_index)
+        desc = kwargs.get("desc", "")
+        kwargs["desc"] = f"[rank {state.process_index}] {desc}".strip()
+    return _tqdm(*args, **kwargs)

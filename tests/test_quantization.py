@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization tests: round-trip accuracy, skip rules,
+memory halving, and transparent llama inference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils.quantization import (
+    dequantize_array,
+    dequantize_pytree,
+    has_quantized,
+    is_quantized,
+    quantize_array,
+    quantize_pytree,
+    quantized_nbytes,
+)
+
+
+def _cosine(a, b):
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+def test_array_round_trip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 128)) * 0.1
+    q = quantize_array(w)
+    assert q["__quant__"].dtype == jnp.int8
+    back = dequantize_array(q, jnp.float32)
+    assert _cosine(w, back) > 0.9999
+    # per-channel: worst-case error bounded by scale/2 per channel
+    err = np.abs(np.asarray(w) - np.asarray(back))
+    assert (err <= np.asarray(q["scale"])[0] * 0.5 + 1e-7).all()
+
+
+def test_stacked_weights_get_per_layer_scales():
+    w = jnp.stack(
+        [jnp.ones((8, 16)) * 0.01, jnp.ones((8, 16)) * 100.0]
+    )  # (L=2, d, f) with wildly different magnitudes
+    q = quantize_array(w)
+    assert q["scale"].shape == (2, 1, 16)
+    back = dequantize_array(q, jnp.float32)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(w), rtol=0.02)
+
+
+def test_pytree_skip_rules():
+    tree = {
+        "w_big": jnp.ones((128, 64)),
+        "final_norm": jnp.ones((128,)),
+        "router": jnp.ones((128, 64)),
+        "tiny": jnp.ones((4, 4)),
+        "ints": jnp.ones((128, 64), jnp.int32),
+    }
+    out = quantize_pytree(tree, min_size=1024)
+    assert is_quantized(out["w_big"])
+    assert not is_quantized(out["final_norm"])
+    assert not is_quantized(out["router"])
+    assert not is_quantized(out["tiny"])
+    assert not is_quantized(out["ints"])
+    restored = dequantize_pytree(out, jnp.float32)
+    assert restored["w_big"].dtype == jnp.float32
+
+
+def test_memory_halves():
+    params = llama.init(jax.random.PRNGKey(0), llama.LlamaConfig.tiny(d_model=128, d_ff=256))
+    before = quantized_nbytes(params)
+    qparams = quantize_pytree(params, min_size=1024)
+    after = quantized_nbytes(qparams)
+    assert has_quantized(qparams)
+    # fp32 -> int8 on the matmul weights: big reduction (embeddings stay fp)
+    assert after < before * 0.55, (before, after)
+
+
+def test_whole_model_quantize_forward_works():
+    # The documented flow: quantize the FULL param tree; embed/head/norms
+    # stay full precision so the non-block paths still work.
+    config = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.PRNGKey(0), config)
+    qparams = quantize_pytree(params, min_size=256)
+    assert not is_quantized(qparams["embed"])
+    assert not is_quantized(qparams["lm_head"])
+    assert has_quantized(qparams["blocks"])
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    full = llama.forward(params, tokens, config)
+    quant = llama.forward(qparams, tokens, config)
+    assert _cosine(full, quant) > 0.99
+
+
+def test_llama_quantized_forward_close_to_full():
+    config = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.PRNGKey(0), config)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    full = llama.forward(params, tokens, config)
+    qparams = {**params, "blocks": quantize_pytree(params["blocks"], min_size=256)}
+    assert has_quantized(qparams["blocks"])
+    quant = llama.forward(qparams, tokens, config)
+    assert _cosine(full, quant) > 0.99, _cosine(full, quant)
+
+
+def test_llama_quantized_cache_path():
+    config = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.PRNGKey(0), config)
+    qparams = {**params, "blocks": quantize_pytree(params["blocks"], min_size=256)}
+    cache = llama.init_cache(config, 2, 32)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, config.vocab_size)
+    full_logits, _ = llama.forward_with_cache(params, tokens, cache, config)
+    q_logits, _ = llama.forward_with_cache(qparams, tokens, cache, config)
+    assert _cosine(full_logits, q_logits) > 0.99

@@ -73,3 +73,13 @@ def test_on_main_process_decorator():
     fn = state.on_main_process(lambda: calls.append(1))
     fn()
     assert calls == [1]
+
+
+def test_rank_aware_tqdm():
+    from accelerate_tpu.utils import tqdm
+
+    bar = tqdm(range(3), desc="t")
+    # single process == main process: bar enabled (close() flips disable,
+    # so check before consuming)
+    assert not bar.disable
+    assert list(bar) == [0, 1, 2]
