@@ -115,7 +115,7 @@ def interactive_config() -> LaunchConfig:
     cfg.mesh_sequence = _ask("Mesh: sequence-parallel size", 1, int)
     cfg.mesh_expert = _ask("Mesh: expert-parallel size", 1, int)
     cfg.sharding_strategy = _ask(
-        "Sharding strategy (DATA_PARALLEL/ZERO1/FSDP/TENSOR_PARALLEL/HYBRID)",
+        "Sharding strategy (DATA_PARALLEL/ZERO1/ZERO2/FSDP/TENSOR_PARALLEL/HYBRID)",
         "FSDP" if cfg.mesh_fsdp > 1 else "DATA_PARALLEL",
     ).upper()
     cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16)", "bf16")

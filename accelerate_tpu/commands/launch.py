@@ -46,7 +46,7 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument(
         "--strategy",
         default=None,
-        help="DATA_PARALLEL | ZERO1 | FSDP | TENSOR_PARALLEL | HYBRID",
+        help="DATA_PARALLEL | ZERO1 | ZERO2 | FSDP | TENSOR_PARALLEL | HYBRID",
     )
     p.add_argument("--data", type=int, default=None, help="mesh data axis size")
     p.add_argument("--fsdp", type=int, default=None, help="mesh fsdp axis size")

@@ -131,7 +131,11 @@ def infer_param_specs(
     def leaf_spec(path: tuple, leaf: Any) -> PartitionSpec:
         shape = tuple(getattr(leaf, "shape", ()))
         path_s = _path_str(path)
-        if kind == ShardingStrategyType.DATA_PARALLEL or kind == ShardingStrategyType.ZERO1:
+        if kind in (
+            ShardingStrategyType.DATA_PARALLEL,
+            ShardingStrategyType.ZERO1,
+            ShardingStrategyType.ZERO2,  # same program under XLA; see dataclasses.py
+        ):
             return PartitionSpec()
         matched = _apply_rules(path_s, shape, strategy.rules)
         if matched is not None:
@@ -159,7 +163,7 @@ def infer_opt_specs(
     """
     params_struct = jax.tree.structure(params_shapes)
 
-    if strategy.kind == ShardingStrategyType.ZERO1:
+    if strategy.kind in (ShardingStrategyType.ZERO1, ShardingStrategyType.ZERO2):
         moment_specs = jax.tree.map(
             lambda leaf: _shard_largest_dim(
                 tuple(leaf.shape), strategy.zero1_axes, mesh, strategy.fsdp.min_weight_size

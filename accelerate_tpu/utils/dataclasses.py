@@ -51,6 +51,12 @@ class ShardingStrategyType(BaseEnum):
     - DATA_PARALLEL: replicate params (reference DDP, `accelerator.py:1519`)
     - ZERO1: replicate params, shard optimizer state over data axis
       (DeepSpeed stage 1, `utils/dataclasses.py:1019`)
+    - ZERO2: accepted as an alias of ZERO1. DeepSpeed stage 2 additionally
+      shards GRADIENT buffers; in a fused XLA step gradients are ephemeral
+      intermediates with no persistent buffer to shard, and XLA already
+      lowers the update to reduce-scatter + sharded-moment updates when the
+      optimizer state is sharded — the two stages compile to the same
+      program here, so the distinction is intentionally collapsed.
     - FSDP: shard params+grads+opt over the fsdp axis (torch FSDP
       FULL_SHARD / ZeRO-3, `utils/dataclasses.py:1449`)
     - TENSOR_PARALLEL: shard weight matrices over the tensor axis
@@ -60,6 +66,7 @@ class ShardingStrategyType(BaseEnum):
 
     DATA_PARALLEL = "DATA_PARALLEL"
     ZERO1 = "ZERO1"
+    ZERO2 = "ZERO2"
     FSDP = "FSDP"
     TENSOR_PARALLEL = "TENSOR_PARALLEL"
     HYBRID = "HYBRID"

@@ -43,12 +43,17 @@ def is_quantized(x: Any) -> bool:
     return isinstance(x, dict) and _QUANT_KEY in x
 
 
-def quantize_array(w: jax.Array) -> dict[str, jax.Array]:
-    """Symmetric int8, one fp32 scale per output channel (last axis) — and
-    per leading-axis slice for stacked scan-over-layers weights (ndim >= 3),
-    so every layer keeps its own scales."""
+def quantize_array(w: jax.Array, stack_dims: int | None = None) -> dict[str, jax.Array]:
+    """Symmetric int8, one fp32 scale per output channel (last axis) — kept
+    separately per leading "stack" axis slice so stacked weights never share
+    scales across slices. ``stack_dims`` = number of leading stack axes
+    (default: 1 for ndim >= 3, the scan-over-layers layout; pass 2 for
+    layer+expert stacked MoE weights so EXPERTS keep independent scales)."""
     w32 = jnp.asarray(w, jnp.float32)
-    reduce_axes = tuple(range(1 if w32.ndim >= 3 else 0, w32.ndim - 1))
+    if stack_dims is None:
+        stack_dims = 1 if w32.ndim >= 3 else 0
+    stack_dims = min(stack_dims, max(w32.ndim - 2, 0))
+    reduce_axes = tuple(range(stack_dims, w32.ndim - 1))
     absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
@@ -68,15 +73,20 @@ def quantize_pytree(
     """Quantize eligible float leaves (big matmul weights); embeddings and
     anything matching ``skip_patterns`` stay full precision."""
 
+    from ..parallel.sharding import _path_str  # lazy: avoids an import cycle
+
     def visit(path, leaf):
-        path_s = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        path_s = _path_str(path)
         if any(re.search(pat, path_s) for pat in skip_patterns):
             return leaf
         if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
             return leaf
         if leaf.size < min_size or leaf.ndim < 2:
             return leaf
-        return quantize_array(leaf)
+        # MoE expert weights are stacked (layer, expert, ...): both leading
+        # axes are stack dims, so each expert keeps independent scales.
+        stack = 2 if "moe" in path_s and leaf.ndim >= 4 else None
+        return quantize_array(leaf, stack_dims=stack)
 
     return jax.tree_util.tree_map_with_path(visit, tree)
 

@@ -80,3 +80,23 @@ def test_removed_knobs_are_gone():
 def test_fsdp_plugin_as_strategy():
     strat = ShardingStrategy.resolve(FsdpPlugin(min_weight_size=1))
     assert strat.fsdp.min_weight_size == 1
+
+
+def test_zero2_is_documented_alias_of_zero1():
+    import optax
+
+    from accelerate_tpu.state import AcceleratorState
+
+    shardings = {}
+    for kind in ("ZERO1", "ZERO2"):
+        AcceleratorState._reset_state()
+        acc = Accelerator(seed=0, strategy=kind)
+        state = acc.create_train_state(
+            lambda r: {"w": jax.random.normal(r, (2048, 64))}, optax.adam(1e-3)
+        )
+        moment = jax.tree.leaves(state.opt_state)[1]  # adam mu for w
+        shardings[kind] = (str(moment.sharding.spec), str(state.params["w"].sharding.spec))
+    assert shardings["ZERO1"] == shardings["ZERO2"]
+    # and both actually shard the moment (params stay replicated)
+    assert "data" in shardings["ZERO2"][0]
+    assert shardings["ZERO2"][1] == "PartitionSpec()"

@@ -108,3 +108,26 @@ def test_llama_quantized_cache_path():
     full_logits, _ = llama.forward_with_cache(params, tokens, cache, config)
     q_logits, _ = llama.forward_with_cache(qparams, tokens, cache, config)
     assert _cosine(full_logits, q_logits) > 0.99
+
+
+def test_moe_experts_keep_independent_scales():
+    # Stacked MoE weights (L, E, d, f): one expert 100x smaller than its
+    # sibling must not be crushed to zeros by a shared scale.
+    config = llama.LlamaConfig.tiny(n_experts=2, n_layers=1)
+    params = llama.init(jax.random.PRNGKey(0), config)
+    w = params["blocks"]["moe"]["w_gate"]  # (1, 2, d, f)
+    w = w.at[:, 1].multiply(0.01)
+    params["blocks"]["moe"]["w_gate"] = w
+    qblocks = quantize_pytree(params["blocks"], min_size=256)
+    q = qblocks["moe"]["w_gate"]
+    assert is_quantized(q)
+    assert q["scale"].shape[1] == 2  # per-expert scales survive
+    back = dequantize_array(q, jnp.float32)
+    cos = _cosine(w, back)
+    assert cos > 0.999, cos
+    # and the quantized MoE model still predicts like the full model
+    qparams = {**params, "blocks": qblocks}
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    full = llama.forward(params, tokens, config)
+    quant = llama.forward(qparams, tokens, config)
+    assert _cosine(full, quant) > 0.98, _cosine(full, quant)

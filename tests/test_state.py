@@ -76,6 +76,7 @@ def test_on_main_process_decorator():
 
 
 def test_rank_aware_tqdm():
+    pytest.importorskip("tqdm")
     from accelerate_tpu.utils import tqdm
 
     bar = tqdm(range(3), desc="t")
