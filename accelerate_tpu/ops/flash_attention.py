@@ -496,18 +496,13 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(scale, block, causal, interpret, valid, residuals, g):
-    q, k, v, o, lse = residuals
+def dq_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid):
+    """dq for one (q, kv) pair via the blocked kernel. Shapes (B, H, S, h);
+    exposed for ring attention's per-chunk backward."""
     B, H, S, h = q.shape
-    if _use_resident(S, h, k.dtype):
-        return _bwd_resident(scale, block, causal, interpret, valid, residuals, g)
-    K = k.shape[1]
-    group = H // K
-    do = g
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
-
+    group = H // k.shape[1]
     grid = (B, H, S // block, S // block)
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
         ),
@@ -526,8 +521,14 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
         **_call_kwargs(interpret),
     )(q, k, v, do, lse, delta)
 
+
+def dkv_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid):
+    """(dk, dv) for one (q, kv) pair via the blocked kernel — per expanded
+    query head (no GQA fold; the caller folds groups). Shapes (B, H, S, h)."""
+    B, H, S, h = q.shape
+    group = H // k.shape[1]
     grid_kv = (B, H, S // block, S // block)
-    dk_h, dv_h = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
         ),
@@ -555,12 +556,29 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
         **_call_kwargs(interpret),
     )(q, k, v, do, lse, delta)
 
+
+def fold_gqa_groups(dk_h, dv_h, K, k_dtype, v_dtype):
+    """Sum per-query-head kv grads onto the shared kv heads."""
+    B, H, S, h = dk_h.shape
+    group = H // K
     if group > 1:
-        # Fold query-head-group gradients onto the shared kv heads.
-        dk = dk_h.reshape(B, K, group, S, h).sum(axis=2).astype(k.dtype)
-        dv = dv_h.reshape(B, K, group, S, h).sum(axis=2).astype(v.dtype)
-    else:
-        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+        dk = dk_h.reshape(B, K, group, S, h).sum(axis=2).astype(k_dtype)
+        dv = dv_h.reshape(B, K, group, S, h).sum(axis=2).astype(v_dtype)
+        return dk, dv
+    return dk_h.astype(k_dtype), dv_h.astype(v_dtype)
+
+
+def _bwd(scale, block, causal, interpret, valid, residuals, g):
+    q, k, v, o, lse = residuals
+    B, H, S, h = q.shape
+    if _use_resident(S, h, k.dtype):
+        return _bwd_resident(scale, block, causal, interpret, valid, residuals, g)
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+    kwargs = dict(scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    dq = dq_call(q, k, v, do, lse, delta, **kwargs)
+    dk_h, dv_h = dkv_call(q, k, v, do, lse, delta, **kwargs)
+    dk, dv = fold_gqa_groups(dk_h, dv_h, k.shape[1], k.dtype, v.dtype)
     return dq, dk, dv
 
 

@@ -131,6 +131,152 @@ def _ring_attention_local(
     return (o / l[..., None]).astype(q.dtype)
 
 
+# ------------------------------------------------------- fused (flash) path
+def _merge_lse(o, lse, oc, lsec):
+    """Merge two normalized partial attentions via their logsumexps."""
+    new_lse = jnp.logaddexp(lse, lsec)
+    w = jnp.exp(lse - new_lse)
+    wc = jnp.exp(lsec - new_lse)
+    return o * w + oc.astype(o.dtype) * wc, new_lse
+
+
+def _ring_fused_fwd_impl(q, k, v, axis_name, causal, scale, block, interpret):
+    from .flash_attention import _fwd
+
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    qt = q.transpose(0, 2, 1, 3)  # (B, H, S, h)
+    S = qt.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk(kk, vv, chunk_causal):
+        oc, lsec = _fwd(
+            qt,
+            kk.transpose(0, 2, 1, 3),
+            vv.transpose(0, 2, 1, 3),
+            scale=scale,
+            block=block,
+            causal=chunk_causal,
+            interpret=interpret,
+            valid=S,
+        )
+        return oc, lsec
+
+    # t = 0: the device's own chunk (diagonal) — triangular under causal.
+    oc, lsec = chunk(k, v, causal)
+    o = oc.astype(jnp.float32)
+    lse = lsec
+    kk = jax.lax.ppermute(k, axis_name, perm)
+    vv = jax.lax.ppermute(v, axis_name, perm)
+
+    def step(t, carry):
+        (o, lse), kk, vv = carry
+
+        def attend(ol):
+            oc, lsec = chunk(kk, vv, False)
+            return _merge_lse(ol[0], ol[1], oc, lsec)
+
+        if causal:
+            # Entirely-future chunks contribute nothing: skip their FLOPs.
+            src = (my - t) % n
+            o, lse = jax.lax.cond(src < my, attend, lambda ol: ol, (o, lse))
+        else:
+            o, lse = attend((o, lse))
+        kk2 = jax.lax.ppermute(kk, axis_name, perm)
+        vv2 = jax.lax.ppermute(vv, axis_name, perm)
+        return (o, lse), kk2, vv2
+
+    (o, lse), _, _ = jax.lax.fori_loop(1, n, step, ((o, lse), kk, vv))
+    return o.astype(q.dtype).transpose(0, 2, 1, 3), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_fused(q, k, v, axis_name, causal, scale, block, interpret):
+    o, _ = _ring_fused_fwd_impl(q, k, v, axis_name, causal, scale, block, interpret)
+    return o
+
+
+def _ring_fused_fwd(q, k, v, axis_name, causal, scale, block, interpret):
+    o, lse = _ring_fused_fwd_impl(q, k, v, axis_name, causal, scale, block, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_fused_bwd(axis_name, causal, scale, block, interpret, residuals, g):
+    from .flash_attention import dkv_call, dq_call, fold_gqa_groups
+
+    q, k, v, o, lse = residuals
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qt = q.transpose(0, 2, 1, 3)
+    dot_ = g.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    S = qt.shape[2]
+    delta = jnp.sum(
+        dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    kwargs = dict(scale=scale, block=block, interpret=interpret, valid=S)
+
+    def chunk_grads(kk, vv, chunk_causal):
+        kt = kk.transpose(0, 2, 1, 3)
+        vt = vv.transpose(0, 2, 1, 3)
+        dq_c = dq_call(qt, kt, vt, dot_, lse, delta, causal=chunk_causal, **kwargs)
+        dkh, dvh = dkv_call(qt, kt, vt, dot_, lse, delta, causal=chunk_causal, **kwargs)
+        return dq_c.astype(jnp.float32), dkh.astype(jnp.float32), dvh.astype(jnp.float32)
+
+    # t = 0: own chunk.
+    dq_t, dkh, dvh = chunk_grads(k, v, causal)
+    kk = jax.lax.ppermute(k, axis_name, perm)
+    vv = jax.lax.ppermute(v, axis_name, perm)
+    # Accumulators travel WITH their chunk: after n total rotations each
+    # device's own chunk gradients are back home.
+    dkh = jax.lax.ppermute(dkh, axis_name, perm)
+    dvh = jax.lax.ppermute(dvh, axis_name, perm)
+
+    def step(t, carry):
+        dq_t, dkh, dvh, kk, vv = carry
+
+        def attend(args):
+            dq_t, dkh, dvh = args
+            dq_c, dkh_c, dvh_c = chunk_grads(kk, vv, False)
+            return dq_t + dq_c, dkh + dkh_c, dvh + dvh_c
+
+        if causal:
+            src = (my - t) % n
+            dq_t, dkh, dvh = jax.lax.cond(src < my, attend, lambda a: a, (dq_t, dkh, dvh))
+        else:
+            dq_t, dkh, dvh = attend((dq_t, dkh, dvh))
+        return (
+            dq_t,
+            jax.lax.ppermute(dkh, axis_name, perm),
+            jax.lax.ppermute(dvh, axis_name, perm),
+            jax.lax.ppermute(kk, axis_name, perm),
+            jax.lax.ppermute(vv, axis_name, perm),
+        )
+
+    dq_t, dkh, dvh, _, _ = jax.lax.fori_loop(1, n, step, (dq_t, dkh, dvh, kk, vv))
+    K = k.shape[2]
+    dk_t, dv_t = fold_gqa_groups(
+        dkh.astype(q.dtype), dvh.astype(q.dtype), K, k.dtype, v.dtype
+    )
+    dq = dq_t.astype(q.dtype).transpose(0, 2, 1, 3)
+    dk = dk_t.transpose(0, 2, 1, 3)
+    dv = dv_t.transpose(0, 2, 1, 3)
+    return dq, dk, dv
+
+
+_ring_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)
+
+
+def _fused_block(s_local: int) -> int | None:
+    """Kernel block size for the fused path; None = chunk too small/ragged,
+    use the einsum path."""
+    for b in (512, 256, 128):
+        if s_local % b == 0:
+            return b
+    return None
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -142,6 +288,7 @@ def ring_attention(
     mesh: Mesh | None = None,
     axis_name: str = SEQUENCE_AXIS,
     batch_axes: Sequence[str] = BATCH_AXES,
+    impl: str = "auto",
 ) -> jax.Array:
     """Sequence-parallel attention over (B, S, H, h) global arrays.
 
@@ -149,7 +296,15 @@ def ring_attention(
     call inside or outside jit. With an unsharded/absent sequence axis this
     degrades to one local chunk (exact attention). ``kv_mask`` is a (B, S)
     key-padding mask (True/1 = attend), sequence-sharded like k/v — each
-    chunk's mask rotates around the ring with it."""
+    chunk's mask rotates around the ring with it.
+
+    ``impl``: "fused" runs the Pallas flash kernels inside every ring chunk
+    (forward AND backward — a custom VJP rings the kv gradients home with
+    their chunks); "einsum" is the unfused oracle path; "auto" picks fused
+    whenever the local chunk is block-aligned and no kv_mask is given.
+    """
+    if impl not in ("auto", "fused", "einsum"):
+        raise ValueError(f"impl must be auto|fused|einsum, got {impl!r}")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if mesh is None:
@@ -163,10 +318,40 @@ def ring_attention(
     # with a small batch on a large mesh) — sequence sharding still applies.
     use_batch = tuple(batch_axes) if batch_group > 1 and q.shape[0] % batch_group == 0 else None
     spec = P(use_batch, axis_name, None, None)
-    mask_spec = P(use_batch, axis_name)
+
+    n_shards = mesh.shape[axis_name]
+    s_local = q.shape[1] // n_shards if q.shape[1] % n_shards == 0 else 0
+    block = _fused_block(s_local) if s_local else None
+    use_fused = impl == "fused" or (impl == "auto" and kv_mask is None and block is not None)
+    if use_fused:
+        if kv_mask is not None:
+            raise NotImplementedError("impl='fused' does not take kv_mask; use 'einsum'")
+        if not s_local:
+            raise ValueError(
+                f"impl='fused' needs sequence length {q.shape[1]} divisible "
+                f"by the {n_shards}-way '{axis_name}' mesh axis"
+            )
+        if block is None:
+            raise ValueError(
+                f"impl='fused' needs the local chunk ({s_local}) to be a "
+                "multiple of 128"
+            )
+        from .flash_attention import _interpret_default
+
+        interp = _interpret_default()
+
+        def fused(q, k, v):
+            # custom_vjp nondiff args must be positional
+            return _ring_fused(q, k, v, axis_name, causal, scale, block, interp)
+        shard_fused = jax.shard_map(
+            fused, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        )
+        return shard_fused(q, k, v)
+
     fn = functools.partial(
         _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
+    mask_spec = P(use_batch, axis_name)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(bool)
     shard_fn = jax.shard_map(

@@ -64,14 +64,29 @@ def dequantize_array(d: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.
     return (d[_QUANT_KEY].astype(jnp.float32) * d["scale"]).astype(dtype)
 
 
+# Path patterns whose weights carry EXTRA leading stack axes beyond the
+# scan-over-layers one (value = total stack dims). MoE experts are stacked
+# (layer, expert, ...): each expert must keep independent scales.
+DEFAULT_STACK_DIM_PATTERNS: tuple[tuple[str, int], ...] = (
+    (r"moe", 2),
+    (r"expert", 2),
+)
+
+
 def quantize_pytree(
     tree: Any,
     *,
     skip_patterns: tuple[str, ...] = DEFAULT_SKIP_PATTERNS,
     min_size: int = 4096,
+    stack_dim_patterns: tuple[tuple[str, int], ...] = DEFAULT_STACK_DIM_PATTERNS,
 ) -> Any:
     """Quantize eligible float leaves (big matmul weights); embeddings and
-    anything matching ``skip_patterns`` stay full precision."""
+    anything matching ``skip_patterns`` stay full precision.
+
+    ``stack_dim_patterns`` maps path regexes to the number of leading stack
+    axes whose slices must keep independent scales — extend it when a model
+    stacks weights along extra axes under different names.
+    """
 
     from ..parallel.sharding import _path_str  # lazy: avoids an import cycle
 
@@ -83,9 +98,11 @@ def quantize_pytree(
             return leaf
         if leaf.size < min_size or leaf.ndim < 2:
             return leaf
-        # MoE expert weights are stacked (layer, expert, ...): both leading
-        # axes are stack dims, so each expert keeps independent scales.
-        stack = 2 if "moe" in path_s and leaf.ndim >= 4 else None
+        stack = None
+        for pat, dims in stack_dim_patterns:
+            if re.search(pat, path_s) and leaf.ndim >= dims + 2:
+                stack = dims
+                break
         return quantize_array(leaf, stack_dims=stack)
 
     return jax.tree_util.tree_map_with_path(visit, tree)
