@@ -16,6 +16,7 @@ from .mesh import (
     replicated_sharding,
     single_device_mesh,
 )
+from .tp import get_tp_plan, list_tp_plans, register_tp_plan
 from .pipeline import (
     Pipeline,
     build_pipeline,
