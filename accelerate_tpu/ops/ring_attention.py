@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, SEQUENCE_AXIS
+from .in_jit import ring_neighbors
 
 _NEG_INF = -1e30
 
@@ -91,7 +92,7 @@ def _ring_attention_local(
     B, S, H, h = q.shape
     rows = jnp.arange(S)[:, None]
     cols = jnp.arange(S)[None, :]
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_neighbors(axis_name, n)
 
     m0 = jnp.full((B, S, H), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, S, H), jnp.float32)
@@ -147,7 +148,7 @@ def _ring_fused_fwd_impl(q, k, v, axis_name, causal, scale, block, interpret):
     my = jax.lax.axis_index(axis_name)
     qt = q.transpose(0, 2, 1, 3)  # (B, H, S, h)
     S = qt.shape[2]
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_neighbors(axis_name, n)
 
     def chunk(kk, vv, chunk_causal):
         oc, lsec = _fwd(
@@ -207,7 +208,7 @@ def _ring_fused_bwd(axis_name, causal, scale, block, interpret, residuals, g):
     q, k, v, o, lse = residuals
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_neighbors(axis_name, n)
     qt = q.transpose(0, 2, 1, 3)
     dot_ = g.transpose(0, 2, 1, 3)
     ot = o.transpose(0, 2, 1, 3)
