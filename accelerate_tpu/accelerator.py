@@ -48,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .data.loader import DataLoader
 from .ops import collectives as _ops
+from .ops import fp8 as _fp8
 from .parallel.mesh import BATCH_AXES, MeshConfig, batch_sharding, data_parallel_size
 from .parallel.sharding import (
     ShardingStrategy,
@@ -64,6 +65,21 @@ from .utils.dataclasses import (
     ProjectConfiguration,
 )
 from .utils.random import set_seed as _set_seed
+
+
+def _warn_fp8_noop() -> None:
+    """mixed_precision='fp8' only has an effect for models whose projections
+    route through `matmul_einsum` (the in-repo model zoo does; arbitrary user
+    models may not). Runs at trace time, so it fires once per compilation."""
+    import warnings
+
+    warnings.warn(
+        "mixed_precision='fp8' had no effect: the traced loss_fn never routed "
+        "a matmul through accelerate_tpu.models.layers.matmul_einsum, so the "
+        "whole step ran in bf16. Use the in-repo model layers (or call "
+        "matmul_einsum for your projections) to get real fp8 matmuls.",
+        stacklevel=2,
+    )
 
 
 class DynamicLossScale(struct.PyTreeNode):
@@ -408,7 +424,13 @@ class Accelerator:
         def compute_loss(params: Any, batch: Any, rng: jax.Array, scale: jax.Array):
             cparams = policy.cast_for_compute(params)
             cbatch = policy.cast_for_compute(batch)
-            out = loss_fn(cparams, cbatch, rng)
+            # Under fp8, the model traces with matmuls lowered to scaled-fp8
+            # contractions (ops/fp8.py); the mode is read at trace time, so
+            # the compiled step bakes it in.
+            with _fp8.fp8_matmuls(policy.fp8):
+                out = loss_fn(cparams, cbatch, rng)
+                if policy.fp8 and _fp8.fp8_hits() == 0:
+                    _warn_fp8_noop()
             if has_aux:
                 loss, aux = out
             else:
@@ -538,7 +560,8 @@ class Accelerator:
         policy = self.policy
 
         def eval_fn(state: TrainState, batch: Any) -> Any:
-            return fn(policy.cast_for_compute(state.params), batch)
+            with _fp8.fp8_matmuls(policy.fp8):
+                return fn(policy.cast_for_compute(state.params), batch)
 
         return jax.jit(eval_fn)
 

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.fp8 import matmul_einsum  # noqa: F401  (re-export: every projection routes through it)
+
 Params = Any
 
 
@@ -77,11 +79,13 @@ def dot_product_attention(
     v: jax.Array,
     *,
     mask: jax.Array | None = None,
+    bias: jax.Array | None = None,
     causal: bool = False,
     scale: float | None = None,
 ) -> jax.Array:
     """Reference (non-fused) attention. q: (B, S, H, h), k/v: (B, T, K, h)
-    with grouped-query broadcast when K < H. fp32 softmax.
+    with grouped-query broadcast when K < H. fp32 softmax. ``bias`` is an
+    additive (H, S, T) logit bias (T5-style relative position bias).
 
     The fused path lives in `ops/flash_attention.py` (Pallas) and the
     sequence-parallel path in `ops/ring_attention.py`; this function is the
@@ -102,6 +106,10 @@ def dot_product_attention(
         q = q.reshape(B, S, K, group, h)
     scale = scale if scale is not None else 1.0 / np.sqrt(h)
     logits = logits * scale
+
+    if bias is not None:
+        # (H, S, T) -> (1, K, group, S, T) matching the logits layout
+        logits = logits + bias.astype(jnp.float32).reshape(1, K, group, S, T)
 
     if causal:
         causal_mask = jnp.tril(jnp.ones((S, T), bool), k=T - S)
@@ -146,14 +154,14 @@ def init_attention(rng: jax.Array, spec: AttentionSpec, dtype=jnp.float32) -> Pa
 
 
 def attention_qkv(params: Params, x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
-    q = jnp.einsum("bsd,dhk->bshk", x, params["wq"].astype(x.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", x, params["wk"].astype(x.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", x, params["wv"].astype(x.dtype))
+    q = matmul_einsum("bsd,dhk->bshk", x, params["wq"])
+    k = matmul_einsum("bsd,dhk->bshk", x, params["wk"])
+    v = matmul_einsum("bsd,dhk->bshk", x, params["wv"])
     return q, k, v
 
 
 def attention_out(params: Params, attn: jax.Array) -> jax.Array:
-    return jnp.einsum("bshk,hkd->bsd", attn, params["wo"].astype(attn.dtype))
+    return matmul_einsum("bshk,hkd->bsd", attn, params["wo"])
 
 
 # ------------------------------------------------------------------------ mlp
@@ -169,10 +177,10 @@ def init_swiglu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> P
 
 
 def swiglu(params: Params, x: jax.Array) -> jax.Array:
-    gate = jnp.einsum("bsd,df->bsf", x, params["w_gate"].astype(x.dtype))
-    up = jnp.einsum("bsd,df->bsf", x, params["w_up"].astype(x.dtype))
+    gate = matmul_einsum("bsd,df->bsf", x, params["w_gate"])
+    up = matmul_einsum("bsd,df->bsf", x, params["w_up"])
     hidden = jax.nn.silu(gate) * up
-    return jnp.einsum("bsf,fd->bsd", hidden, params["w_down"].astype(x.dtype))
+    return matmul_einsum("bsf,fd->bsd", hidden, params["w_down"])
 
 
 def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> Params:
@@ -186,9 +194,9 @@ def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) ->
 
 
 def mlp_gelu(params: Params, x: jax.Array) -> jax.Array:
-    h = jnp.einsum("bsd,df->bsf", x, params["w_in"].astype(x.dtype)) + params["b_in"].astype(x.dtype)
+    h = matmul_einsum("bsd,df->bsf", x, params["w_in"]) + params["b_in"].astype(x.dtype)
     h = jax.nn.gelu(h, approximate=True)
-    return jnp.einsum("bsf,fd->bsd", h, params["w_out"].astype(x.dtype)) + params["b_out"].astype(x.dtype)
+    return matmul_einsum("bsf,fd->bsd", h, params["w_out"]) + params["b_out"].astype(x.dtype)
 
 
 # ----------------------------------------------------------------------- loss

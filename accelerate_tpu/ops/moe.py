@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.layers import truncated_normal_init
+from .fp8 import matmul_einsum
 
 Params = Any
 
@@ -97,12 +98,15 @@ def _group_moe(params: Params, xt: jax.Array, *, top_k: int, capacity: int):
         importance = importance + jnp.mean(onehot.astype(jnp.float32), axis=0)
         remaining = remaining * (1.0 - onehot.astype(probs.dtype))
 
-    # Dispatch -> expert FFN -> combine.
+    # Dispatch -> expert FFN -> combine. The expert projections (the FLOPs)
+    # route through `matmul_einsum` so fp8 mode covers them; the one-hot
+    # dispatch/combine contractions are data movement, not matmuls, and stay
+    # in the compute dtype.
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, xt)  # (E, C, d)
-    gate_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"].astype(xt.dtype))
-    up_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(xt.dtype))
+    gate_h = matmul_einsum("ecd,edf->ecf", expert_in, params["w_gate"])
+    up_h = matmul_einsum("ecd,edf->ecf", expert_in, params["w_up"])
     hidden = jax.nn.silu(gate_h) * up_h
-    expert_out = jnp.einsum("ecf,efd->ecd", hidden, params["w_down"].astype(xt.dtype))
+    expert_out = matmul_einsum("ecf,efd->ecd", hidden, params["w_down"])
     out = jnp.einsum("nec,ecd->nd", combine.astype(xt.dtype), expert_out)
 
     # Renormalize: dropped tokens keep whatever gate mass survived; the usual

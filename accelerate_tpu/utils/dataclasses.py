@@ -106,18 +106,22 @@ class MixedPrecisionPolicy:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32
     output_dtype: Any = jnp.float32
+    # fp8 is NOT a blanket cast (that would silently produce garbage): it
+    # keeps bf16 activations/params at call boundaries and routes the
+    # matmul-shaped einsums through dynamically-scaled e4m3/e5m2
+    # contractions (`ops/fp8.py` — the torchao-recipe analog of the
+    # reference's `utils/ao.py:103` `convert_model_to_fp8_ao`).
+    fp8: bool = False
 
     @classmethod
     def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
         precision = PrecisionType(precision)
         if precision == PrecisionType.FP8:
-            # A blanket e4m3 cast would silently produce garbage; real fp8
-            # needs per-tensor scaling (delayed-scaling recipe) that this
-            # framework does not implement yet. Refuse rather than corrupt.
-            raise NotImplementedError(
-                "mixed_precision='fp8' is not implemented: fp8 matmuls need "
-                "per-tensor scaling, not a blanket cast. Use 'bf16' (the "
-                "TPU-native choice) or 'fp16'."
+            return cls(
+                param_dtype=jnp.float32,
+                compute_dtype=jnp.bfloat16,
+                output_dtype=jnp.float32,
+                fp8=True,
             )
         if precision == PrecisionType.NO:
             return cls()
