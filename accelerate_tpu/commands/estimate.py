@@ -20,6 +20,15 @@ _MODEL_PRESETS = {
     "llama3-70b": ("llama", "llama3_70b"),
     "bert-base": ("bert", "bert_base"),
     "bert-tiny": ("bert", "tiny"),
+    "gpt2": ("gpt", "gpt2"),
+    "gpt2-xl": ("gpt", "gpt2_xl"),
+    "gpt-tiny": ("gpt", "tiny"),
+    "t5-small": ("t5", "t5_small"),
+    "t5-base": ("t5", "t5_base"),
+    "t5-tiny": ("t5", "tiny"),
+    "vit-base": ("vit", "vit_base"),
+    "vit-large": ("vit", "vit_large"),
+    "vit-tiny": ("vit", "tiny"),
 }
 
 
@@ -67,7 +76,11 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
 
     family, preset = _MODEL_PRESETS[model]
     module = getattr(models, family)
-    config = getattr(module.__dict__[f"{family.capitalize()}Config"], preset)()
+    config_cls = next(
+        v for k, v in module.__dict__.items()
+        if k.lower() == f"{family}config" and isinstance(v, type)
+    )
+    config = getattr(config_cls, preset)()
 
     # Exact parameter count via abstract evaluation — nothing materializes.
     shapes = jax.eval_shape(lambda rng: module.init(rng, config), jax.random.PRNGKey(0))
@@ -83,7 +96,12 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
     opt_b = n_params * 4 * moments / shards
 
     d_model = config.d_model
-    n_layers = config.n_layers
+    n_layers = getattr(config, "n_layers", None)
+    if n_layers is None:  # encoder-decoder families
+        n_layers = config.n_encoder_layers + config.n_decoder_layers
+    if hasattr(config, "n_patches"):  # vision: sequence = patches + [CLS]
+        seq_len = config.n_patches + 1
+    eff_seq = seq_len
     per_layer_act = batch_size * seq_len * d_model * compute_bytes
     if remat:
         # One residual stream per layer boundary + current-layer working set.
@@ -98,6 +116,7 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
     total = params_b + compute_copy_b + grads_b + opt_b + act_b + logits_b
     return {
         "config": config,
+        "seq_len": eff_seq,
         "n_params": n_params,
         "params": params_b,
         "compute_copy": compute_copy_b,
@@ -118,7 +137,7 @@ def run(args: argparse.Namespace) -> int:
         args.optimizer, args.shards, args.remat,
     )
     print(f"Model: {args.model}  ({r['n_params']:,} params)")
-    print(f"Assumptions: batch={args.batch_size} seq={args.seq_len} "
+    print(f"Assumptions: batch={args.batch_size} seq={r['seq_len']} "
           f"precision={args.precision} optimizer={args.optimizer} "
           f"shards={args.shards} remat={args.remat}")
     print()

@@ -8,7 +8,7 @@ module exposes: a frozen ``*Config``, ``init(rng, config) -> params``,
 (`parallel/tp.py`).
 """
 
-from . import bert, llama
+from . import bert, gpt, llama, t5, vit
 from .layers import cross_entropy_loss, dot_product_attention
 
-__all__ = ["bert", "llama", "cross_entropy_loss", "dot_product_attention"]
+__all__ = ["bert", "gpt", "llama", "t5", "vit", "cross_entropy_loss", "dot_product_attention"]

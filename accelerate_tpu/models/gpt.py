@@ -1,0 +1,297 @@
+"""GPT-2-style decoder family.
+
+Widens the model zoo to the reference's breadth: the reference trains
+GPT-class models through Megatron's `GPTTrainStep` (reference
+`utils/megatron_lm.py:588`) and serves GPT-J/GPT-NeoX through big-model
+inference (reference `benchmarks/big_model_inference/README.md`). Same
+TPU-native skeleton as `models/llama.py` (scan-over-layers, optional remat,
+pluggable attention) with the GPT architectural choices:
+
+- learned absolute position embeddings (``wpe``) instead of RoPE;
+- pre-LN `layer_norm` (scale+bias) instead of RMSNorm;
+- full multi-head attention (no GQA) + gelu MLP with biases;
+- LM head tied to the token embedding (GPT-2 ties by default).
+
+Attention projections are bias-free: the q/k/v/o biases in the original
+GPT-2 contribute nothing measurable and dropping them keeps the projections
+on the shared `layers.matmul_einsum` path (bf16/fp8 policy for free).
+
+The TP/FSDP plan is registered in `parallel/tp.py` as ``"gpt"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import (
+    AttentionSpec,
+    attention_out,
+    attention_qkv,
+    cross_entropy_loss,
+    dot_product_attention,
+    init_attention,
+    init_mlp_gelu,
+    layer_norm,
+    mlp_gelu,
+    truncated_normal_init,
+)
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    num_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    remat: bool = False
+    remat_policy: str = "block_outputs"
+    attention_impl: str = "dot"  # "dot" | "flash"
+    z_loss: float = 0.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def attention_spec(self) -> AttentionSpec:
+        return AttentionSpec(self.d_model, self.num_heads, self.num_heads, self.head_dim)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "GPTConfig":
+        defaults = dict(
+            vocab_size=256, d_model=64, n_layers=2, num_heads=4, d_ff=128, max_seq_len=128
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def gpt2(cls, **overrides: Any) -> "GPTConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def gpt2_xl(cls, **overrides: Any) -> "GPTConfig":
+        return cls(**{**dict(d_model=1600, n_layers=48, num_heads=25, d_ff=6400), **overrides})
+
+    def param_count(self) -> int:
+        attn = 4 * self.d_model * self.d_model
+        ffn = 2 * self.d_model * self.d_ff + self.d_ff + self.d_model
+        norms = 2 * 2 * self.d_model
+        block = attn + ffn + norms
+        embed = self.vocab_size * self.d_model + self.max_seq_len * self.d_model
+        head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
+        return self.n_layers * block + embed + 2 * self.d_model + head
+
+    def flops_per_token(self) -> float:
+        return 6.0 * self.param_count() + 12.0 * self.n_layers * self.d_model * self.max_seq_len
+
+
+def init_block(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
+    ka, km = jax.random.split(rng)
+    return {
+        "ln1_scale": jnp.ones((config.d_model,), dtype),
+        "ln1_bias": jnp.zeros((config.d_model,), dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype),
+        "ln2_scale": jnp.ones((config.d_model,), dtype),
+        "ln2_bias": jnp.zeros((config.d_model,), dtype),
+        "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),
+    }
+
+
+def init(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
+    """Layer params stacked along a leading ``n_layers`` axis (scan layout)."""
+    k_tok, k_pos, k_blocks, k_head = jax.random.split(rng, 4)
+    block_keys = jax.random.split(k_blocks, config.n_layers)
+    blocks = jax.vmap(lambda k: init_block(k, config, dtype))(block_keys)
+    params = {
+        "wte": truncated_normal_init(k_tok, (config.vocab_size, config.d_model), 0.02, dtype),
+        "wpe": truncated_normal_init(k_pos, (config.max_seq_len, config.d_model), 0.01, dtype),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((config.d_model,), dtype),
+        "lnf_bias": jnp.zeros((config.d_model,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = truncated_normal_init(
+            k_head, (config.d_model, config.vocab_size), 1.0 / np.sqrt(config.d_model), dtype
+        )
+    return params
+
+
+def _attention(config: GPTConfig, q, k, v, mask):
+    if config.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, segment_mask=mask)
+    if config.attention_impl != "dot":
+        raise ValueError(
+            f"Unknown attention_impl {config.attention_impl!r}; expected 'dot' or 'flash'"
+        )
+    return dot_product_attention(q, k, v, mask=mask, causal=True)
+
+
+def block_forward(
+    block: Params,
+    x: jax.Array,
+    *,
+    config: GPTConfig,
+    mask: jax.Array | None,
+) -> jax.Array:
+    from jax.ad_checkpoint import checkpoint_name
+
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+    q, k, v = attention_qkv(block["attn"], h)
+    attn = _attention(config, q, k, v, mask)
+    x = x + checkpoint_name(attention_out(block["attn"], attn), "attn_out")
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+    x = x + checkpoint_name(mlp_gelu(block["mlp"], h), "ffn_out")
+    return x
+
+
+def _logits(params: Params, x: jax.Array, config: GPTConfig) -> jax.Array:
+    head = params["wte"].T if config.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: GPTConfig,
+    *,
+    positions: jax.Array | None = None,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["wte"][tokens] + params["wpe"][positions]
+
+    body = partial(block_forward, config=config, mask=mask)
+    if config.remat:
+        from .llama import _remat_policy
+
+        body = jax.checkpoint(body, policy=_remat_policy(config.remat_policy))
+
+    def scan_body(carry, block):
+        return body(block, carry), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], config.norm_eps)
+    return _logits(params, x, config)
+
+
+# ---------------------------------------------------------------- KV cache
+def init_cache(
+    config: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
+) -> dict[str, jax.Array]:
+    shape = (config.n_layers, batch_size, max_len, config.num_heads, config.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_with_cache(
+    params: Params,
+    tokens: jax.Array,
+    cache: dict[str, jax.Array],
+    config: GPTConfig,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Incremental forward (prefill or decode) against the KV cache."""
+    B, T_new = tokens.shape
+    max_len = cache["k"].shape[2]
+    start = cache["length"]
+    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T_new))
+    cache_pos = jnp.arange(max_len, dtype=jnp.int32)
+    mask = cache_pos[None, None, :] <= positions[:, :, None]
+
+    x = params["wte"][tokens] + params["wpe"][positions]
+
+    def scan_body(carry, xs):
+        x = carry
+        block, k_cache, v_cache = xs
+        h = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+        q, k, v = attention_qkv(block["attn"], h)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+        attn = dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
+        )
+        x = x + attention_out(block["attn"], attn)
+        h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+        x = x + mlp_gelu(block["mlp"], h)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], config.norm_eps)
+    logits = _logits(params, x, config)
+    return logits, {"k": new_k, "v": new_v, "length": start + T_new}
+
+
+@functools.lru_cache(maxsize=16)
+def _generator(config: GPTConfig, generation_config: Any, jit_loop: bool):
+    from ..generation import Generator
+
+    return Generator(
+        lambda p, t, c: forward_with_cache(p, t, c, config),
+        lambda b, m: init_cache(config, b, m),
+        generation_config,
+        jit_loop=jit_loop,
+    )
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    config: GPTConfig,
+    *,
+    generation_config: Any = None,
+    rng: jax.Array | None = None,
+    jit_loop: bool = True,
+) -> jax.Array:
+    gen = _generator(config, generation_config, jit_loop)
+    total = prompt.shape[1] + gen.config.max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({gen.config.max_new_tokens}) = {total} exceeds "
+            f"max_seq_len={config.max_seq_len}"
+        )
+    return gen(params, prompt, rng=rng)
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    config: GPTConfig,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Next-token prediction. batch: {"input_ids": (B, S)} with optional
+    "labels" and "attention_mask" (same contract as `llama.loss_fn`)."""
+    tokens = batch["input_ids"]
+    labels = batch.get("labels")
+    attn_mask = batch.get("attention_mask")
+    logits = forward(params, tokens, config, mask=attn_mask)
+    if labels is None:
+        labels = tokens[:, 1:]
+        loss_mask = attn_mask[:, 1:] if attn_mask is not None else None
+        logits = logits[:, :-1]
+    else:
+        loss_mask = attn_mask
+    return cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)

@@ -176,11 +176,17 @@ def init_swiglu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> P
     }
 
 
-def swiglu(params: Params, x: jax.Array) -> jax.Array:
+def gated_mlp(params: Params, x: jax.Array, activation=jax.nn.silu) -> jax.Array:
+    """Gated MLP over {w_gate, w_up, w_down}: swiglu with silu (llama),
+    gated-gelu with gelu (T5 v1.1)."""
     gate = matmul_einsum("bsd,df->bsf", x, params["w_gate"])
     up = matmul_einsum("bsd,df->bsf", x, params["w_up"])
-    hidden = jax.nn.silu(gate) * up
+    hidden = activation(gate) * up
     return matmul_einsum("bsf,fd->bsd", hidden, params["w_down"])
+
+
+def swiglu(params: Params, x: jax.Array) -> jax.Array:
+    return gated_mlp(params, x, jax.nn.silu)
 
 
 def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) -> Params:

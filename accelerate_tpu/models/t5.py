@@ -1,0 +1,353 @@
+"""T5-style encoder-decoder family.
+
+Completes the Megatron model-type trio the reference drives (BERT / GPT / T5
+train steps, reference `utils/megatron_lm.py:446/:588/:720`). Same TPU-native
+skeleton as the other families (scan-over-layers, stacked block params,
+einsum projections on the shared `matmul_einsum` path) with the T5
+architectural choices:
+
+- relative position bias instead of absolute positions: one learned
+  ``(num_buckets, num_heads)`` table per stack, shared by all layers of that
+  stack (exactly T5's sharing scheme), added to the attention logits;
+- RMSNorm pre-norm, bias-free projections, unscaled attention (T5 folds the
+  1/sqrt(h) into init);
+- gated-gelu MLP (T5 v1.1) built on the shared matmul path;
+- decoder = causal self-attention + cross-attention over the encoder output;
+- logits tied to the input embedding with the T5 ``d_model**-0.5`` rescale.
+
+`generate` is a greedy/temperature loop that re-runs the decoder on the
+growing target (no KV cache: T5-class seq2seq targets are short; the
+decoder-only families own the cached decode path).
+
+TP/FSDP plan registered in `parallel/tp.py` as ``"t5"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import (
+    AttentionSpec,
+    cross_entropy_loss,
+    dot_product_attention,
+    gated_mlp,
+    init_attention,
+    init_swiglu,
+    matmul_einsum,
+    rms_norm,
+    truncated_normal_init,
+)
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    n_encoder_layers: int = 6
+    n_decoder_layers: int = 6
+    num_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 1024
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    norm_eps: float = 1e-6
+    tie_embeddings: bool = True
+    remat: bool = False
+    z_loss: float = 0.0
+
+    @property
+    def attention_spec(self) -> AttentionSpec:
+        return AttentionSpec(self.d_model, self.num_heads, self.num_heads, self.head_dim)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "T5Config":
+        defaults = dict(
+            vocab_size=256, d_model=64, n_encoder_layers=2, n_decoder_layers=2,
+            num_heads=4, head_dim=16, d_ff=128, rel_buckets=8, rel_max_distance=20,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def t5_small(cls, **overrides: Any) -> "T5Config":
+        return cls(**overrides)
+
+    @classmethod
+    def t5_base(cls, **overrides: Any) -> "T5Config":
+        return cls(**{**dict(
+            d_model=768, n_encoder_layers=12, n_decoder_layers=12,
+            num_heads=12, d_ff=2048,
+        ), **overrides})
+
+    def param_count(self) -> int:
+        d, f, H, h = self.d_model, self.d_ff, self.num_heads, self.head_dim
+        attn = d * H * h * 4
+        mlp = 3 * d * f
+        enc_block = attn + mlp + 2 * d
+        dec_block = 2 * attn + mlp + 3 * d
+        rel = 2 * self.rel_buckets * H
+        embed = self.vocab_size * d * (1 if self.tie_embeddings else 2)
+        return (
+            self.n_encoder_layers * enc_block
+            + self.n_decoder_layers * dec_block
+            + rel + embed + 2 * d
+        )
+
+
+# ------------------------------------------------------- relative positions
+def relative_position_bucket(
+    relative_position: jax.Array,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """T5's log-bucketed relative positions: half the buckets cover exact
+    small offsets, the other half log-spaced offsets up to ``max_distance``."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+def _rel_bias(table: jax.Array, S: int, T: int, config: T5Config, *, bidirectional: bool) -> jax.Array:
+    """(num_buckets, H) table -> (H, S, T) additive logit bias."""
+    ctx = jnp.arange(S, dtype=jnp.int32)[:, None]
+    mem = jnp.arange(T, dtype=jnp.int32)[None, :]
+    buckets = relative_position_bucket(
+        mem - ctx,
+        bidirectional=bidirectional,
+        num_buckets=config.rel_buckets,
+        max_distance=config.rel_max_distance,
+    )
+    return jnp.transpose(table[buckets], (2, 0, 1))  # (S, T, H) -> (H, S, T)
+
+
+# ------------------------------------------------------------------- blocks
+def _gated_gelu(params: Params, x: jax.Array) -> jax.Array:
+    """T5 v1.1 gated-gelu on the shared gated-MLP block (layers.gated_mlp)."""
+    return gated_mlp(params, x, partial(jax.nn.gelu, approximate=True))
+
+
+def _attn(params: Params, x: jax.Array, kv: jax.Array, *, mask, bias, causal) -> jax.Array:
+    q = matmul_einsum("bsd,dhk->bshk", x, params["wq"])
+    k = matmul_einsum("bsd,dhk->bshk", kv, params["wk"])
+    v = matmul_einsum("bsd,dhk->bshk", kv, params["wv"])
+    # T5 folds 1/sqrt(h) into initialization: unscaled attention.
+    attn = dot_product_attention(q, k, v, mask=mask, bias=bias, causal=causal, scale=1.0)
+    return matmul_einsum("bshk,hkd->bsd", attn, params["wo"])
+
+
+def _init_t5_attention(rng: jax.Array, config: T5Config, dtype) -> Params:
+    """T5 runs UNSCALED attention and compensates in init: wq gets an extra
+    head_dim**-0.5 so q.k logits at init have the same scale a 1/sqrt(h)
+    -scaled attention would (without this, logits are ~sqrt(h) too large and
+    the softmax saturates from step 0 at real head dims)."""
+    attn = init_attention(rng, config.attention_spec, dtype)
+    attn["wq"] = attn["wq"] * (config.head_dim**-0.5)
+    return attn
+
+
+def _init_encoder_block(rng: jax.Array, config: T5Config, dtype) -> Params:
+    ka, km = jax.random.split(rng)
+    return {
+        "attn_norm": jnp.zeros((config.d_model,), dtype),
+        "attn": _init_t5_attention(ka, config, dtype),
+        "mlp_norm": jnp.zeros((config.d_model,), dtype),
+        "mlp": init_swiglu(km, config.d_model, config.d_ff, dtype),
+    }
+
+
+def _init_decoder_block(rng: jax.Array, config: T5Config, dtype) -> Params:
+    ka, kc, km = jax.random.split(rng, 3)
+    return {
+        "self_norm": jnp.zeros((config.d_model,), dtype),
+        "self_attn": _init_t5_attention(ka, config, dtype),
+        "cross_norm": jnp.zeros((config.d_model,), dtype),
+        "cross_attn": _init_t5_attention(kc, config, dtype),
+        "mlp_norm": jnp.zeros((config.d_model,), dtype),
+        "mlp": init_swiglu(km, config.d_model, config.d_ff, dtype),
+    }
+
+
+def init(rng: jax.Array, config: T5Config, dtype=jnp.float32) -> Params:
+    k_embed, k_enc, k_dec, k_re, k_rd, k_head = jax.random.split(rng, 6)
+    enc_keys = jax.random.split(k_enc, config.n_encoder_layers)
+    dec_keys = jax.random.split(k_dec, config.n_decoder_layers)
+    params = {
+        "embed": truncated_normal_init(k_embed, (config.vocab_size, config.d_model), 1.0, dtype),
+        "enc_rel_bias": truncated_normal_init(
+            k_re, (config.rel_buckets, config.num_heads), 0.02, dtype
+        ),
+        "dec_rel_bias": truncated_normal_init(
+            k_rd, (config.rel_buckets, config.num_heads), 0.02, dtype
+        ),
+        "encoder": jax.vmap(lambda k: _init_encoder_block(k, config, dtype))(enc_keys),
+        "enc_final_norm": jnp.zeros((config.d_model,), dtype),
+        "decoder": jax.vmap(lambda k: _init_decoder_block(k, config, dtype))(dec_keys),
+        "dec_final_norm": jnp.zeros((config.d_model,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = truncated_normal_init(
+            k_head, (config.d_model, config.vocab_size), 1.0 / np.sqrt(config.d_model), dtype
+        )
+    return params
+
+
+# ------------------------------------------------------------------ forward
+def encode(
+    params: Params,
+    input_ids: jax.Array,
+    config: T5Config,
+    *,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    """input_ids (B, S) -> encoder states (B, S, D)."""
+    B, S = input_ids.shape
+    x = params["embed"][input_ids]
+    bias = _rel_bias(params["enc_rel_bias"], S, S, config, bidirectional=True)
+
+    def body(block, carry):
+        h = rms_norm(carry, block["attn_norm"], config.norm_eps)
+        carry = carry + _attn(block["attn"], h, h, mask=attention_mask, bias=bias, causal=False)
+        h = rms_norm(carry, block["mlp_norm"], config.norm_eps)
+        return carry + _gated_gelu(block["mlp"], h)
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(lambda c, b: (body(b, c), None), x, params["encoder"])
+    return rms_norm(x, params["enc_final_norm"], config.norm_eps)
+
+
+def decode(
+    params: Params,
+    decoder_input_ids: jax.Array,
+    encoder_states: jax.Array,
+    config: T5Config,
+    *,
+    encoder_mask: jax.Array | None = None,
+) -> jax.Array:
+    """decoder_input_ids (B, T) + encoder states -> logits (B, T, vocab)."""
+    B, T = decoder_input_ids.shape
+    x = params["embed"][decoder_input_ids]
+    bias = _rel_bias(params["dec_rel_bias"], T, T, config, bidirectional=False)
+
+    def body(block, carry):
+        h = rms_norm(carry, block["self_norm"], config.norm_eps)
+        carry = carry + _attn(block["self_attn"], h, h, mask=None, bias=bias, causal=True)
+        h = rms_norm(carry, block["cross_norm"], config.norm_eps)
+        carry = carry + _attn(
+            block["cross_attn"], h, encoder_states.astype(h.dtype),
+            mask=encoder_mask, bias=None, causal=False,
+        )
+        h = rms_norm(carry, block["mlp_norm"], config.norm_eps)
+        return carry + _gated_gelu(block["mlp"], h)
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(lambda c, b: (body(b, c), None), x, params["decoder"])
+    x = rms_norm(x, params["dec_final_norm"], config.norm_eps)
+    if config.tie_embeddings:
+        # T5 rescales tied logits by d_model**-0.5.
+        head = params["embed"].T
+        return jnp.einsum("btd,dv->btv", x * (config.d_model**-0.5), head.astype(x.dtype))
+    return jnp.einsum("btd,dv->btv", x, params["lm_head"].astype(x.dtype))
+
+
+def forward(
+    params: Params,
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    config: T5Config,
+    *,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    enc = encode(params, input_ids, config, attention_mask=attention_mask)
+    return decode(params, decoder_input_ids, enc, config, encoder_mask=attention_mask)
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    config: T5Config,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Seq2seq LM loss. batch: {"input_ids", "decoder_input_ids"} plus
+    optional "labels" (defaults to next-token on the decoder side),
+    "attention_mask" (encoder padding), "decoder_attention_mask" (loss mask)."""
+    dec_in = batch["decoder_input_ids"]
+    labels = batch.get("labels")
+    dec_mask = batch.get("decoder_attention_mask")
+    logits = forward(
+        params, batch["input_ids"], dec_in, config,
+        attention_mask=batch.get("attention_mask"),
+    )
+    if labels is None:
+        labels = dec_in[:, 1:]
+        loss_mask = dec_mask[:, 1:] if dec_mask is not None else None
+        logits = logits[:, :-1]
+    else:
+        loss_mask = dec_mask
+    return cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_encode(config: T5Config):
+    return jax.jit(lambda p, i, m: encode(p, i, config, attention_mask=m))
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_decode(config: T5Config):
+    return jax.jit(lambda p, d, e, m: decode(p, d, e, config, encoder_mask=m))
+
+
+def generate(
+    params: Params,
+    input_ids: jax.Array,
+    config: T5Config,
+    *,
+    max_new_tokens: int = 32,
+    bos_token_id: int = 0,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy (or sampled) seq2seq generation: encode once, re-run the
+    decoder on the growing target. Returns (B, max_new_tokens) tokens
+    (including no BOS). O(T^2) decoder work — fine for seq2seq-length
+    targets; cached decode belongs to the decoder-only families."""
+    B = input_ids.shape[0]
+    enc = _jitted_encode(config)(params, input_ids, attention_mask)
+    dec_step = _jitted_decode(config)
+    tokens = jnp.full((B, 1), bos_token_id, jnp.int32)
+    for i in range(max_new_tokens):
+        logits = dec_step(params, tokens, enc, attention_mask)[:, -1]
+        if temperature > 0.0:
+            rng, step_rng = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0))
+            nxt = jax.random.categorical(step_rng, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
+    return tokens[:, 1:]

@@ -1,0 +1,190 @@
+"""Vision Transformer (ViT) — the vision model family.
+
+The reference's vision story is `examples/cv_example.py` (torchvision
+resnet50 fine-tune); the tracked config in BASELINE.md is "cv_example
+(data-parallel)". A TPU-native framework wants a transformer vision
+backbone instead: patch-embedding is one big matmul (MXU-friendly, unlike
+stride-heavy convs), and the encoder reuses the exact block structure,
+sharding plans, and kernels the text families already exercise.
+
+- patchify = reshape + one linear projection on the shared `matmul_einsum`
+  path (equivalent to the non-overlapping conv, but lowered as a single
+  (B*N, P*P*C) x (P*P*C, D) matmul);
+- learned [CLS] token + learned position embeddings;
+- pre-LN encoder blocks identical in shape to `models/gpt.py` blocks
+  (bidirectional attention — no causal mask);
+- classification head on the [CLS] representation.
+
+TP/FSDP plan registered in `parallel/tp.py` as ``"vit"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import (
+    AttentionSpec,
+    attention_out,
+    attention_qkv,
+    dot_product_attention,
+    init_attention,
+    init_mlp_gelu,
+    layer_norm,
+    matmul_einsum,
+    mlp_gelu,
+    truncated_normal_init,
+)
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    num_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    norm_eps: float = 1e-6
+    remat: bool = False
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def attention_spec(self) -> AttentionSpec:
+        return AttentionSpec(self.d_model, self.num_heads, self.num_heads, self.d_model // self.num_heads)
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "ViTConfig":
+        defaults = dict(
+            image_size=32, patch_size=8, d_model=64, n_layers=2,
+            num_heads=4, d_ff=128, num_classes=4,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def vit_base(cls, **overrides: Any) -> "ViTConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def vit_large(cls, **overrides: Any) -> "ViTConfig":
+        return cls(**{**dict(d_model=1024, n_layers=24, num_heads=16, d_ff=4096), **overrides})
+
+    def param_count(self) -> int:
+        d, f = self.d_model, self.d_ff
+        block = 4 * d * d + 2 * d * f + f + d + 4 * d
+        patch = self.patch_dim * d + d
+        pos = (self.n_patches + 1) * d
+        head = d * self.num_classes + self.num_classes
+        return self.n_layers * block + patch + pos + d + 2 * d + head
+
+
+def init_block(rng: jax.Array, config: ViTConfig, dtype=jnp.float32) -> Params:
+    ka, km = jax.random.split(rng)
+    return {
+        "ln1_scale": jnp.ones((config.d_model,), dtype),
+        "ln1_bias": jnp.zeros((config.d_model,), dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype),
+        "ln2_scale": jnp.ones((config.d_model,), dtype),
+        "ln2_bias": jnp.zeros((config.d_model,), dtype),
+        "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),
+    }
+
+
+def init(rng: jax.Array, config: ViTConfig, dtype=jnp.float32) -> Params:
+    k_patch, k_cls, k_pos, k_blocks, k_head = jax.random.split(rng, 5)
+    block_keys = jax.random.split(k_blocks, config.n_layers)
+    return {
+        "patch_proj": {
+            "w": truncated_normal_init(
+                k_patch, (config.patch_dim, config.d_model), 1.0 / np.sqrt(config.patch_dim), dtype
+            ),
+            "b": jnp.zeros((config.d_model,), dtype),
+        },
+        "cls_token": truncated_normal_init(k_cls, (config.d_model,), 0.02, dtype),
+        "pos_embed": truncated_normal_init(
+            k_pos, (config.n_patches + 1, config.d_model), 0.02, dtype
+        ),
+        "blocks": jax.vmap(lambda k: init_block(k, config, dtype))(block_keys),
+        "lnf_scale": jnp.ones((config.d_model,), dtype),
+        "lnf_bias": jnp.zeros((config.d_model,), dtype),
+        "head": {
+            "w": truncated_normal_init(k_head, (config.d_model, config.num_classes), 0.02, dtype),
+            "b": jnp.zeros((config.num_classes,), dtype),
+        },
+    }
+
+
+def patchify(images: jax.Array, config: ViTConfig) -> jax.Array:
+    """(B, H, W, C) -> (B, N, P*P*C) non-overlapping patches."""
+    B, H, W, C = images.shape
+    p = config.patch_size
+    if H != config.image_size or W != config.image_size or C != config.channels:
+        raise ValueError(
+            f"expected {(config.image_size, config.image_size, config.channels)} "
+            f"images, got {(H, W, C)}"
+        )
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))  # (B, Hp, Wp, p, p, C)
+    return x.reshape(B, config.n_patches, config.patch_dim)
+
+
+def block_forward(block: Params, x: jax.Array, *, config: ViTConfig) -> jax.Array:
+    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+    q, k, v = attention_qkv(block["attn"], h)
+    x = x + attention_out(block["attn"], dot_product_attention(q, k, v))
+    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+    return x + mlp_gelu(block["mlp"], h)
+
+
+def forward(params: Params, images: jax.Array, config: ViTConfig) -> jax.Array:
+    """images (B, H, W, C) -> class logits (B, num_classes)."""
+    patches = patchify(images, config)
+    x = matmul_einsum("bsd,df->bsf", patches, params["patch_proj"]["w"])
+    x = x + params["patch_proj"]["b"].astype(x.dtype)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"].astype(x.dtype), (B, 1, x.shape[-1]))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(x.dtype)[None]
+
+    body = partial(block_forward, config=config)
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(lambda c, b: (body(b, c), None), x, params["blocks"])
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], config.norm_eps)
+    cls_repr = x[:, 0]
+    head = params["head"]
+    return cls_repr @ head["w"].astype(cls_repr.dtype) + head["b"].astype(cls_repr.dtype)
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    config: ViTConfig,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """batch: {"pixel_values": (B, H, W, C), "labels": (B,)}."""
+    logits = forward(params, batch["pixel_values"], config).astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logprobs, batch["labels"][:, None], axis=-1))
+
+
+def accuracy(params: Params, batch: dict[str, jax.Array], config: ViTConfig) -> jax.Array:
+    logits = forward(params, batch["pixel_values"], config)
+    return jnp.mean((jnp.argmax(logits, axis=-1) == batch["labels"]).astype(jnp.float32))

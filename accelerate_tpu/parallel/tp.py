@@ -70,6 +70,54 @@ register_tp_plan(
     ),
 )
 
+# ----------------------------------------------------------------------- gpt
+# Layout (gpt.init): MHA (no GQA), gelu MLP with biases, learned positions.
+register_tp_plan(
+    "gpt",
+    (
+        (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
+        (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/mlp/w_in$", P(None, F, T)),
+        (r"blocks/mlp/b_in$", P(None, T)),
+        (r"blocks/mlp/w_out$", P(None, T, F)),
+        (r"^wte$", P(T, F)),
+        (r"^wpe$", P(None, F)),
+        (r"^lm_head$", P(F, T)),
+        (r"ln", P()),
+    ),
+)
+
+# ------------------------------------------------------------------------ t5
+# Layout (t5.init): encoder/decoder stacks with self/cross attention and
+# gated-gelu MLPs; per-stack relative-bias tables stay replicated.
+register_tp_plan(
+    "t5",
+    (
+        (r"(encoder|decoder)/(self_|cross_)?attn/w[qkv]$", P(None, F, T, None)),
+        (r"(encoder|decoder)/(self_|cross_)?attn/wo$", P(None, T, None, F)),
+        (r"(encoder|decoder)/mlp/w_(gate|up)$", P(None, F, T)),
+        (r"(encoder|decoder)/mlp/w_down$", P(None, T, F)),
+        (r"^embed$", P(T, F)),
+        (r"^lm_head$", P(F, T)),
+        (r"rel_bias|norm", P()),
+    ),
+)
+
+# ----------------------------------------------------------------------- vit
+register_tp_plan(
+    "vit",
+    (
+        (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
+        (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/mlp/w_in$", P(None, F, T)),
+        (r"blocks/mlp/b_in$", P(None, T)),
+        (r"blocks/mlp/w_out$", P(None, T, F)),
+        (r"patch_proj/w$", P(F, T)),
+        (r"pos_embed|cls_token|patch_proj/b", P()),
+        (r"ln|head", P()),
+    ),
+)
+
 # ---------------------------------------------------------------------- bert
 register_tp_plan(
     "bert",

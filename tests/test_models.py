@@ -14,7 +14,7 @@ import optax
 import pytest
 
 from accelerate_tpu import Accelerator, MeshConfig
-from accelerate_tpu.models import bert, llama
+from accelerate_tpu.models import bert, gpt, llama, t5, vit
 from accelerate_tpu.parallel.sharding import ShardingStrategy, infer_param_specs, shard_pytree
 from accelerate_tpu.parallel.tp import get_tp_plan
 from accelerate_tpu.utils.dataclasses import ShardingStrategyType
@@ -188,4 +188,266 @@ class TestBert:
         param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, spec)
         sharded = shard_pytree(params, param_specs, acc.mesh)
         out = jax.jit(lambda p, b: bert.classify(p, b, config))(sharded, batch)
+        np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=2e-4, rtol=2e-4)
+
+
+class TestGPT:
+    def test_forward_shape_and_param_count(self):
+        config = gpt.GPTConfig.tiny()
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert actual == config.param_count()
+        logits = gpt.forward(params, jnp.zeros((2, 8), jnp.int32), config)
+        assert logits.shape == (2, 8, config.vocab_size)
+
+    def test_causality(self):
+        config = gpt.GPTConfig.tiny()
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, config.vocab_size, jnp.int32)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % config.vocab_size)
+        l1 = gpt.forward(params, t1, config)
+        l2 = gpt.forward(params, t2, config)
+        np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+
+    def test_untied_head(self):
+        config = gpt.GPTConfig.tiny(tie_embeddings=False)
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        assert "lm_head" in params
+        logits = gpt.forward(params, jnp.zeros((1, 4), jnp.int32), config)
+        assert logits.shape == (1, 4, config.vocab_size)
+
+    def test_training_decreases_loss(self):
+        config = gpt.GPTConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        state = acc.create_train_state(lambda rng: gpt.init(rng, config), optax.adam(1e-3))
+        step = acc.make_train_step(lambda p, b, r: gpt.loss_fn(p, b, config, r))
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(42), (8, 16), 0, config.vocab_size, jnp.int32
+            )
+        }
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_tp_forward_matches_replicated(self):
+        config = gpt.GPTConfig.tiny()
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, config.vocab_size, jnp.int32)
+        expected = np.asarray(gpt.forward(params, tokens, config), np.float32)
+        acc = Accelerator(
+            mesh_config=MeshConfig(data=2, tensor=4),
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=get_tp_plan("gpt"),
+        )
+        spec = ShardingStrategy.resolve("TENSOR_PARALLEL", rules=get_tp_plan("gpt"))
+        param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, spec)
+        sharded = shard_pytree(params, param_specs, acc.mesh)
+        out = jax.jit(lambda p, t: gpt.forward(p, t, config))(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=2e-4, rtol=2e-4)
+
+    def test_tp_plan_actually_shards(self):
+        config = gpt.GPTConfig.tiny()
+        acc = Accelerator(
+            mesh_config=MeshConfig(data=2, tensor=4),
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=get_tp_plan("gpt"),
+        )
+        state = acc.create_train_state(lambda rng: gpt.init(rng, config), optax.sgd(1e-3))
+        wq = state.params["blocks"]["attn"]["wq"]
+        shard_shape = wq.sharding.shard_shape(wq.shape)
+        assert shard_shape[2] == wq.shape[2] // 4
+
+    def test_generate_greedy_matches_forward(self):
+        """One greedy step from the cache path must agree with the full
+        forward's argmax (cache correctness oracle)."""
+        from accelerate_tpu.generation import GenerationConfig
+
+        config = gpt.GPTConfig.tiny()
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0, config.vocab_size, jnp.int32)
+        out = gpt.generate(
+            params, prompt, config,
+            generation_config=GenerationConfig(max_new_tokens=4, temperature=0.0),
+        )
+        assert out.shape == (2, 16)
+        logits = gpt.forward(params, prompt, config)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 12]), np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        )
+
+    def test_remat_matches(self):
+        config = gpt.GPTConfig.tiny()
+        config_r = gpt.GPTConfig.tiny(remat=True)
+        params = gpt.init(jax.random.PRNGKey(0), config)
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(3), (2, 8), 0, config.vocab_size, jnp.int32
+            )
+        }
+        g1 = jax.grad(lambda p: gpt.loss_fn(p, batch, config))(params)
+        g2 = jax.grad(lambda p: gpt.loss_fn(p, batch, config_r))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g1, g2)
+
+
+class TestT5:
+    def test_shapes_and_param_count(self):
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert actual == config.param_count()
+        logits = t5.forward(
+            params, jnp.zeros((2, 10), jnp.int32), jnp.zeros((2, 6), jnp.int32), config
+        )
+        assert logits.shape == (2, 6, config.vocab_size)
+
+    def test_decoder_causality(self):
+        """Changing a future decoder token must not change past logits."""
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        src = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, config.vocab_size, jnp.int32)
+        d1 = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, config.vocab_size, jnp.int32)
+        d2 = d1.at[0, -1].set((d1[0, -1] + 1) % config.vocab_size)
+        l1 = t5.forward(params, src, d1, config)
+        l2 = t5.forward(params, src, d2, config)
+        np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+
+    def test_encoder_is_bidirectional(self):
+        """Encoder states must depend on later source tokens (no causal mask)."""
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        s1 = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, config.vocab_size, jnp.int32)
+        s2 = s1.at[0, -1].set((s1[0, -1] + 1) % config.vocab_size)
+        e1 = t5.encode(params, s1, config)
+        e2 = t5.encode(params, s2, config)
+        assert not np.allclose(np.asarray(e1[0, 0]), np.asarray(e2[0, 0]), atol=1e-7)
+
+    def test_rel_bucket_properties(self):
+        # bidirectional: sign distinguishes direction; monotone in distance
+        rp = jnp.arange(-20, 21)[None, :]
+        b = t5.relative_position_bucket(rp, bidirectional=True, num_buckets=32, max_distance=128)
+        assert b.min() >= 0 and b.max() < 32
+        assert int(b[0, 20]) == 0  # zero offset -> bucket 0
+        b_causal = t5.relative_position_bucket(rp, bidirectional=False, num_buckets=32, max_distance=128)
+        assert b_causal.min() >= 0 and b_causal.max() < 32
+
+    def test_src_padding_masked_out(self):
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        src = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, config.vocab_size, jnp.int32)
+        mask = jnp.ones((1, 8), jnp.int32).at[0, 5:].set(0)
+        dec = jnp.zeros((1, 4), jnp.int32)
+        l1 = t5.forward(params, src, dec, config, attention_mask=mask)
+        src2 = src.at[0, 6].set((src[0, 6] + 3) % config.vocab_size)
+        l2 = t5.forward(params, src2, dec, config, attention_mask=mask)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+    def test_training_decreases_loss(self):
+        config = t5.T5Config.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        state = acc.create_train_state(lambda rng: t5.init(rng, config), optax.adam(1e-3))
+        step = acc.make_train_step(lambda p, b, r: t5.loss_fn(p, b, config, r))
+        batch = {
+            "input_ids": jax.random.randint(jax.random.PRNGKey(4), (8, 12), 0, config.vocab_size, jnp.int32),
+            "decoder_input_ids": jax.random.randint(jax.random.PRNGKey(5), (8, 8), 0, config.vocab_size, jnp.int32),
+        }
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_tp_forward_matches_replicated(self):
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        src = jax.random.randint(jax.random.PRNGKey(1), (8, 12), 0, config.vocab_size, jnp.int32)
+        dec = jax.random.randint(jax.random.PRNGKey(2), (8, 8), 0, config.vocab_size, jnp.int32)
+        expected = np.asarray(t5.forward(params, src, dec, config), np.float32)
+        acc = Accelerator(
+            mesh_config=MeshConfig(data=2, tensor=4),
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=get_tp_plan("t5"),
+        )
+        spec = ShardingStrategy.resolve("TENSOR_PARALLEL", rules=get_tp_plan("t5"))
+        param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, spec)
+        sharded = shard_pytree(params, param_specs, acc.mesh)
+        out = jax.jit(lambda p, s, d: t5.forward(p, s, d, config))(sharded, src, dec)
+        np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=2e-4, rtol=2e-4)
+
+    def test_generate_greedy(self):
+        config = t5.T5Config.tiny()
+        params = t5.init(jax.random.PRNGKey(0), config)
+        src = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, config.vocab_size, jnp.int32)
+        out = t5.generate(params, src, config, max_new_tokens=5)
+        assert out.shape == (2, 5)
+        # greedy first token must equal the argmax of a single decode step
+        enc = t5.encode(params, src, config)
+        logits = t5.decode(params, jnp.zeros((2, 1), jnp.int32), enc, config)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 0]), np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        )
+
+
+class TestViT:
+    def test_shapes_and_param_count(self):
+        config = vit.ViTConfig.tiny()
+        params = vit.init(jax.random.PRNGKey(0), config)
+        actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert actual == config.param_count()
+        images = jnp.zeros((2, 32, 32, 3))
+        logits = vit.forward(params, images, config)
+        assert logits.shape == (2, config.num_classes)
+
+    def test_patchify_roundtrip(self):
+        """Patch extraction preserves pixels (reshape, not resample)."""
+        config = vit.ViTConfig.tiny()
+        images = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 32, 3))
+        patches = vit.patchify(images, config)
+        assert patches.shape == (1, config.n_patches, config.patch_dim)
+        # first patch = top-left 8x8 block
+        np.testing.assert_allclose(
+            np.asarray(patches[0, 0]), np.asarray(images[0, :8, :8, :]).reshape(-1)
+        )
+
+    def test_permutation_changes_prediction(self):
+        """Spatial information must matter (pos embeddings active)."""
+        config = vit.ViTConfig.tiny()
+        params = vit.init(jax.random.PRNGKey(0), config)
+        images = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 32, 3))
+        flipped = images[:, ::-1]
+        l1 = vit.forward(params, images, config)
+        l2 = vit.forward(params, flipped, config)
+        assert not np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-7)
+
+    def test_training_decreases_loss(self):
+        config = vit.ViTConfig.tiny()
+        acc = Accelerator(mesh_config=MeshConfig(), seed=0)
+        state = acc.create_train_state(lambda rng: vit.init(rng, config), optax.adam(1e-3))
+        step = acc.make_train_step(lambda p, b, r: vit.loss_fn(p, b, config, r))
+        batch = {
+            "pixel_values": jax.random.normal(jax.random.PRNGKey(2), (8, 32, 32, 3)),
+            "labels": jax.random.randint(jax.random.PRNGKey(3), (8,), 0, config.num_classes, jnp.int32),
+        }
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_tp_forward_matches_replicated(self):
+        config = vit.ViTConfig.tiny()
+        params = vit.init(jax.random.PRNGKey(0), config)
+        images = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+        expected = np.asarray(vit.forward(params, images, config), np.float32)
+        acc = Accelerator(
+            mesh_config=MeshConfig(data=2, tensor=4),
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=get_tp_plan("vit"),
+        )
+        spec = ShardingStrategy.resolve("TENSOR_PARALLEL", rules=get_tp_plan("vit"))
+        param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, spec)
+        sharded = shard_pytree(params, param_specs, acc.mesh)
+        out = jax.jit(lambda p, i: vit.forward(p, i, config))(sharded, images)
         np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=2e-4, rtol=2e-4)
