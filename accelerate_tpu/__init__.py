@@ -18,7 +18,7 @@ from .big_modeling import (
     offload_blocks,
     streamed_scan,
 )
-from .data import DataLoader, prepare_data_loader, skip_first_batches
+from .data import ArrayDataset, DataLoader, prepare_data_loader, skip_first_batches
 from .generation import GenerationConfig, Generator, generate
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import (

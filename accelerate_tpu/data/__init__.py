@@ -1,3 +1,4 @@
+from .array_dataset import ArrayDataset
 from .loader import DataLoader, default_collate, prepare_data_loader, skip_first_batches
 from .sampler import (
     SeedableSampler,

@@ -189,9 +189,18 @@ class DataLoader:
         """Collated host batches containing the full global content."""
         if self._sized:
             dispatch = bool(self.config.dispatch_batches)
+            # Array-backed datasets collate as one native row-gather per leaf
+            # (accelerate_tpu.native) instead of a Python sample loop — only
+            # when the default collate would do the equivalent stacking.
+            fast_gather = (
+                hasattr(self.dataset, "gather_batch")
+                and self.collate_fn is default_collate
+            )
             for idx_batch in self._global_index_batches():
                 if dispatch and not self.state.is_main_process:
                     collated = None
+                elif fast_gather:
+                    collated = self.dataset.gather_batch(idx_batch)
                 else:
                     samples = [self.dataset[i] for i in idx_batch]
                     collated = self.collate_fn(samples)

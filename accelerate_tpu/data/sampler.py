@@ -32,10 +32,24 @@ class SeedableSampler:
     are disjoint by construction.
     """
 
-    def __init__(self, num_samples: int, shuffle: bool = True, seed: int = 0) -> None:
+    def __init__(
+        self,
+        num_samples: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        backend: str = "numpy",
+    ) -> None:
+        """``backend="native"`` shuffles with the C++ Fisher-Yates kernel
+        (`accelerate_tpu.native.permutation`) — same determinism contract
+        (identical order for a (seed, epoch) pair on every process/machine
+        running the native path) but a DIFFERENT order than numpy's PCG64,
+        so switching backends mid-training reshuffles the epoch."""
+        if backend not in ("numpy", "native"):
+            raise ValueError(f"backend must be 'numpy' or 'native', got {backend!r}")
         self.num_samples = num_samples
         self.shuffle = shuffle
         self.seed = seed
+        self.backend = backend
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -45,11 +59,15 @@ class SeedableSampler:
         return self.num_samples
 
     def __iter__(self) -> Iterator[int]:
-        if self.shuffle:
+        if not self.shuffle:
+            yield from range(self.num_samples)
+        elif self.backend == "native":
+            from ..native import permutation
+
+            yield from permutation(self.num_samples, seed=self.seed + self.epoch).tolist()
+        else:
             rng = np.random.RandomState(seed=(self.seed + self.epoch) % (2**32))
             yield from rng.permutation(self.num_samples).tolist()
-        else:
-            yield from range(self.num_samples)
 
 
 def batch_indices(

@@ -40,6 +40,7 @@ from .layers import (
     init_mlp_gelu,
     layer_norm,
     mlp_gelu,
+    remat_policy,
     truncated_normal_init,
 )
 
@@ -174,15 +175,17 @@ def forward(
 ) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab)."""
     B, S = tokens.shape
+    if S > config.max_seq_len:
+        # XLA gathers clamp out-of-range rows, which would silently hand
+        # every position past the table its last row.
+        raise ValueError(f"sequence length {S} exceeds max_seq_len={config.max_seq_len}")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = params["wte"][tokens] + params["wpe"][positions]
 
     body = partial(block_forward, config=config, mask=mask)
     if config.remat:
-        from .llama import _remat_policy
-
-        body = jax.checkpoint(body, policy=_remat_policy(config.remat_policy))
+        body = jax.checkpoint(body, policy=remat_policy(config.remat_policy))
 
     def scan_body(carry, block):
         return body(block, carry), None

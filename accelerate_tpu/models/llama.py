@@ -39,6 +39,7 @@ from .layers import (
     init_attention,
     init_swiglu,
     rms_norm,
+    remat_policy,
     rope_frequencies,
     swiglu,
     truncated_normal_init,
@@ -162,28 +163,7 @@ def init(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
     return params
 
 
-def _remat_policy(name: str):
-    """Resolve a remat policy name to a `jax.checkpoint` policy."""
-    if name == "nothing":
-        return None  # jax.checkpoint default: save nothing, recompute all
-    if name == "dots":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    if name == "block_outputs":
-        return jax.checkpoint_policies.save_only_these_names("attn_out", "ffn_out")
-    if name == "attn_and_outputs":
-        # Additionally keep the rotated q/k/v so the backward skips the qkv
-        # projections + rope recompute. The flash forward kernel itself still
-        # re-runs (its lse residual is internal to the custom_vjp and can't be
-        # kept by a name policy), so this trades ~64MB/layer for only the qkv
-        # recompute — measured neutral at bench scale; useful when qkv is a
-        # larger fraction (big d_model, short S).
-        return jax.checkpoint_policies.save_only_these_names(
-            "attn_out", "ffn_out", "q_rope", "k_rope", "v_proj"
-        )
-    raise ValueError(
-        f"Unknown remat_policy {name!r}; expected 'nothing', 'dots', "
-        "'block_outputs', or 'attn_and_outputs'"
-    )
+_remat_policy = remat_policy  # shared impl in layers.py
 
 
 def _attention(config: LlamaConfig, q, k, v, mask):
@@ -272,6 +252,10 @@ def forward(
     With ``return_aux`` (MoE training) returns ``(logits, aux)`` where aux
     holds the per-layer-averaged router losses."""
     B, S = tokens.shape
+    if S > config.max_seq_len:
+        # RoPE table gathers clamp out-of-range positions under jit, which
+        # would silently degrade instead of failing.
+        raise ValueError(f"sequence length {S} exceeds max_seq_len={config.max_seq_len}")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)

@@ -341,13 +341,16 @@ def generate(
     B = input_ids.shape[0]
     enc = _jitted_encode(config)(params, input_ids, attention_mask)
     dec_step = _jitted_decode(config)
-    tokens = jnp.full((B, 1), bos_token_id, jnp.int32)
+    # Fixed-shape target buffer: the decoder always sees (B, max_new_tokens+1),
+    # so the whole loop costs ONE compilation. Causal self-attention makes the
+    # not-yet-written suffix (zeros) invisible to the position being read.
+    tokens = jnp.zeros((B, max_new_tokens + 1), jnp.int32).at[:, 0].set(bos_token_id)
     for i in range(max_new_tokens):
-        logits = dec_step(params, tokens, enc, attention_mask)[:, -1]
+        logits = dec_step(params, tokens, enc, attention_mask)[:, i]
         if temperature > 0.0:
             rng, step_rng = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0))
             nxt = jax.random.categorical(step_rng, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
+        tokens = tokens.at[:, i + 1].set(nxt.astype(jnp.int32))
     return tokens[:, 1:]

@@ -1,0 +1,153 @@
+"""Native (C++) host data-path: threaded batch gather + seeded shuffle.
+
+The reference's input pipeline delegates its native side to torch's C++
+DataLoader core; here the equivalent lives in `hostloader.cpp`, compiled on
+first use with the system toolchain (`g++ -O3 -shared -fPIC` — no pybind11
+in the image, so bindings are plain-C ABI through ctypes) and cached next to
+the source. Everything degrades gracefully: if no toolchain is available,
+the numpy fallbacks below keep identical semantics (`gather_rows` is
+bit-identical; `permutation` documents its own determinism contract).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Any
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "hostloader.cpp")
+_LOCK = threading.Lock()
+_LIB: Any = None
+_LIB_ERR: str | None = None
+_DEFAULT_THREADS = min(8, os.cpu_count() or 1)
+
+
+def _build_and_load() -> Any:
+    """Compile (if needed) and dlopen the native library. Raises on failure."""
+    cache_dir = os.environ.get(
+        "ATX_NATIVE_CACHE", os.path.join(tempfile.gettempdir(), "atx_native")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    src_mtime = int(os.path.getmtime(_SRC))
+    so_path = os.path.join(cache_dir, f"hostloader_{src_mtime}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=120)
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    lib.atx_gather_rows.restype = ctypes.c_longlong
+    lib.atx_gather_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.atx_shuffle.restype = None
+    lib.atx_shuffle.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong, ctypes.c_uint64
+    ]
+    lib.atx_permutation.restype = None
+    lib.atx_permutation.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong, ctypes.c_uint64
+    ]
+    return lib
+
+
+def _lib() -> Any:
+    """The loaded native library, or None if unavailable (cached verdict)."""
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is None and _LIB_ERR is None:
+            if os.environ.get("ATX_DISABLE_NATIVE"):
+                _LIB_ERR = "disabled via ATX_DISABLE_NATIVE"
+                return None
+            try:
+                _LIB = _build_and_load()
+            except Exception as e:  # no toolchain / sandboxed tmp / bad cc
+                _LIB_ERR = f"{type(e).__name__}: {e}"
+    return _LIB
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+def native_error() -> str | None:
+    """Why the native path is off (None when it's on)."""
+    _lib()
+    return _LIB_ERR
+
+
+def gather_rows(
+    src: np.ndarray, indices: Any, *, n_threads: int | None = None
+) -> np.ndarray:
+    """``src[indices]`` along axis 0 into a freshly-allocated contiguous
+    array — the batch-assembly primitive. Native path: multi-threaded
+    memcpy outside the GIL; fallback: numpy fancy indexing (bit-identical).
+    """
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    if idx.ndim != 1:
+        raise ValueError(f"indices must be 1-D, got shape {idx.shape}")
+    src = np.asarray(src)
+    # One bounds contract on both paths (numpy fancy indexing would silently
+    # wrap negatives; the native kernel rejects them).
+    if idx.size and (
+        int(idx.min()) < 0 or (src.ndim and int(idx.max()) >= src.shape[0])
+    ):
+        bad = idx[(idx < 0) | (idx >= (src.shape[0] if src.ndim else 0))][0]
+        raise IndexError(
+            f"index {int(bad)} out of bounds for axis 0 with size "
+            f"{src.shape[0] if src.ndim else 0}"
+        )
+    lib = _lib()
+    # Non-contiguous sources: ascontiguousarray would copy the WHOLE dataset
+    # per batch; numpy's strided fancy indexing copies only the batch rows.
+    if lib is None or src.ndim == 0 or not src.flags.c_contiguous:
+        return src[idx]
+    out = np.empty((idx.shape[0],) + src.shape[1:], dtype=src.dtype)
+    row_bytes = src.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes == 0 or idx.shape[0] == 0:
+        return src[idx]
+    rc = lib.atx_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        src.shape[0],
+        row_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        idx.shape[0],
+        out.ctypes.data_as(ctypes.c_char_p),
+        int(n_threads if n_threads is not None else _DEFAULT_THREADS),
+    )
+    if rc >= 0:  # unreachable after the Python-side check; kernel backstop
+        raise IndexError(f"index {int(idx[rc])} out of bounds (native)")
+    return out
+
+
+def permutation(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of range(n) keyed by ``seed``.
+
+    The native and fallback paths use DIFFERENT generators (splitmix64
+    Fisher-Yates vs numpy PCG64) — both are deterministic in the seed, but
+    the orders differ. Callers that must reproduce an order across machines
+    with and without a toolchain should use `numpy.random.Generator`
+    directly; `SeedableSampler` therefore defaults to its numpy backend and
+    routes here only with ``backend="native"`` (`data/sampler.py`).
+    """
+    lib = _lib()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n).astype(np.int64)
+    out = np.empty(n, dtype=np.int64)
+    if n:
+        lib.atx_permutation(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            n,
+            ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
+        )
+    return out

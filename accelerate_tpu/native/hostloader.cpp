@@ -1,0 +1,90 @@
+// Native host-side data-path kernels for accelerate_tpu.
+//
+// The reference's input pipeline rides torch's C++ DataLoader machinery
+// (worker pool, pinned-memory batch assembly); this is the TPU-native
+// equivalent for the host side of the pipeline: assembling the next global
+// batch must outrun the device step, and the Python-loop + np.stack path
+// holds the GIL and copies twice. These kernels do the two hot operations
+// with no Python in the loop:
+//
+//   atx_gather_rows  — gather dataset rows by index into one contiguous
+//                      batch buffer, multi-threaded memcpy (the collate path
+//                      for array-backed datasets).
+//   atx_shuffle      — Fisher-Yates permutation driven by splitmix64
+//                      (deterministic in the seed, O(n), no numpy RNG
+//                      state to carry).
+//
+// Built by native/build.py with `g++ -O3 -shared -fPIC`; loaded via ctypes
+// (no pybind11 in the image). Every entry point is plain C ABI.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather n rows of row_bytes each: dst[i] = src[indices[i]] for i in [0, n).
+// src must be C-contiguous with rows of exactly row_bytes. Negative indices
+// or indices >= src_rows return the offending position (first error);
+// returns -1 on success.
+long long atx_gather_rows(const char* src, long long src_rows,
+                          long long row_bytes, const long long* indices,
+                          long long n, char* dst, int n_threads) {
+    for (long long i = 0; i < n; ++i) {
+        if (indices[i] < 0 || indices[i] >= src_rows) return i;
+    }
+    if (n_threads <= 1 || n < n_threads * 4) {
+        for (long long i = 0; i < n; ++i) {
+            std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                        static_cast<size_t>(row_bytes));
+        }
+        return -1;
+    }
+    std::vector<std::thread> workers;
+    workers.reserve(n_threads);
+    long long chunk = (n + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        long long begin = t * chunk;
+        long long end = begin + chunk < n ? begin + chunk : n;
+        if (begin >= end) break;
+        workers.emplace_back([=]() {
+            for (long long i = begin; i < end; ++i) {
+                std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                            static_cast<size_t>(row_bytes));
+            }
+        });
+    }
+    for (auto& w : workers) w.join();
+    return -1;
+}
+
+static inline uint64_t splitmix64(uint64_t& state) {
+    uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+// In-place Fisher-Yates over indices[0..n) seeded by `seed` (deterministic).
+void atx_shuffle(long long* indices, long long n, uint64_t seed) {
+    uint64_t state = seed;
+    for (long long i = n - 1; i > 0; --i) {
+        // Unbiased bounded draw (Lemire); bias is < 2^-64 * n, irrelevant
+        // for dataset sizes, so the simple multiply-shift is fine.
+        uint64_t r = splitmix64(state);
+        __uint128_t m = static_cast<__uint128_t>(r) * static_cast<__uint128_t>(i + 1);
+        long long j = static_cast<long long>(m >> 64);
+        long long tmp = indices[i];
+        indices[i] = indices[j];
+        indices[j] = tmp;
+    }
+}
+
+// iota + shuffle in one call (saves a Python-side arange for big datasets).
+void atx_permutation(long long* out, long long n, uint64_t seed) {
+    for (long long i = 0; i < n; ++i) out[i] = i;
+    atx_shuffle(out, n, seed);
+}
+
+}  // extern "C"

@@ -451,3 +451,16 @@ class TestViT:
         sharded = shard_pytree(params, param_specs, acc.mesh)
         out = jax.jit(lambda p, i: vit.forward(p, i, config))(sharded, images)
         np.testing.assert_allclose(np.asarray(out, np.float32), expected, atol=2e-4, rtol=2e-4)
+
+
+def test_seq_len_overflow_raises():
+    """Position/RoPE tables clamp under jit; the forwards must refuse instead
+    of silently degrading."""
+    gcfg = gpt.GPTConfig.tiny(max_seq_len=16)
+    gparams = gpt.init(jax.random.PRNGKey(0), gcfg)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gpt.forward(gparams, jnp.zeros((1, 32), jnp.int32), gcfg)
+    lcfg = llama.LlamaConfig.tiny(max_seq_len=16)
+    lparams = llama.init(jax.random.PRNGKey(0), lcfg)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        llama.forward(lparams, jnp.zeros((1, 32), jnp.int32), lcfg)
