@@ -36,6 +36,7 @@ import threading
 from typing import TYPE_CHECKING, Any, Iterable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -266,8 +267,18 @@ def load_pytree(target: Any, directory: str) -> Any:
                         f"Shape mismatch for {key!r}: target {tuple(leaf.shape)} vs "
                         f"checkpoint {shape}"
                     )
-                sharding = leaf.sharding
                 target_dtype = leaf.dtype
+                if not getattr(leaf, "committed", True):
+                    # An uncommitted target (e.g. the scalar `step` from
+                    # jnp.zeros) must restore uncommitted: rebuilding it via
+                    # make_array_from_callback would COMMIT it to its current
+                    # device, and a later jit over committed mesh-sharded
+                    # params + a device-0-committed scalar is an error.
+                    out.append(
+                        jnp.asarray(reader.read_full(key).astype(target_dtype))
+                    )
+                    continue
+                sharding = leaf.sharding
                 arr = jax.make_array_from_callback(
                     shape,
                     sharding,

@@ -296,6 +296,17 @@ class DataLoader:
                 current = next(it)
             except StopIteration:
                 self.end_of_dataloader = True
+                if self.skip_batches:
+                    # A resume that landed exactly on the epoch boundary
+                    # (batches_yielded == total at save time) consumes the
+                    # whole offset here. Advance to the next epoch start —
+                    # without this, the stale offset would suppress every
+                    # subsequent epoch's batches too.
+                    self._epoch += 1
+                    self._batches_yielded = 0
+                    self.skip_batches = 0
+                    if self.sampler is not None:
+                        self.sampler.set_epoch(self._epoch)
                 return
             for upcoming in it:
                 self.end_of_dataloader = False
@@ -308,6 +319,11 @@ class DataLoader:
             self._batches_yielded += 1
             yield current
             self._epoch += 1
+            # Position is now "start of the next epoch": zero the consumed
+            # count WITH the epoch bump, or a checkpoint taken after a
+            # completed epoch would pair the new epoch with the old epoch's
+            # batch count and resume by skipping a full epoch of data.
+            self._batches_yielded = 0
             # A mid-epoch resume offset applies only to the resumed epoch.
             self.skip_batches = 0
             if self.sampler is not None:

@@ -1,6 +1,34 @@
 """Shipped test utilities (reference `test_utils/`, 5,156 LoC: the bundled
 self-diagnostic + tiny fixtures pattern, SURVEY.md §2.6/§4)."""
 
+from .testing import (
+    AccelerateTestCase,
+    are_same_tensors,
+    require_cpu,
+    require_devices,
+    require_multi_device,
+    require_multi_process,
+    require_native_toolchain,
+    require_tensorboard,
+    require_tpu,
+    require_wandb,
+    slow,
+)
 from .training import RegressionDataset, regression_init, regression_loss
 
-__all__ = ["RegressionDataset", "regression_init", "regression_loss"]
+__all__ = [
+    "AccelerateTestCase",
+    "RegressionDataset",
+    "are_same_tensors",
+    "regression_init",
+    "regression_loss",
+    "require_cpu",
+    "require_devices",
+    "require_multi_device",
+    "require_multi_process",
+    "require_native_toolchain",
+    "require_tensorboard",
+    "require_tpu",
+    "require_wandb",
+    "slow",
+]

@@ -1,0 +1,138 @@
+"""Capability-gated test decorators + test-case helpers.
+
+The reference ships ~50 ``require_*`` skip decorators and singleton-resetting
+test cases in its package (`test_utils/testing.py:146-541`, `:595-606`) so
+downstream projects can gate their own distributed tests. The TPU build's
+capability matrix is smaller — platform, device count, toolchain, optional
+SaaS deps, slow-test opt-in — but the pattern is the same: decorate, don't
+mock.
+
+All decorators work on test functions and classes (pytest collects the skip
+either way).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import unittest
+from typing import Any, Callable
+
+import jax
+
+
+def _skip_unless(condition: bool, reason: str) -> Callable:
+    def decorate(obj: Any) -> Any:
+        obj = unittest.skipUnless(condition, reason)(obj)
+        if isinstance(obj, type) and not issubclass(obj, unittest.TestCase):
+            # unittest's class skip is only honored by pytest for TestCase
+            # subclasses; plain pytest-style classes need a pytestmark.
+            try:
+                import pytest
+
+                marks = list(getattr(obj, "pytestmark", []))
+                marks.append(pytest.mark.skipif(not condition, reason=reason))
+                obj.pytestmark = marks
+            except ImportError:  # pragma: no cover - pytest is baked in
+                pass
+        return obj
+
+    return decorate
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu" or "TPU" in getattr(
+            jax.devices()[0], "device_kind", ""
+        )
+    except Exception:
+        return False
+
+
+def require_tpu(test: Any) -> Any:
+    """Needs a real TPU chip (the CPU-simulated mesh does not count)."""
+    return _skip_unless(on_tpu(), "test requires a TPU device")(test)
+
+
+def require_cpu(test: Any) -> Any:
+    """Needs the CPU platform (e.g. asserts about host-simulated meshes)."""
+    return _skip_unless(jax.devices()[0].platform == "cpu", "test requires CPU platform")(test)
+
+
+def require_multi_device(test: Any) -> Any:
+    """Needs >= 2 local devices (real or --xla_force_host_platform_device_count)."""
+    return _skip_unless(device_count() >= 2, "test requires multiple devices")(test)
+
+
+def require_devices(n: int) -> Callable:
+    """Needs at least ``n`` local devices."""
+
+    def decorator(test: Any) -> Any:
+        return _skip_unless(device_count() >= n, f"test requires >= {n} devices")(test)
+
+    return decorator
+
+
+def require_multi_process(test: Any) -> Any:
+    """Needs a multi-process (multi-host style) run."""
+    return _skip_unless(jax.process_count() > 1, "test requires multiple processes")(test)
+
+
+def require_native_toolchain(test: Any) -> Any:
+    """Needs the C++ host kernels (`accelerate_tpu.native`) to build/load."""
+    from .. import native
+
+    return _skip_unless(native.native_available(), f"no native toolchain: {native.native_error()}")(test)
+
+
+def _has_module(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def require_tensorboard(test: Any) -> Any:
+    return _skip_unless(_has_module("tensorboardX") or _has_module("tensorboard"),
+                        "test requires tensorboard")(test)
+
+
+def require_wandb(test: Any) -> Any:
+    return _skip_unless(_has_module("wandb"), "test requires wandb")(test)
+
+
+def slow(test: Any) -> Any:
+    """Opt-in long tests: run only with ATX_RUN_SLOW=1 (reference `slow`,
+    `testing.py:146`)."""
+    return _skip_unless(
+        os.environ.get("ATX_RUN_SLOW", "") not in ("", "0", "false"),
+        "slow test: set ATX_RUN_SLOW=1 to run",
+    )(test)
+
+
+class AccelerateTestCase(unittest.TestCase):
+    """Resets the process-wide singletons between tests so one test's
+    Accelerator/mesh cannot leak into the next (reference
+    `AccelerateTestCase`, `testing.py:595-606`)."""
+
+    def tearDown(self) -> None:
+        from ..state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        super().tearDown()
+
+
+def are_same_tensors(a: Any, b: Any, *, atol: float = 1e-6) -> bool:
+    """Cross-pytree allclose (reference `are_the_same_tensors`,
+    `testing.py:641`)."""
+    import numpy as np
+
+    leaves_a, treedef_a = jax.tree_util.tree_flatten(a)
+    leaves_b, treedef_b = jax.tree_util.tree_flatten(b)
+    if treedef_a != treedef_b or len(leaves_a) != len(leaves_b):
+        return False
+    return all(
+        np.allclose(np.asarray(x, np.float32), np.asarray(y, np.float32), atol=atol)
+        for x, y in zip(leaves_a, leaves_b)
+    )

@@ -1,0 +1,129 @@
+"""Causal language modeling — pre-tokenized corpus to trained GPT + samples.
+
+The decoder-training analog of the reference's example set (the reference
+drives GPT-class models through Megatron, `utils/megatron_lm.py:588`, and
+its big-model benchmarks generate with GPT-J/NeoX). This example shows the
+full production loop on the in-repo GPT family:
+
+- corpus as an `ArrayDataset` (pre-tokenized array → native C++ batch gather);
+- one compiled SPMD train step (bf16, grad clipping, accumulation);
+- checkpoint mid-run, then resume and confirm the loss picks up where it
+  left off (`save_state` / `load_state`);
+- greedy generation from the trained model at the end.
+
+Data is SYNTHETIC (no network egress): modular-arithmetic token sequences
+``t_{i+1} = (t_i + stride) mod vocab`` with a per-sequence stride drawn from
+a small set. Predicting the next token requires inferring the stride from
+context — learnable, and trivially checkable at generation time.
+
+Run:
+    python examples/lm_example.py
+    accelerate-tpu launch examples/lm_example.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.generation import GenerationConfig
+from accelerate_tpu.models import gpt
+
+STRIDES = (1, 3, 7)
+
+
+def make_corpus(size: int, seq_len: int, vocab: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, vocab, (size, 1))
+    strides = rng.choice(STRIDES, (size, 1))
+    return ((starts + strides * np.arange(seq_len)) % vocab).astype(np.int32)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--dataset_size", type=int, default=512)
+    parser.add_argument(
+        "--total_steps", type=int, default=None,
+        help="LR-schedule horizon in optimizer steps; pass the ORIGINAL "
+        "run's horizon when resuming, or the restored step counter runs "
+        "off the end of a schedule built from this run's epochs alone",
+    )
+    parser.add_argument("--ckpt_dir", default=None, help="save/resume checkpoint here")
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--mixed_precision", default="bf16")
+    args = parser.parse_args(argv)
+
+    accelerator = atx.Accelerator(
+        mixed_precision=args.mixed_precision, max_grad_norm=1.0, seed=0
+    )
+    config = gpt.GPTConfig(
+        vocab_size=args.vocab, d_model=128, n_layers=4, num_heads=4,
+        d_ff=512, max_seq_len=args.seq_len,
+    )
+
+    corpus = make_corpus(args.dataset_size, args.seq_len, args.vocab, seed=1)
+    dataset = atx.ArrayDataset({"input_ids": corpus})
+    loader = accelerator.prepare_data_loader(
+        dataset, batch_size=args.batch_size, shuffle=True, seed=2
+    )
+
+    total_steps = args.total_steps or args.epochs * len(loader)
+    # alpha keeps the terminal LR at 10% instead of 0, so a resume that
+    # overruns the horizon still trains.
+    tx = optax.adamw(optax.cosine_decay_schedule(args.lr, total_steps, alpha=0.1))
+    state = accelerator.create_train_state(lambda r: gpt.init(r, config), tx)
+    step = accelerator.make_train_step(lambda p, b, r: gpt.loss_fn(p, b, config, r))
+
+    if args.resume:
+        if not args.ckpt_dir:
+            raise SystemExit("--resume needs --ckpt_dir")
+        state = accelerator.load_state(args.ckpt_dir, state)
+        accelerator.print(f"resumed at step {int(state.step)}")
+
+    for epoch in range(args.epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            state, metrics = step(state, batch)
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"grad_norm {float(metrics['grad_norm']):.3f}"
+        )
+        if args.ckpt_dir:
+            accelerator.save_state(args.ckpt_dir, state)
+
+    # Generate greedily and check the model actually learned the arithmetic:
+    # prompt with stride-1 sequences and count correct continuations.
+    prompt = ((7 + np.arange(8)) % args.vocab)[None].astype(np.int32)
+    out = gpt.generate(
+        state.params, jnp.asarray(prompt), config,
+        generation_config=GenerationConfig(max_new_tokens=8),
+    )
+    generated = np.asarray(out[0, 8:])
+    expected = (7 + np.arange(8, 16)) % args.vocab
+    n_correct = int((generated == expected).sum())
+    accelerator.print(f"generated continuation: {generated.tolist()}")
+    accelerator.print(f"expected:               {expected.tolist()}")
+    accelerator.print(f"correct: {n_correct}/8")
+    accelerator.end_training()
+    return n_correct
+
+
+if __name__ == "__main__":
+    n = main()
+    if n < 6:
+        raise SystemExit(f"only {n}/8 generated tokens correct — did not learn")

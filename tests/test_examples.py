@@ -71,3 +71,34 @@ def test_nlp_example_under_launcher_two_processes():
     )
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     assert "epoch 0" in proc.stdout
+
+
+def test_lm_example_learns_and_resumes(tmp_path):
+    m = _load("lm_example")
+    # batch_size is per-process: on the 8-device sim mesh the global batch
+    # is 8x, so keep it small enough for ~100 optimizer steps.
+    n_correct = m.main(
+        [
+            "--epochs", "6",
+            "--dataset_size", "512",
+            "--batch_size", "4",
+            "--seq_len", "32",
+            "--vocab", "64",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+        ]
+    )
+    assert n_correct >= 6, n_correct
+    # resume from the checkpoint and keep training: must not crash, and the
+    # restored step counter continues rather than restarting.
+    n_correct2 = m.main(
+        [
+            "--epochs", "1",
+            "--dataset_size", "512",
+            "--batch_size", "4",
+            "--seq_len", "32",
+            "--vocab", "64",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+            "--resume",
+        ]
+    )
+    assert n_correct2 >= 6, n_correct2

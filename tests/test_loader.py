@@ -154,3 +154,22 @@ def test_mesh_2d_batch_formation():
     (batch,) = list(dl)
     assert batch["x"].shape == (16, 4)
     np.testing.assert_allclose(np.asarray(batch["x"]), ds.x)
+
+
+def test_resume_at_exact_epoch_boundary_recovers():
+    """A checkpoint whose batches_yielded == epoch length (saved while the
+    consumer held the final batch) must advance to the next epoch on resume,
+    not suppress every later epoch."""
+    import numpy as np
+
+    from accelerate_tpu.data import ArrayDataset, DataLoader
+
+    data = {"x": np.arange(64, dtype=np.int32).reshape(32, 2)}
+    loader = DataLoader(ArrayDataset(data), batch_size=1, shuffle=True, seed=0)
+    n_batches = len(loader)
+    loader.load_state_dict({"epoch": 0, "batches_yielded": n_batches, "seed": 0})
+    first = list(loader)   # boundary epoch: nothing left to yield
+    assert first == []
+    second = list(loader)  # next epoch must be full again
+    assert len(second) == n_batches
+    assert loader.state_dict()["epoch"] >= 1

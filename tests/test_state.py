@@ -1,4 +1,5 @@
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -84,3 +85,89 @@ def test_rank_aware_tqdm():
     # so check before consuming)
     assert not bar.disable
     assert list(bar) == [0, 1, 2]
+
+
+class TestRequireDecorators:
+    """Capability gating (reference require_* pattern, testing.py:146-541)."""
+
+    def test_multi_device_passes_on_sim_mesh(self):
+        from accelerate_tpu.test_utils import require_multi_device
+
+        @require_multi_device
+        def probe():
+            return True
+
+        assert probe()  # conftest forces the 8-device CPU mesh
+
+    def test_require_tpu_skips_on_cpu(self):
+        import unittest
+
+        from accelerate_tpu.test_utils import require_tpu
+
+        @require_tpu
+        def probe():
+            return True
+
+        with pytest.raises(unittest.SkipTest):
+            probe()
+
+    def test_require_devices_threshold(self):
+        import unittest
+
+        from accelerate_tpu.test_utils import require_devices
+
+        @require_devices(8)
+        def ok():
+            return True
+
+        assert ok()
+
+        @require_devices(1000)
+        def too_many():
+            return True
+
+        with pytest.raises(unittest.SkipTest):
+            too_many()
+
+    def test_slow_gated_by_env(self, monkeypatch):
+        import unittest
+
+        from accelerate_tpu.test_utils import slow
+
+        monkeypatch.delenv("ATX_RUN_SLOW", raising=False)
+
+        @slow
+        def probe():
+            return True
+
+        with pytest.raises(unittest.SkipTest):
+            probe()
+        monkeypatch.setenv("ATX_RUN_SLOW", "1")
+
+        @slow
+        def probe2():
+            return True
+
+        assert probe2()
+
+    def test_are_same_tensors(self):
+        from accelerate_tpu.test_utils import are_same_tensors
+
+        a = {"x": jnp.ones((2, 2)), "y": jnp.zeros(3)}
+        b = {"x": jnp.ones((2, 2)), "y": jnp.zeros(3)}
+        assert are_same_tensors(a, b)
+        assert not are_same_tensors(a, {"x": jnp.ones((2, 2)), "y": jnp.ones(3)})
+        assert not are_same_tensors(a, {"x": jnp.ones((2, 2))})
+
+
+def test_require_decorator_on_plain_pytest_class():
+    """Plain (non-TestCase) classes must carry a pytest skip mark."""
+    from accelerate_tpu.test_utils import require_tpu
+
+    @require_tpu
+    class Probe:
+        def test_x(self):
+            pass
+
+    marks = getattr(Probe, "pytestmark", [])
+    assert any(m.name == "skipif" and m.args == (True,) for m in marks)
