@@ -302,11 +302,7 @@ class DataLoader:
                     # whole offset here. Advance to the next epoch start —
                     # without this, the stale offset would suppress every
                     # subsequent epoch's batches too.
-                    self._epoch += 1
-                    self._batches_yielded = 0
-                    self.skip_batches = 0
-                    if self.sampler is not None:
-                        self.sampler.set_epoch(self._epoch)
+                    self._advance_epoch()
                 return
             for upcoming in it:
                 self.end_of_dataloader = False
@@ -318,16 +314,7 @@ class DataLoader:
             self.end_of_dataloader = True
             self._batches_yielded += 1
             yield current
-            self._epoch += 1
-            # Position is now "start of the next epoch": zero the consumed
-            # count WITH the epoch bump, or a checkpoint taken after a
-            # completed epoch would pair the new epoch with the old epoch's
-            # batch count and resume by skipping a full epoch of data.
-            self._batches_yielded = 0
-            # A mid-epoch resume offset applies only to the resumed epoch.
-            self.skip_batches = 0
-            if self.sampler is not None:
-                self.sampler.set_epoch(self._epoch)
+            self._advance_epoch()
         finally:
             # Runs on normal exhaustion AND on early break/GC (GeneratorExit):
             # unregister from GradientState and release the prefetch worker.
@@ -335,6 +322,18 @@ class DataLoader:
             if hasattr(it, "close"):
                 it.close()
             self.end()
+
+    def _advance_epoch(self) -> None:
+        """Move the position to "start of the next epoch". The consumed count
+        zeroes WITH the epoch bump (a checkpoint taken after a completed epoch
+        must not pair the new epoch with the old epoch's batch count, or
+        resume would skip a full epoch of data); any mid-epoch resume offset
+        applied only to the epoch that just ended."""
+        self._epoch += 1
+        self._batches_yielded = 0
+        self.skip_batches = 0
+        if self.sampler is not None:
+            self.sampler.set_epoch(self._epoch)
 
     # ------------------------------------------------------ GradientState glue
     def begin(self) -> None:

@@ -117,9 +117,11 @@ class AccelerateTestCase(unittest.TestCase):
     `AccelerateTestCase`, `testing.py:595-606`)."""
 
     def tearDown(self) -> None:
-        from ..state import AcceleratorState
+        from ..state import AcceleratorState, GradientState, ProcessState
 
         AcceleratorState._reset_state()
+        GradientState._reset_state()
+        ProcessState._reset_state()
         super().tearDown()
 
 

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 _QUANT_KEY = "__quant__"
+_QUANT4_KEY = "__quant4__"
 
 # Leaves that stay full precision: cheap, sensitive, integer-indexed, or
 # consumed outside the per-block dequant (embedding lookup / head matmul).
@@ -40,27 +41,50 @@ DEFAULT_SKIP_PATTERNS = (
 
 
 def is_quantized(x: Any) -> bool:
-    return isinstance(x, dict) and _QUANT_KEY in x
+    return isinstance(x, dict) and (_QUANT_KEY in x or _QUANT4_KEY in x)
 
 
-def quantize_array(w: jax.Array, stack_dims: int | None = None) -> dict[str, jax.Array]:
-    """Symmetric int8, one fp32 scale per output channel (last axis) — kept
-    separately per leading "stack" axis slice so stacked weights never share
-    scales across slices. ``stack_dims`` = number of leading stack axes
+def quantize_array(
+    w: jax.Array, stack_dims: int | None = None, bits: int = 8
+) -> dict[str, jax.Array]:
+    """Symmetric int8/int4, one fp32 scale per output channel (last axis) —
+    kept separately per leading "stack" axis slice so stacked weights never
+    share scales across slices. ``stack_dims`` = number of leading stack axes
     (default: 1 for ndim >= 3, the scan-over-layers layout; pass 2 for
-    layer+expert stacked MoE weights so EXPERTS keep independent scales)."""
+    layer+expert stacked MoE weights so EXPERTS keep independent scales).
+
+    ``bits=4`` (the bnb-4bit analog) packs two values per byte along the
+    output axis — 2x smaller than int8, 8x smaller than fp32. Per-channel
+    symmetric [-7, 7]: coarser than int8, fine for big matmul weights with
+    the sensitive leaves (norms/embeddings/head) excluded by the skip list.
+    Falls back to int8 when the output axis is odd (can't pack pairs).
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     w32 = jnp.asarray(w, jnp.float32)
     if stack_dims is None:
         stack_dims = 1 if w32.ndim >= 3 else 0
     stack_dims = min(stack_dims, max(w32.ndim - 2, 0))
     reduce_axes = tuple(range(stack_dims, w32.ndim - 1))
     absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    if bits == 4 and w32.shape[-1] % 2 == 0:
+        scale = jnp.maximum(absmax, 1e-12) / 7.0
+        q = jnp.clip(jnp.round(w32 / scale), -7, 7).astype(jnp.int8) + 8
+        q = q.astype(jnp.uint8)
+        packed = (q[..., 0::2] << 4) | q[..., 1::2]
+        return {_QUANT4_KEY: packed, "scale": scale.astype(jnp.float32)}
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {_QUANT_KEY: q, "scale": scale.astype(jnp.float32)}
 
 
 def dequantize_array(d: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.Array:
+    if _QUANT4_KEY in d:
+        packed = d[_QUANT4_KEY]
+        hi = (packed >> 4).astype(jnp.int8) - 8
+        lo = (packed & 0xF).astype(jnp.int8) - 8
+        q = jnp.stack([hi, lo], axis=-1).reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))
+        return (q.astype(jnp.float32) * d["scale"]).astype(dtype)
     return (d[_QUANT_KEY].astype(jnp.float32) * d["scale"]).astype(dtype)
 
 
@@ -79,13 +103,15 @@ def quantize_pytree(
     skip_patterns: tuple[str, ...] = DEFAULT_SKIP_PATTERNS,
     min_size: int = 4096,
     stack_dim_patterns: tuple[tuple[str, int], ...] = DEFAULT_STACK_DIM_PATTERNS,
+    bits: int = 8,
 ) -> Any:
     """Quantize eligible float leaves (big matmul weights); embeddings and
     anything matching ``skip_patterns`` stay full precision.
 
     ``stack_dim_patterns`` maps path regexes to the number of leading stack
     axes whose slices must keep independent scales — extend it when a model
-    stacks weights along extra axes under different names.
+    stacks weights along extra axes under different names. ``bits=4`` packs
+    two weights per byte (see `quantize_array`).
     """
 
     from ..parallel.sharding import _path_str  # lazy: avoids an import cycle
@@ -103,7 +129,7 @@ def quantize_pytree(
             if re.search(pat, path_s) and leaf.ndim >= dims + 2:
                 stack = dims
                 break
-        return quantize_array(leaf, stack_dims=stack)
+        return quantize_array(leaf, stack_dims=stack, bits=bits)
 
     return jax.tree_util.tree_map_with_path(visit, tree)
 

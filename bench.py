@@ -108,6 +108,10 @@ def main() -> None:
             bert_stats.update(_bench_long_context())
         except Exception as e:
             bert_stats["longctx_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            bert_stats.update(_bench_generate(config))
+        except Exception as e:
+            bert_stats["generate_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(
         json.dumps(
@@ -180,6 +184,54 @@ def _bench_long_context() -> dict:
         "longctx_seq": S,
         "longctx_step_ms": round(dt * 1000, 1),
         "longctx_tflops": round(flops / dt / 1e12, 1),
+    }
+
+
+def _bench_generate(config) -> dict:
+    """KV-cache decode throughput on the headline model (the
+    big-model-inference `generate()` config BASELINE.md tracks): bf16
+    params, batch 8, prefill 128, steady-state decode tokens/sec.
+
+    Timed as the DIFFERENCE between a long and a short generation, which
+    cancels the prefill forward and the device->host fetch round trip from
+    the measurement (the same concern `_timed_steps` handles; only the extra
+    decode steps remain)."""
+    import dataclasses
+
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import llama
+
+    gen_config = dataclasses.replace(
+        config,
+        remat=False,
+        attention_impl="dot",  # decode T=1 steps; flash needs block-sized S
+    )
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        llama.init(jax.random.PRNGKey(3), gen_config),
+    )
+    B, prompt_len = 8, 128
+    short, long = 16, 80
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (B, prompt_len), 0, gen_config.vocab_size, jnp.int32
+    )
+    gcfg_short = GenerationConfig(max_new_tokens=short)
+    gcfg_long = GenerationConfig(max_new_tokens=long)
+
+    def run(gcfg) -> float:
+        t0 = time.perf_counter()
+        out = llama.generate(params, prompt, gen_config, generation_config=gcfg)
+        int(out[0, -1])  # fetch barrier (block_until_ready is a no-op via axon)
+        return time.perf_counter() - t0
+
+    run(gcfg_short), run(gcfg_long)  # compile both loop lengths
+    dt_short = min(run(gcfg_short) for _ in range(2))
+    dt_long = min(run(gcfg_long) for _ in range(2))
+    decode_dt = max(dt_long - dt_short, 1e-9)
+    n_tokens = long - short
+    return {
+        "decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
+        "decode_ms_per_token": round(1000 * decode_dt / n_tokens, 3),
     }
 
 

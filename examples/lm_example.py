@@ -89,13 +89,17 @@ def main(argv: list[str] | None = None) -> int:
     state = accelerator.create_train_state(lambda r: gpt.init(r, config), tx)
     step = accelerator.make_train_step(lambda p, b, r: gpt.loss_fn(p, b, config, r))
 
+    start_epoch = 0
     if args.resume:
         if not args.ckpt_dir:
             raise SystemExit("--resume needs --ckpt_dir")
         state = accelerator.load_state(args.ckpt_dir, state)
-        accelerator.print(f"resumed at step {int(state.step)}")
+        # Continue from the restored position: re-running epoch 0 would
+        # replay the original run's shuffle order instead of advancing.
+        start_epoch = loader.state_dict()["epoch"]
+        accelerator.print(f"resumed at step {int(state.step)}, epoch {start_epoch}")
 
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, start_epoch + args.epochs):
         loader.set_epoch(epoch)
         for batch in loader:
             state, metrics = step(state, batch)
