@@ -1,0 +1,55 @@
+"""Feature example: cooperative early stopping across processes.
+
+Reference analog: `examples/by_feature/early_stopping.py` —
+`accelerator.set_trigger()` on the process that sees the stop condition,
+`accelerator.check_trigger()` (an all-reduce of the flag) on every process so
+the whole job breaks out of the loop on the same step.
+
+Run: python examples/by_feature/early_stopping.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--loss_threshold", type=float, default=0.05)
+    parser.add_argument("--max_steps", type=int, default=200)
+    args = parser.parse_args(argv)
+
+    acc = atx.Accelerator(seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.05))
+    step = acc.make_train_step(regression_loss)
+    ds = RegressionDataset(length=64)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+
+    stopped_at = args.max_steps
+    for i in range(args.max_steps):
+        state, metrics = step(state, batch)
+        # Any process may raise the flag...
+        if float(metrics["loss"]) < args.loss_threshold:
+            acc.set_trigger()
+        # ...every process sees it on the same step (flag is all-reduced).
+        if acc.check_trigger():
+            stopped_at = i + 1
+            acc.print(f"early stop at step {stopped_at} (loss {float(metrics['loss']):.4f})")
+            break
+    if stopped_at >= args.max_steps:
+        raise SystemExit("early stopping never triggered")
+    return stopped_at
+
+
+if __name__ == "__main__":
+    main()

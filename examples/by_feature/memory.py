@@ -1,0 +1,71 @@
+"""Feature example: automatic batch-size reduction on OOM.
+
+Reference analog: `examples/by_feature/memory.py` —
+`find_executable_batch_size` wraps the whole train setup; when XLA reports
+RESOURCE_EXHAUSTED (at compile or execution), compiled caches are dropped and
+the function retries at half the batch size.
+
+On a real chip an over-HBM starting batch triggers the retry genuinely; this
+example defaults to sizes that fit anywhere and offers ``--hbm_cap_gb`` to
+demonstrate the halving loop deterministically (the cap raises the same
+RESOURCE_EXHAUSTED error an over-HBM compile would).
+
+Run: python examples/by_feature/memory.py --hbm_cap_gb 0.001
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.test_utils import regression_init, regression_loss
+from accelerate_tpu.utils import find_executable_batch_size
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--starting_batch_size", type=int, default=4096)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument(
+        "--hbm_cap_gb", type=float, default=None,
+        help="Demo cap: batches whose fp32 bytes exceed this raise the same "
+        "RESOURCE_EXHAUSTED error an over-HBM program would",
+    )
+    args = parser.parse_args(argv)
+
+    acc = atx.Accelerator(seed=0)
+    attempts: list[int] = []
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def run_training(batch_size: int) -> float:
+        attempts.append(batch_size)
+        if args.hbm_cap_gb is not None and batch_size * 2 * 4 > args.hbm_cap_gb * 2**30:
+            raise RuntimeError(
+                f"RESOURCE_EXHAUSTED: demo cap: batch {batch_size} exceeds "
+                f"{args.hbm_cap_gb} GB"
+            )
+        state = acc.create_train_state(regression_init, optax.sgd(0.05))
+        step = acc.make_train_step(regression_loss)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=batch_size).astype(np.float32)
+        batch = {"x": jnp.asarray(x), "y": jnp.asarray(2 * x + 1)}
+        for _ in range(args.steps):
+            state, metrics = step(state, batch)
+        return float(metrics["loss"])
+
+    loss = run_training()
+    acc.print(f"attempted batch sizes: {attempts}")
+    acc.print(f"final loss {loss:.4f} at batch size {attempts[-1]}")
+    return attempts[-1]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Feature example: exact metrics over a dataset that doesn't divide evenly.
+
+Reference analog: `examples/by_feature/multi_process_metrics.py` — the last
+batch wraps around (`even_batches`) so every device stays busy, and
+`gather_for_metrics` drops the duplicated samples before computing metrics,
+giving EXACTLY one prediction per dataset row.
+
+Run: python examples/by_feature/multi_process_metrics.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--eval_size", type=int, default=77)  # deliberately ragged
+    parser.add_argument("--batch_size", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    acc = atx.Accelerator(seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.05))
+    train_step = acc.make_train_step(regression_loss)
+    eval_step = acc.make_eval_step(lambda p, b: p["a"] * b["x"] + p["b"])
+
+    ds = RegressionDataset(length=64)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+    for _ in range(40):
+        state, _ = train_step(state, batch)
+
+    eval_ds = RegressionDataset(length=args.eval_size, seed=7)
+    loader = acc.prepare_data_loader(eval_ds, batch_size=args.batch_size)
+    preds = []
+    for eval_batch in loader:
+        out = eval_step(state, eval_batch)
+        # Drops the wraparound duplicates on the final batch:
+        preds.append(np.asarray(acc.gather_for_metrics(out)))
+    n_preds = int(np.concatenate(preds).shape[0])
+    acc.print(
+        f"dataset rows: {args.eval_size}, gathered predictions: {n_preds} "
+        f"(batches of {loader.total_batch_size}, remainder {loader.remainder})"
+    )
+    if n_preds != args.eval_size:
+        raise SystemExit(
+            f"expected exactly {args.eval_size} predictions, got {n_preds}"
+        )
+    return n_preds
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Feature example: profiling a training loop.
+
+Reference analog: `examples/by_feature/profiler.py` — wrap the hot loop in
+`accelerator.profile(...)`; the TPU build captures a `jax.profiler` XPlane
+trace (TensorBoard / Perfetto viewable) instead of a torch Chrome trace.
+
+Run: python examples/by_feature/profiler.py --trace_dir /tmp/atx_trace
+     tensorboard --logdir /tmp/atx_trace   # "Profile" tab
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+from accelerate_tpu.utils import ProfileKwargs
+from accelerate_tpu.utils.profiler import step_annotation
+
+
+def main(argv: list[str] | None = None) -> str:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--trace_dir", default="profile_trace")
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    acc = atx.Accelerator(seed=0)
+    state = acc.create_train_state(regression_init, optax.sgd(0.05))
+    step = acc.make_train_step(regression_loss)
+    ds = RegressionDataset(length=64)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+
+    # Warm up OUTSIDE the trace so compilation doesn't dominate it.
+    state, _ = step(state, batch)
+
+    with acc.profile(ProfileKwargs(output_trace_dir=args.trace_dir)):
+        for i in range(args.steps):
+            with step_annotation(i):
+                state, metrics = step(state, batch)
+        float(metrics["loss"])  # drain before the trace closes
+
+    trace_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(args.trace_dir)
+        for f in files
+    ]
+    acc.print(f"trace wrote {len(trace_files)} file(s) under {args.trace_dir}")
+    return args.trace_dir
+
+
+if __name__ == "__main__":
+    main()

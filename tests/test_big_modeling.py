@@ -277,8 +277,7 @@ class TestShardedGenerate:
             strategy="HYBRID",
             sharding_rules=get_tp_plan("llama"),
         )
-        spec = ShardingStrategy.resolve("HYBRID", rules=get_tp_plan("llama"))
-        param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, spec)
+        param_specs = infer_param_specs(jax.eval_shape(lambda: params), acc.mesh, acc.strategy)
         sharded = shard_pytree(params, param_specs, acc.mesh)
         got = np.asarray(llama.generate(sharded, prompt, config, generation_config=gen_cfg))
         np.testing.assert_array_equal(got, want)

@@ -13,7 +13,11 @@ EXAMPLES = os.path.join(REPO_ROOT, "examples")
 
 
 def _load(name: str):
-    spec = importlib.util.spec_from_file_location(name, os.path.join(EXAMPLES, f"{name}.py"))
+    """Load an example module by repo-relative name, e.g. "nlp_example" or
+    "by_feature/memory"."""
+    spec = importlib.util.spec_from_file_location(
+        name.replace("/", "."), os.path.join(EXAMPLES, f"{name}.py")
+    )
     module = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(module)
     return module
@@ -102,3 +106,25 @@ def test_lm_example_learns_and_resumes(tmp_path):
         ]
     )
     assert n_correct2 >= 6, n_correct2
+
+
+@pytest.mark.parametrize(
+    "name,args,check",
+    [
+        ("gradient_accumulation", [], lambda r: r < 1e-3),
+        ("early_stopping", [], lambda r: r < 200),
+        ("memory", ["--hbm_cap_gb", "0.00002", "--steps", "5"], lambda r: r < 4096),
+        ("local_sgd", [], lambda r: r < 0.1),
+        ("multi_process_metrics", [], lambda r: r == 77),
+    ],
+)
+def test_by_feature_examples(name, args, check):
+    result = _load(f"by_feature/{name}").main(args)
+    assert check(result), result
+
+
+def test_by_feature_profiler(tmp_path):
+    module = _load("by_feature/profiler")
+    trace_dir = module.main(["--trace_dir", str(tmp_path / "trace"), "--steps", "3"])
+    files = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert files, "profiler example wrote no trace files"
