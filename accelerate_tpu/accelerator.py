@@ -755,12 +755,25 @@ class Accelerator:
 
     # ---------------------------------------------------------------- misc
     def autocast(self):
-        """Context manager kept for API parity (reference `autocast`,
-        `accelerator.py:3587`); dtype policy is applied inside compiled steps,
-        so this is advisory."""
+        """Apply the dtype policy to ad-hoc computations OUTSIDE the compiled
+        train/eval steps (reference `autocast`, `accelerator.py:3587`).
+
+        JAX has no global op interception, so the context (a) activates the
+        fp8 matmul mode when the policy is fp8 — any `matmul_einsum` traced
+        inside lowers to scaled-fp8 contractions, exactly as in the compiled
+        steps — and (b) yields the policy's cast function for the operands::
+
+            with accelerator.autocast() as cast:
+                out = model_fn(cast(params), batch)
+        """
         import contextlib
 
-        return contextlib.nullcontext()
+        @contextlib.contextmanager
+        def ctx():
+            with _fp8.fp8_matmuls(self.policy.fp8):
+                yield self.policy.cast_for_compute
+
+        return ctx()
 
     def __repr__(self) -> str:
         return (

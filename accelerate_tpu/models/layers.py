@@ -231,6 +231,59 @@ def mlp_gelu(params: Params, x: jax.Array) -> jax.Array:
 
 
 # ----------------------------------------------------------------------- loss
+def chunked_lm_loss(
+    x: jax.Array,
+    head: jax.Array,
+    labels: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    z_loss: float = 0.0,
+    chunk_size: int = 512,
+) -> jax.Array:
+    """Next-token cross entropy WITHOUT materializing the full (B, S, V)
+    logits: the sequence is scanned in chunks, each chunk's
+    projection+softmax is `jax.checkpoint`ed so the backward recomputes it
+    chunk-by-chunk. At (8, 2048, 32k) the fp32 logit tail is ~2 GB of
+    residuals; chunking caps it at chunk_size/S of that. Numerically
+    identical (fp32 reductions, same masking/z-loss) to
+    ``cross_entropy_loss(einsum(x, head), labels, ...)``.
+
+    x: (B, S, D) trunk output aligned with labels (B, S); S must be a
+    multiple of ``chunk_size`` (pick a divisor — S is static under jit).
+    """
+    B, S, D = x.shape
+    if S % chunk_size != 0:
+        raise ValueError(f"chunk_size {chunk_size} must divide sequence length {S}")
+    n_chunks = S // chunk_size
+    xc = x.reshape(B, n_chunks, chunk_size, D).swapaxes(0, 1)
+    lc = labels.reshape(B, n_chunks, chunk_size).swapaxes(0, 1)
+    if mask is None:
+        mc = jnp.ones((n_chunks, B, chunk_size), jnp.float32)
+    else:
+        mc = mask.reshape(B, n_chunks, chunk_size).swapaxes(0, 1).astype(jnp.float32)
+
+    @jax.checkpoint
+    def chunk_sums(x_chunk, label_chunk, mask_chunk):
+        logits = jnp.einsum("bsd,dv->bsv", x_chunk, head.astype(x_chunk.dtype))
+        logits = logits.astype(jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, label_chunk[..., None], axis=-1)[..., 0]
+        losses = logz - label_logits
+        if z_loss > 0.0:
+            losses = losses + z_loss * jnp.square(logz)
+        return jnp.sum(losses * mask_chunk), jnp.sum(mask_chunk)
+
+    def scan_body(carry, inputs):
+        loss_sum, count = carry
+        s, c = chunk_sums(*inputs)
+        return (loss_sum + s, count + c), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        scan_body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (xc, lc, mc)
+    )
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
 def cross_entropy_loss(
     logits: jax.Array,
     labels: jax.Array,

@@ -72,6 +72,11 @@ class LlamaConfig:
     remat_policy: str = "block_outputs"
     attention_impl: str = "dot"  # "dot" | "flash" | "ring"
     z_loss: float = 0.0
+    # Compute the LM loss in sequence chunks of this size (must divide S)
+    # without materializing the full (B, S, V) logits — the fp32 logit tail
+    # is the single biggest activation at long S / large vocab
+    # (layers.chunked_lm_loss). None = unchunked.
+    loss_chunk_size: int | None = None
     # Mixture-of-Experts: n_experts > 0 replaces every block's FFN with a
     # top-k routed expert layer (ops/moe.py); expert weights shard over the
     # `expert` mesh axis via the "llama" plan.
@@ -246,11 +251,14 @@ def forward(
     positions: jax.Array | None = None,
     mask: jax.Array | None = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab).
 
     With ``return_aux`` (MoE training) returns ``(logits, aux)`` where aux
-    holds the per-layer-averaged router losses."""
+    holds the per-layer-averaged router losses. ``return_hidden`` skips the
+    logits head and returns the final-norm hidden states instead (the
+    chunked-loss path projects them chunk-by-chunk)."""
     B, S = tokens.shape
     if S > config.max_seq_len:
         # RoPE table gathers clamp out-of-range positions under jit, which
@@ -275,15 +283,16 @@ def forward(
 
     x, aux_stacked = jax.lax.scan(scan_body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
-    if not return_aux:
-        return logits
     aux = (
         jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stacked)
         if aux_stacked is not None
         else {}
     )
+    if return_hidden:
+        return (x, aux) if return_aux else x
+    logits = jnp.einsum("bsd,dv->bsv", x, _lm_head(params, config).astype(x.dtype))
+    if not return_aux:
+        return logits
     return logits, aux
 
 
@@ -349,8 +358,7 @@ def forward_with_cache(
         scan_body, x, (params["blocks"], cache["k"], cache["v"])
     )
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, _lm_head(params, config).astype(x.dtype))
     new_cache = {"k": new_k, "v": new_v, "length": start + T_new}
     return logits, new_cache
 
@@ -365,6 +373,54 @@ def _generator(config: LlamaConfig, generation_config: Any, jit_loop: bool):
         generation_config,
         jit_loop=jit_loop,
     )
+
+
+def _lm_head(params: Params, config: LlamaConfig) -> jax.Array:
+    return params["embed"].T if config.tie_embeddings else params["lm_head"]
+
+
+def _add_moe_aux(loss: jax.Array, aux: dict, config: LlamaConfig) -> jax.Array:
+    return (
+        loss
+        + config.moe_aux_weight * aux["moe_load_balance"]
+        + config.moe_z_weight * aux["moe_z_loss"]
+    )
+
+
+def _chunked_loss_fn(
+    params: Params, batch: dict[str, jax.Array], config: LlamaConfig
+) -> jax.Array:
+    """`loss_fn` with `layers.chunked_lm_loss`: the trunk runs at full S and
+    only the logits projection + softmax are chunked. The shifted-labels
+    default keeps S intact by masking out the final position instead of
+    slicing (chunking needs chunk_size | S)."""
+    from .layers import chunked_lm_loss
+
+    tokens = batch["input_ids"]
+    labels = batch.get("labels")
+    attn_mask = batch.get("attention_mask")
+    moe = config.n_experts > 0
+    out = forward(
+        params, tokens, config, mask=attn_mask, return_aux=moe, return_hidden=True
+    )
+    x, aux = out if moe else (out, {})
+    B, S = tokens.shape
+    if labels is None:
+        # predict token i+1 at position i; last position contributes nothing
+        labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        loss_mask = jnp.ones((B, S), jnp.float32).at[:, -1].set(0.0)
+        if attn_mask is not None:
+            shifted = jnp.concatenate(
+                [attn_mask[:, 1:], jnp.zeros((B, 1), attn_mask.dtype)], axis=1
+            )
+            loss_mask = loss_mask * shifted.astype(jnp.float32)
+    else:
+        loss_mask = attn_mask
+    loss = chunked_lm_loss(
+        x, _lm_head(params, config), labels,
+        mask=loss_mask, z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
+    )
+    return _add_moe_aux(loss, aux, config) if moe else loss
 
 
 def generate(
@@ -446,6 +502,8 @@ def loss_fn(
 ) -> jax.Array:
     """Next-token prediction loss. batch: {"input_ids": (B, S)} with optional
     "labels" (shifted) and "attention_mask"."""
+    if config.loss_chunk_size:
+        return _chunked_loss_fn(params, batch, config)
     tokens = batch["input_ids"]
     labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
@@ -463,10 +521,4 @@ def loss_fn(
     else:
         loss_mask = attn_mask
     loss = cross_entropy_loss(logits, labels, mask=loss_mask, z_loss=config.z_loss)
-    if moe:
-        loss = (
-            loss
-            + config.moe_aux_weight * aux["moe_load_balance"]
-            + config.moe_z_weight * aux["moe_z_loss"]
-        )
-    return loss
+    return _add_moe_aux(loss, aux, config) if moe else loss

@@ -35,7 +35,7 @@ def main(argv: list[str] | None = None) -> int:
     ds = RegressionDataset(length=64)
     batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
 
-    stopped_at = args.max_steps
+    stopped_at = None
     for i in range(args.max_steps):
         state, metrics = step(state, batch)
         # Any process may raise the flag...
@@ -46,7 +46,7 @@ def main(argv: list[str] | None = None) -> int:
             stopped_at = i + 1
             acc.print(f"early stop at step {stopped_at} (loss {float(metrics['loss']):.4f})")
             break
-    if stopped_at >= args.max_steps:
+    if stopped_at is None:
         raise SystemExit("early stopping never triggered")
     return stopped_at
 

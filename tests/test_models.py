@@ -464,3 +464,43 @@ def test_seq_len_overflow_raises():
     lparams = llama.init(jax.random.PRNGKey(0), lcfg)
     with pytest.raises(ValueError, match="max_seq_len"):
         llama.forward(lparams, jnp.zeros((1, 32), jnp.int32), lcfg)
+
+
+class TestChunkedLoss:
+    def test_matches_unchunked_value_and_grads(self):
+        config = llama.LlamaConfig.tiny()
+        config_c = llama.LlamaConfig.tiny(loss_chunk_size=8)
+        params = llama.init(jax.random.PRNGKey(0), config)
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(1), (2, 32), 0, config.vocab_size, jnp.int32
+            )
+        }
+        l1, g1 = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, config))(params)
+        l2, g2 = jax.value_and_grad(lambda p: llama.loss_fn(p, batch, config_c))(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5), g1, g2
+        )
+
+    def test_with_attention_mask(self):
+        config = llama.LlamaConfig.tiny()
+        config_c = llama.LlamaConfig.tiny(loss_chunk_size=16)
+        params = llama.init(jax.random.PRNGKey(0), config)
+        mask = jnp.ones((2, 32), jnp.int32).at[:, 20:].set(0)
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(2), (2, 32), 0, config.vocab_size, jnp.int32
+            ),
+            "attention_mask": mask,
+        }
+        l1 = llama.loss_fn(params, batch, config)
+        l2 = llama.loss_fn(params, batch, config_c)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+    def test_indivisible_chunk_rejected(self):
+        config = llama.LlamaConfig.tiny(loss_chunk_size=7)
+        params = llama.init(jax.random.PRNGKey(0), config)
+        batch = {"input_ids": jnp.zeros((1, 32), jnp.int32)}
+        with pytest.raises(ValueError, match="chunk_size"):
+            llama.loss_fn(params, batch, config)

@@ -256,3 +256,24 @@ def test_loss_scale_survives_checkpoint(tmp_path):
     restored = acc2.load_state(str(tmp_path / "ckpt"), fresh)
     assert float(restored.loss_scale.scale) == float(state.loss_scale.scale)
     assert int(restored.loss_scale.growth_counter) == int(state.loss_scale.growth_counter)
+
+
+def test_autocast_applies_policy():
+    """autocast yields the cast fn and (under fp8) activates the matmul mode
+    for ad-hoc computations outside compiled steps."""
+    from accelerate_tpu.ops import fp8 as fp8_mod
+
+    acc = Accelerator(mixed_precision="bf16", seed=0)
+    with acc.autocast() as cast:
+        assert not fp8_mod.fp8_enabled()
+        x = cast({"w": jnp.ones((4, 4), jnp.float32)})
+        assert x["w"].dtype == jnp.bfloat16
+
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    acc8 = Accelerator(mixed_precision="fp8", seed=0)
+    assert not fp8_mod.fp8_enabled()
+    with acc8.autocast() as cast:
+        assert fp8_mod.fp8_enabled()
+    assert not fp8_mod.fp8_enabled()
