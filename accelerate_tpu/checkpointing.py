@@ -295,8 +295,19 @@ def load_pytree(target: Any, directory: str) -> Any:
 
 
 def consolidate_checkpoint(directory: str, output_path: str) -> str:
-    """Merge a sharded pytree dir into one host `.npz` with full arrays —
-    the `accelerate merge-weights` analog (reference `utils/fsdp_utils.py:275`)."""
+    """Merge a sharded pytree dir into one host file with full arrays —
+    the `accelerate merge-weights` analog (reference `utils/fsdp_utils.py:275`).
+
+    The output format follows the extension: ``.safetensors`` writes an
+    HF-interchange file (loadable by `transformers`/`safetensors` consumers
+    AND by `big_modeling.load_checkpoint_and_dispatch`); anything else
+    writes `.npz`. Leaf keys are the pytree paths ("/"-joined), matching
+    what the safetensors *reader* here expects back.
+    """
+    if output_path.endswith(".safetensors"):
+        # Import before the (potentially multi-GB) shard read so a missing
+        # dependency fails fast, not after minutes of IO.
+        from safetensors.numpy import save_file
     reader = _ShardReader(directory)
     merged: dict[str, np.ndarray] = {}
     try:
@@ -306,9 +317,13 @@ def consolidate_checkpoint(directory: str, output_path: str) -> str:
             merged[key] = reader.read_full(key)
     finally:
         reader.close()
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    if output_path.endswith(".safetensors"):
+        # safetensors requires contiguous buffers.
+        save_file({k: np.ascontiguousarray(v) for k, v in merged.items()}, output_path)
+        return output_path
     if not output_path.endswith(".npz"):
         output_path = output_path + ".npz"
-    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
     np.savez(output_path, **merged)
     return output_path
 

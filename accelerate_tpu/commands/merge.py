@@ -12,10 +12,14 @@ import argparse
 
 def register(subparsers: argparse._SubParsersAction) -> None:
     p = subparsers.add_parser(
-        "merge", help="Merge a sharded checkpoint dir into a single .npz"
+        "merge", help="Merge a sharded checkpoint dir into a single .npz or .safetensors file"
     )
     p.add_argument("checkpoint_dir", help="Directory containing shards_*.npz + index_*.json")
-    p.add_argument("output_path", help="Output .npz path")
+    p.add_argument(
+        "output_path",
+        help="Output path: .safetensors writes an HF-interchange file, "
+        "anything else writes .npz",
+    )
     p.set_defaults(func=run)
 
 

@@ -251,3 +251,38 @@ class TestConsolidate:
         state = _tiny_state(acc, config)
         out = checkpointing.save_model(acc, state.params, str(tmp_path / "m"))
         assert out.endswith(".npz") and os.path.exists(out)
+
+
+def test_consolidate_to_safetensors_round_trips(tmp_path):
+    """merge to .safetensors: readable by the safetensors ecosystem AND by
+    load_checkpoint_and_dispatch (HF-interchange export)."""
+    import numpy as np
+    from safetensors import safe_open
+
+    from accelerate_tpu.checkpointing import consolidate_checkpoint, save_pytree
+    from accelerate_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.PRNGKey(0), config)
+    src = str(tmp_path / "sharded")
+    save_pytree(params, src)
+    out = consolidate_checkpoint(src, str(tmp_path / "model.safetensors"))
+    assert out.endswith(".safetensors")
+
+    with safe_open(out, framework="np") as f:
+        keys = list(f.keys())
+        assert "embed" in keys
+        np.testing.assert_array_equal(f.get_tensor("embed"), np.asarray(params["embed"]))
+
+    # streamed load back into sharded buffers from the safetensors file
+    from accelerate_tpu.big_modeling import infer_sharding_plan, load_checkpoint_and_dispatch
+    from accelerate_tpu.state import AcceleratorState
+
+    mesh = AcceleratorState().mesh
+    shapes = jax.eval_shape(lambda: params)
+    plan = infer_sharding_plan(shapes, mesh)
+    restored = load_checkpoint_and_dispatch(shapes, out, plan)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, params,
+    )
