@@ -61,6 +61,9 @@ class GPTConfig:
     remat_policy: str = "block_outputs"
     attention_impl: str = "dot"  # "dot" | "flash"
     z_loss: float = 0.0
+    # Chunked LM loss (layers.chunked_lm_loss): compute the loss in sequence
+    # chunks without materializing the (B, S, V) fp32 logits. None = off.
+    loss_chunk_size: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -160,8 +163,12 @@ def block_forward(
     return x
 
 
+def _lm_head(params: Params, config: GPTConfig) -> jax.Array:
+    return params["wte"].T if config.tie_embeddings else params["lm_head"]
+
+
 def _logits(params: Params, x: jax.Array, config: GPTConfig) -> jax.Array:
-    head = params["wte"].T if config.tie_embeddings else params["lm_head"]
+    head = _lm_head(params, config)
     return jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
 
 
@@ -172,8 +179,10 @@ def forward(
     *,
     positions: jax.Array | None = None,
     mask: jax.Array | None = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    """tokens (B, S) int32 -> logits (B, S, vocab). ``return_hidden`` skips
+    the logits head (the chunked-loss path projects chunk-by-chunk)."""
     B, S = tokens.shape
     if S > config.max_seq_len:
         # XLA gathers clamp out-of-range rows, which would silently hand
@@ -192,6 +201,8 @@ def forward(
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], config.norm_eps)
+    if return_hidden:
+        return x
     return _logits(params, x, config)
 
 
@@ -290,6 +301,18 @@ def loss_fn(
     tokens = batch["input_ids"]
     labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
+    if config.loss_chunk_size:
+        from .layers import chunked_lm_loss, shifted_labels_and_mask
+
+        x = forward(params, tokens, config, mask=attn_mask, return_hidden=True)
+        if labels is None:
+            labels, loss_mask = shifted_labels_and_mask(tokens, attn_mask)
+        else:
+            loss_mask = attn_mask
+        return chunked_lm_loss(
+            x, _lm_head(params, config), labels,
+            mask=loss_mask, z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
+        )
     logits = forward(params, tokens, config, mask=attn_mask)
     if labels is None:
         labels = tokens[:, 1:]

@@ -284,6 +284,23 @@ def chunked_lm_loss(
     return loss_sum / jnp.maximum(count, 1.0)
 
 
+def shifted_labels_and_mask(
+    tokens: jax.Array, attn_mask: jax.Array | None
+) -> tuple[jax.Array, jax.Array]:
+    """Next-token labels/mask at FULL sequence length for the chunked loss:
+    position i predicts token i+1; the final position is masked out instead
+    of sliced off (chunking needs chunk_size | S)."""
+    B, S = tokens.shape
+    labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    loss_mask = jnp.ones((B, S), jnp.float32).at[:, -1].set(0.0)
+    if attn_mask is not None:
+        shifted = jnp.concatenate(
+            [attn_mask[:, 1:], jnp.zeros((B, 1), attn_mask.dtype)], axis=1
+        )
+        loss_mask = loss_mask * shifted.astype(jnp.float32)
+    return labels, loss_mask
+
+
 def cross_entropy_loss(
     logits: jax.Array,
     labels: jax.Array,

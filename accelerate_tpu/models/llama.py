@@ -404,16 +404,10 @@ def _chunked_loss_fn(
         params, tokens, config, mask=attn_mask, return_aux=moe, return_hidden=True
     )
     x, aux = out if moe else (out, {})
-    B, S = tokens.shape
     if labels is None:
-        # predict token i+1 at position i; last position contributes nothing
-        labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
-        loss_mask = jnp.ones((B, S), jnp.float32).at[:, -1].set(0.0)
-        if attn_mask is not None:
-            shifted = jnp.concatenate(
-                [attn_mask[:, 1:], jnp.zeros((B, 1), attn_mask.dtype)], axis=1
-            )
-            loss_mask = loss_mask * shifted.astype(jnp.float32)
+        from .layers import shifted_labels_and_mask
+
+        labels, loss_mask = shifted_labels_and_mask(tokens, attn_mask)
     else:
         loss_mask = attn_mask
     loss = chunked_lm_loss(

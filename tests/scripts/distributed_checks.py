@@ -95,7 +95,7 @@ def check_split_between_processes(ps: ProcessState) -> None:
     assert flat == items, flat
 
 
-def check_training_and_checkpoint(ps: ProcessState, ckpt_dir: str) -> None:
+def check_training_and_checkpoint(ps: ProcessState, ckpt_dir: str):
     acc = atx.Accelerator(seed=0)
     assert acc.num_processes == ps.num_processes
     state = acc.create_train_state(regression_init, optax.sgd(0.05))
@@ -125,6 +125,68 @@ def check_training_and_checkpoint(ps: ProcessState, ckpt_dir: str) -> None:
     )
     gathered_metric = acc.gather(jnp.ones((2,)) * ps.process_index)
     assert gathered_metric.shape[0] >= ps.num_processes * 2
+    return acc, state2
+
+
+def check_dispatch_loader(ps: ProcessState) -> None:
+    """dispatch_batches: rank 0 reads the dataset, other ranks receive each
+    batch over the object channel (reference `DataLoaderDispatcher`,
+    `data_loader.py:696`) — every rank must see identical global batches."""
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    class MainOnlyDataset:
+        """Readable only on rank 0 — proves no other rank touches the data."""
+
+        def __len__(self) -> int:
+            return 24
+
+        def __getitem__(self, i: int) -> dict:
+            if ps.process_index != 0:
+                raise AssertionError("dataset read on a non-main process")
+            return {"x": np.float32([i])}
+
+    loader = atx.DataLoader(
+        MainOnlyDataset(),
+        batch_size=2,
+        config=DataLoaderConfiguration(dispatch_batches=True, prefetch_size=0),
+    )
+    seen = []
+    for batch in loader:
+        x = batch["x"]
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # Each rank holds only its shards of the global batch.
+            local = np.concatenate(
+                [np.asarray(s.data).ravel() for s in x.addressable_shards]
+            )
+        else:
+            local = np.asarray(x).ravel()
+        seen.append(local.tolist())
+    assert seen, "dispatch loader yielded nothing"
+    # The union of every rank's shards per step must cover the whole dataset
+    # exactly (dispatch delivered every sample to exactly one device slot,
+    # modulo the even_batches wraparound duplicates).
+    all_seen = ops.gather_object([seen])
+    flat = [v for rank_seen in all_seen for step_vals in rank_seen for v in step_vals]
+    expected = {float(i) for i in range(24)}
+    assert set(flat) == expected, sorted(set(flat) ^ expected)
+    assert len(flat) >= 24
+
+
+def check_gather_for_metrics(
+    ps: ProcessState, acc: "atx.Accelerator", state: "atx.TrainState"
+) -> None:
+    """Ragged eval: the wraparound duplicates on the final global batch must
+    be trimmed to exactly one prediction per dataset sample."""
+    eval_step = acc.make_eval_step(lambda p, b: p["a"] * b["x"] + p["b"])
+    total = 4 * ps.num_processes + 2  # ragged tail
+    loader = acc.prepare_data_loader(
+        RegressionDataset(length=total, seed=3), batch_size=4
+    )
+    preds = []
+    for batch in loader:
+        preds.append(np.asarray(acc.gather_for_metrics(eval_step(state, batch))))
+    n_preds = int(np.concatenate(preds).shape[0])
+    assert n_preds == total, (n_preds, total)
 
 
 def run_mismatch_mode(ps: ProcessState) -> None:
@@ -154,8 +216,10 @@ def main() -> int:
     check_collectives(ps)
     check_object_channel(ps)
     check_split_between_processes(ps)
+    check_dispatch_loader(ps)
     if args.ckpt_dir:
-        check_training_and_checkpoint(ps, args.ckpt_dir)
+        acc, trained_state = check_training_and_checkpoint(ps, args.ckpt_dir)
+        check_gather_for_metrics(ps, acc, trained_state)
     ps.wait_for_everyone()
     print(f"[proc {ps.process_index}] ALL OK", flush=True)
     return 0

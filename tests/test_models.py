@@ -504,3 +504,36 @@ class TestChunkedLoss:
         batch = {"input_ids": jnp.zeros((1, 32), jnp.int32)}
         with pytest.raises(ValueError, match="chunk_size"):
             llama.loss_fn(params, batch, config)
+
+
+def test_gpt_chunked_loss_matches():
+    config = gpt.GPTConfig.tiny()
+    config_c = gpt.GPTConfig.tiny(loss_chunk_size=8)
+    params = gpt.init(jax.random.PRNGKey(0), config)
+    batch = {
+        "input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (2, 32), 0, config.vocab_size, jnp.int32
+        )
+    }
+    l1, g1 = jax.value_and_grad(lambda p: gpt.loss_fn(p, batch, config))(params)
+    l2, g2 = jax.value_and_grad(lambda p: gpt.loss_fn(p, batch, config_c))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5), g1, g2
+    )
+
+
+def test_gpt_chunked_loss_with_mask_matches():
+    config = gpt.GPTConfig.tiny()
+    config_c = gpt.GPTConfig.tiny(loss_chunk_size=16)
+    params = gpt.init(jax.random.PRNGKey(0), config)
+    mask = jnp.ones((2, 32), jnp.int32).at[:, 24:].set(0)
+    batch = {
+        "input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (2, 32), 0, config.vocab_size, jnp.int32
+        ),
+        "attention_mask": mask,
+    }
+    l1 = gpt.loss_fn(params, batch, config)
+    l2 = gpt.loss_fn(params, batch, config_c)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
