@@ -302,16 +302,12 @@ def loss_fn(
     labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
     if config.loss_chunk_size:
-        from .layers import chunked_lm_loss, shifted_labels_and_mask
+        from .layers import chunked_lm_loss_from_batch
 
         x = forward(params, tokens, config, mask=attn_mask, return_hidden=True)
-        if labels is None:
-            labels, loss_mask = shifted_labels_and_mask(tokens, attn_mask)
-        else:
-            loss_mask = attn_mask
-        return chunked_lm_loss(
-            x, _lm_head(params, config), labels,
-            mask=loss_mask, z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
+        return chunked_lm_loss_from_batch(
+            x, _lm_head(params, config), tokens, labels, attn_mask,
+            z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
         )
     logits = forward(params, tokens, config, mask=attn_mask)
     if labels is None:

@@ -301,6 +301,27 @@ def shifted_labels_and_mask(
     return labels, loss_mask
 
 
+def chunked_lm_loss_from_batch(
+    x: jax.Array,
+    head: jax.Array,
+    tokens: jax.Array,
+    labels: jax.Array | None,
+    attn_mask: jax.Array | None,
+    *,
+    z_loss: float,
+    chunk_size: int,
+) -> jax.Array:
+    """The shared chunked-loss entry for decoder families: resolves the
+    shifted-labels default, then runs `chunked_lm_loss`."""
+    if labels is None:
+        labels, loss_mask = shifted_labels_and_mask(tokens, attn_mask)
+    else:
+        loss_mask = attn_mask
+    return chunked_lm_loss(
+        x, head, labels, mask=loss_mask, z_loss=z_loss, chunk_size=chunk_size
+    )
+
+
 def cross_entropy_loss(
     logits: jax.Array,
     labels: jax.Array,

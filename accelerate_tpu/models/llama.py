@@ -394,25 +394,18 @@ def _chunked_loss_fn(
     only the logits projection + softmax are chunked. The shifted-labels
     default keeps S intact by masking out the final position instead of
     slicing (chunking needs chunk_size | S)."""
-    from .layers import chunked_lm_loss
+    from .layers import chunked_lm_loss_from_batch
 
     tokens = batch["input_ids"]
-    labels = batch.get("labels")
     attn_mask = batch.get("attention_mask")
     moe = config.n_experts > 0
     out = forward(
         params, tokens, config, mask=attn_mask, return_aux=moe, return_hidden=True
     )
     x, aux = out if moe else (out, {})
-    if labels is None:
-        from .layers import shifted_labels_and_mask
-
-        labels, loss_mask = shifted_labels_and_mask(tokens, attn_mask)
-    else:
-        loss_mask = attn_mask
-    loss = chunked_lm_loss(
-        x, _lm_head(params, config), labels,
-        mask=loss_mask, z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
+    loss = chunked_lm_loss_from_batch(
+        x, _lm_head(params, config), tokens, batch.get("labels"), attn_mask,
+        z_loss=config.z_loss, chunk_size=config.loss_chunk_size,
     )
     return _add_moe_aux(loss, aux, config) if moe else loss
 

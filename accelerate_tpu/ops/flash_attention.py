@@ -604,7 +604,7 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_mask: jax.Array | None = None,
-    block_size: int = DEFAULT_BLOCK,
+    block_size: int | None = None,
     scale: float | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
@@ -624,6 +624,12 @@ def flash_attention(
 
         return dot_product_attention(q, k, v, mask=segment_mask, causal=causal, scale=scale)
     interpret = _interpret_default() if interpret is None else interpret
+    if block_size is None:
+        # Bigger blocks amortize the online-softmax bookkeeping across more
+        # MXU work: 1024 measured 1.5x over 512 at 32k context on v5e
+        # (75.6 vs 50.6 TF/s fwd+bwd); 2048 exceeds VMEM. Short/medium
+        # sequences keep 512 (measured neutral at S=2048).
+        block_size = 1024 if S >= 4096 else DEFAULT_BLOCK
     block = min(block_size, _round_up(S, 128) if S < block_size else block_size)
     # Pad S up to a block multiple (e.g. the ubiquitous S-1 from next-token
     # shifting). Padded KV columns sit at positions >= S: under causal they
