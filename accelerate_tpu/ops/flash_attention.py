@@ -627,9 +627,19 @@ def flash_attention(
     if block_size is None:
         # Bigger blocks amortize the online-softmax bookkeeping across more
         # MXU work: 1024 measured 1.5x over 512 at 32k context on v5e
-        # (75.6 vs 50.6 TF/s fwd+bwd); 2048 exceeds VMEM. Short/medium
-        # sequences keep 512 (measured neutral at S=2048).
-        block_size = 1024 if S >= 4096 else DEFAULT_BLOCK
+        # (75.6 vs 50.6 TF/s fwd+bwd); 2048 exceeds VMEM. Guards: only on
+        # the blocked-KV path (the resident-KV kernels also stage the whole
+        # sequence per program — 1024-wide f32 score tiles on top is VMEM
+        # we haven't measured), and only when 1024 doesn't pad more than
+        # 512 would (e.g. S=4608 runs exact at 512, +11% dead work at 1024).
+        if (
+            S >= 4096
+            and not _use_resident(S, h, k.dtype)
+            and _round_up(S, 1024) == _round_up(S, 512)
+        ):
+            block_size = 1024
+        else:
+            block_size = DEFAULT_BLOCK
     block = min(block_size, _round_up(S, 128) if S < block_size else block_size)
     # Pad S up to a block multiple (e.g. the ubiquitous S-1 from next-token
     # shifting). Padded KV columns sit at positions >= S: under causal they

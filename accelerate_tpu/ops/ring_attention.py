@@ -269,9 +269,16 @@ def _ring_fused_bwd(axis_name, causal, scale, block, interpret, residuals, g):
 _ring_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)
 
 
-def _fused_block(s_local: int) -> int | None:
+def _fused_block(s_local: int, h: int, dtype) -> int | None:
     """Kernel block size for the fused path; None = chunk too small/ragged,
-    use the einsum path."""
+    use the einsum path. Long blocked-path chunks prefer 1024 — same
+    measurement and same resident-KV guard as `flash_attention`'s adaptive
+    default (1.5x over 512 at 32k on v5e; 2048 exceeds VMEM; resident
+    kernels stage the whole chunk per program, unmeasured with 1024)."""
+    from .flash_attention import _use_resident
+
+    if s_local >= 4096 and s_local % 1024 == 0 and not _use_resident(s_local, h, dtype):
+        return 1024
     for b in (512, 256, 128):
         if s_local % b == 0:
             return b
@@ -322,7 +329,7 @@ def ring_attention(
 
     n_shards = mesh.shape[axis_name]
     s_local = q.shape[1] // n_shards if q.shape[1] % n_shards == 0 else 0
-    block = _fused_block(s_local) if s_local else None
+    block = _fused_block(s_local, q.shape[-1], k.dtype) if s_local else None
     use_fused = impl == "fused" or (impl == "auto" and kv_mask is None and block is not None)
     if use_fused:
         if kv_mask is not None:
