@@ -70,7 +70,7 @@ class LlamaConfig:
     # recompute-FLOPs-avoided per byte (those are the highest-arithmetic-
     # intensity matmuls) at ~64MB/layer for the bench shape.
     remat_policy: str = "block_outputs"
-    attention_impl: str = "dot"  # "dot" | "flash" | "ring"
+    attention_impl: str = "dot"  # "dot" | "flash" | "ring" | "ulysses"
     z_loss: float = 0.0
     # Compute the LM loss in sequence chunks of this size (must divide S)
     # without materializing the full (B, S, V) logits — the fp32 logit tail
@@ -176,18 +176,33 @@ def _attention(config: LlamaConfig, q, k, v, mask):
         from ..ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=True, segment_mask=mask)
-    if config.attention_impl == "ring":
-        from ..ops.ring_attention import ring_attention
-
+    if config.attention_impl in ("ring", "ulysses"):
         if mask is not None and mask.ndim != 2:
             raise NotImplementedError(
-                "attention_impl='ring' supports (B, S) key-padding masks "
-                "only; full (B, S, T) masks need 'flash' or 'dot'."
+                f"attention_impl={config.attention_impl!r} supports (B, S) "
+                "key-padding masks only; full (B, S, T) masks need 'flash' "
+                "or 'dot'."
             )
-        return ring_attention(q, k, v, causal=True, kv_mask=mask)
+        if config.attention_impl == "ring":
+            from ..ops.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, causal=True, kv_mask=mask)
+        if mask is not None:
+            # Masked ulysses falls back to the O(S^2)-per-device oracle over
+            # the gathered sequence — exactly what long context cannot
+            # afford; ring handles masks chunked at O(S^2/n).
+            raise NotImplementedError(
+                "attention_impl='ulysses' with a padding mask would "
+                "materialize full-sequence attention per device; use "
+                "attention_impl='ring' for padded long-context batches."
+            )
+        from ..ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=True)
     if config.attention_impl != "dot":
         raise ValueError(
-            f"Unknown attention_impl {config.attention_impl!r}; expected 'dot', 'flash', or 'ring'"
+            f"Unknown attention_impl {config.attention_impl!r}; expected "
+            "'dot', 'flash', 'ring', or 'ulysses'"
         )
     return dot_product_attention(q, k, v, mask=mask, causal=True)
 

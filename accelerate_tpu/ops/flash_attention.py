@@ -46,7 +46,12 @@ _RESIDENT_KV_BUDGET = 4 * 1024 * 1024
 
 
 def _use_resident(S: int, h: int, dtype) -> bool:
-    return 2 * S * h * jnp.dtype(dtype).itemsize <= _RESIDENT_KV_BUDGET
+    # The blocked-KV path with its adaptive 1024 block measured 1.5-1.6x
+    # FASTER than the resident kernels from S=4096 up on v5e (equal-token
+    # sweeps: 31 vs 50 ms at 4k, 41 vs 61 ms at 8k, fwd+bwd); resident
+    # still wins at S=2048 (27 vs 33 ms). Keep resident below the
+    # crossover, and only while the staged KV fits its VMEM budget.
+    return S < 4096 and 2 * S * h * jnp.dtype(dtype).itemsize <= _RESIDENT_KV_BUDGET
 
 
 def _interpret_default() -> bool:
@@ -626,17 +631,13 @@ def flash_attention(
     interpret = _interpret_default() if interpret is None else interpret
     if block_size is None:
         # Bigger blocks amortize the online-softmax bookkeeping across more
-        # MXU work: 1024 measured 1.5x over 512 at 32k context on v5e
-        # (75.6 vs 50.6 TF/s fwd+bwd); 2048 exceeds VMEM. Guards: only on
-        # the blocked-KV path (the resident-KV kernels also stage the whole
-        # sequence per program — 1024-wide f32 score tiles on top is VMEM
-        # we haven't measured), and only when 1024 doesn't pad more than
-        # 512 would (e.g. S=4608 runs exact at 512, +11% dead work at 1024).
-        if (
-            S >= 4096
-            and not _use_resident(S, h, k.dtype)
-            and _round_up(S, 1024) == _round_up(S, 512)
-        ):
+        # MXU work: 1024 measured 1.5x over 512 from S=4096 up on v5e
+        # (75.6 vs 50.6 TF/s at 32k; 31 vs 46 ms at 4k); 2048 exceeds VMEM.
+        # _use_resident already cuts over to the blocked path at 4096, so
+        # 1024 here never reaches the resident kernels (which cannot
+        # compile it). Guard: only when 1024 pads no more than 512 would
+        # (S=4608 runs exact at 512; 1024 would add 11% dead work).
+        if S >= 4096 and _round_up(S, 1024) == _round_up(S, 512):
             block_size = 1024
         else:
             block_size = DEFAULT_BLOCK

@@ -50,6 +50,25 @@ def shard_map_over(
     return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
 
 
+def sequence_parallel_specs(
+    mesh: Mesh, batch_size: int, batch_axes, axis_name: str
+):
+    """Shared entry scaffolding for the sequence-parallel attention schemes
+    (ring / ulysses): returns ``(qkv_spec, mask_spec)`` with the batch dim
+    sharded over ``batch_axes`` only when it divides (otherwise replicated —
+    e.g. eval with a small batch on a large mesh; sequence sharding still
+    applies)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_group = 1
+    for a in batch_axes:
+        batch_group *= mesh.shape[a]
+    use_batch = (
+        tuple(batch_axes) if batch_group > 1 and batch_size % batch_group == 0 else None
+    )
+    return P(use_batch, axis_name, None, None), P(use_batch, axis_name)
+
+
 def ring_neighbors(axis_name: str, n: int) -> list[tuple[int, int]]:
     """Permutation pairs sending shard i -> i+1 (mod n) along a mesh axis."""
     return [(i, (i + 1) % n) for i in range(n)]

@@ -271,13 +271,11 @@ _ring_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)
 
 def _fused_block(s_local: int, h: int, dtype) -> int | None:
     """Kernel block size for the fused path; None = chunk too small/ragged,
-    use the einsum path. Long blocked-path chunks prefer 1024 — same
-    measurement and same resident-KV guard as `flash_attention`'s adaptive
-    default (1.5x over 512 at 32k on v5e; 2048 exceeds VMEM; resident
-    kernels stage the whole chunk per program, unmeasured with 1024)."""
-    from .flash_attention import _use_resident
-
-    if s_local >= 4096 and s_local % 1024 == 0 and not _use_resident(s_local, h, dtype):
+    use the einsum path. Long chunks prefer 1024 — same measurement as
+    `flash_attention`'s adaptive default (1.5x over 512 from 4k up on v5e;
+    2048 exceeds VMEM; below 4k the resident kernels win and they take 512)."""
+    del h, dtype  # crossover is purely in s_local since the resident cutover
+    if s_local >= 4096 and s_local % 1024 == 0:
         return 1024
     for b in (512, 256, 128):
         if s_local % b == 0:
@@ -319,13 +317,9 @@ def ring_attention(
         from ..state import AcceleratorState
 
         mesh = AcceleratorState().mesh
-    batch_group = 1
-    for a in batch_axes:
-        batch_group *= mesh.shape[a]
-    # Replicate the batch when it can't divide over the batch axes (e.g. eval
-    # with a small batch on a large mesh) — sequence sharding still applies.
-    use_batch = tuple(batch_axes) if batch_group > 1 and q.shape[0] % batch_group == 0 else None
-    spec = P(use_batch, axis_name, None, None)
+    from .in_jit import sequence_parallel_specs
+
+    spec, mask_spec = sequence_parallel_specs(mesh, q.shape[0], batch_axes, axis_name)
 
     n_shards = mesh.shape[axis_name]
     s_local = q.shape[1] // n_shards if q.shape[1] % n_shards == 0 else 0
@@ -359,7 +353,6 @@ def ring_attention(
     fn = functools.partial(
         _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
-    mask_spec = P(use_batch, axis_name)
     if kv_mask is not None:
         kv_mask = kv_mask.astype(bool)
     shard_fn = jax.shard_map(

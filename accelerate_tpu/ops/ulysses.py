@@ -1,0 +1,111 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the two standard long-context schemes (the task the reference
+delegates entirely to Megatron flags — SURVEY.md §2.2): `ring_attention.py`
+keeps Q local and rotates KV around the ring; Ulysses (DeepSpeed-Ulysses)
+instead re-shards *within* attention. Outside attention every tensor is
+sequence-sharded; for the attention op itself an all-to-all converts the
+layout
+
+    (B, S/n, H, h)  --all_to_all-->  (B, S, H/n, h)
+
+so each device runs EXACT full-sequence attention over its slice of heads
+(any local kernel — here the Pallas flash path — with no chunk-granular
+masking), and a second all-to-all converts back. Communication is
+2x all-to-all of the qkv/o tensors per layer vs ring's (n-1) KV rotations:
+cheaper when heads divide the mesh axis and S is very long; ring wins when
+H is small or KV is much smaller than Q (GQA). Both ride the ICI.
+
+Trade-offs vs ring:
+- needs ``num_heads % n == 0`` AND ``num_kv_heads % n == 0`` (heads are the
+  parallel resource during attention);
+- exact attention locally -> no chunk-causality bookkeeping, the flash
+  kernel's own causal masking applies;
+- differentiable end-to-end through `jax.lax.all_to_all` + the flash
+  custom VJP: no hand-written backward.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, SEQUENCE_AXIS
+
+
+def _ulysses_local(q, k, v, mask, *, axis_name, causal, scale):
+    """Per-device body under shard_map. q/k/v: (B, S/n, H, h) local."""
+    from .flash_attention import flash_attention
+
+    # (B, S/n, H, h) -> (B, S, H/n, h): split heads (axis 2), gather seq (1).
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if mask is not None:
+        # (B, S/n) -> (B, S): every device needs the full key mask.
+        mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = flash_attention(qh, kh, vh, causal=causal, segment_mask=mask, scale=scale)
+    # (B, S, H/n, h) -> (B, S/n, H, h)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    kv_mask: jax.Array | None = None,
+    scale: float | None = None,
+    mesh: Mesh | None = None,
+    axis_name: str = SEQUENCE_AXIS,
+    batch_axes: Sequence[str] = BATCH_AXES,
+) -> jax.Array:
+    """Sequence-parallel exact attention over (B, S, H, h) global arrays.
+
+    Same call contract as `ring_attention` (S sharded over ``axis_name``,
+    B over ``batch_axes``; callable inside or outside jit; degrades to
+    plain local attention when the sequence axis is 1). ``kv_mask`` is a
+    (B, S) key-padding mask, sequence-sharded like k/v — but NOTE: the
+    masked path runs the unfused O(S^2) oracle over the gathered sequence
+    (the flash kernel has no per-key masking), so it is only suitable for
+    short/medium S; padded long-context batches should use ring attention,
+    whose chunked einsum path handles masks at O(S^2/n) memory.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    n = mesh.shape[axis_name]
+    B, S, H, h = q.shape
+    K = k.shape[2]
+    if n > 1:
+        if H % n != 0 or K % n != 0:
+            raise ValueError(
+                f"ulysses_attention needs num_heads ({H}) and num_kv_heads "
+                f"({K}) divisible by the '{axis_name}' axis size ({n}); "
+                "use ring attention for head counts that don't divide."
+            )
+        if S % n != 0:
+            raise ValueError(f"sequence length {S} not divisible by {axis_name}={n}")
+
+    import functools
+
+    from .in_jit import sequence_parallel_specs, shard_map_over
+
+    spec, mask_spec = sequence_parallel_specs(mesh, B, batch_axes, axis_name)
+
+    body = functools.partial(
+        _ulysses_local, axis_name=axis_name, causal=causal, scale=scale
+    )
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(bool)
+    in_specs = (spec, spec, spec, mask_spec if kv_mask is not None else None)
+    fn = shard_map_over(body, mesh, in_specs, spec)
+    return fn(q, k, v, kv_mask)

@@ -236,3 +236,81 @@ class TestRingAttention:
             np.testing.assert_allclose(
                 np.asarray(gr), np.asarray(ge), atol=5e-4, rtol=5e-4, err_msg=f"d{name}"
             )
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (ops/ulysses.py): exact full-sequence
+    attention over head slices between two all-to-alls."""
+
+    @pytest.mark.parametrize("seq_shards", [2, 4])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, seq_shards, causal):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=-1, sequence=seq_shards))
+        q, k, v = _qkv(jax.random.PRNGKey(30), B=2, S=64, H=4, K=4, h=16)
+        expected = dot_product_attention(q, k, v, causal=causal)
+        out = ulysses_attention(q, k, v, causal=causal, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_gqa_and_jit(self):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=4, sequence=2))
+        q, k, v = _qkv(jax.random.PRNGKey(31), B=4, S=64, H=4, K=2, h=16)
+        expected = dot_product_attention(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal=True, mesh=mesh))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_oracle(self):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        q, k, v = _qkv(jax.random.PRNGKey(32), B=2, S=128, H=4, K=4, h=16)
+        w = jax.random.normal(jax.random.PRNGKey(33), q.shape)
+
+        def loss_u(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, causal=True, mesh=mesh) * w)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) * w)
+
+        g_u = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_u, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
+
+    def test_padding_mask(self):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=-1, sequence=4))
+        q, k, v = _qkv(jax.random.PRNGKey(34), B=2, S=64, H=4, K=4, h=16)
+        mask = jnp.ones((2, 64), jnp.int32).at[:, 48:].set(0)
+        expected = dot_product_attention(q, k, v, mask=mask, causal=False)
+        out = ulysses_attention(q, k, v, causal=False, kv_mask=mask, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :48]), np.asarray(expected[:, :48]), atol=2e-5, rtol=2e-5
+        )
+
+    def test_indivisible_heads_rejected(self):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=-1, sequence=8))
+        q, k, v = _qkv(jax.random.PRNGKey(35), B=1, S=64, H=4, K=2, h=16)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_llama_ulysses_matches_dot():
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.models import llama
+
+    AcceleratorState._reset_state()
+    mesh = build_mesh(MeshConfig(data=2, sequence=4))
+    config = llama.LlamaConfig.tiny()
+    config_u = llama.LlamaConfig.tiny(attention_impl="ulysses")
+    params = llama.init(jax.random.PRNGKey(0), config)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size, jnp.int32)
+    expected = llama.forward(params, tokens, config)
+    out = llama.forward(params, tokens, config_u)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=3e-4, rtol=3e-4)
