@@ -286,20 +286,45 @@ class Accelerator:
     def prepare_data_loader(
         self,
         dataset: Any,
-        batch_size: int = 1,
+        batch_size: int | None = None,
         *,
-        shuffle: bool = False,
+        shuffle: bool | None = None,
         seed: int | None = None,
-        drop_last: bool = False,
+        drop_last: bool | None = None,
         collate_fn: Callable | None = None,
         spec: PartitionSpec | None = None,
     ) -> DataLoader:
+        """None for batch_size/shuffle/drop_last means "default" (1 / False /
+        False) — or, when ``dataset`` is a torch DataLoader, "inherit from
+        it"; explicit values always win over inherited ones."""
+        from .data.torch_interop import is_torch_dataloader, unwrap_torch_dataloader
+
+        if is_torch_dataloader(dataset):
+            # Reference-style migration path: hand in the torch DataLoader,
+            # get the framework loader over the same dataset back (the torch
+            # sampler is replaced by the sharded seeded one, exactly as the
+            # reference substitutes its BatchSamplerShard). A collate_fn
+            # passed HERE receives raw torch samples; its output is
+            # converted tensor->numpy.
+            torch_cfg = unwrap_torch_dataloader(
+                dataset, has_user_collate=collate_fn is not None
+            )
+            dataset = torch_cfg["dataset"]
+            batch_size = batch_size if batch_size is not None else torch_cfg["batch_size"]
+            shuffle = shuffle if shuffle is not None else torch_cfg["shuffle"]
+            drop_last = drop_last if drop_last is not None else torch_cfg["drop_last"]
+            if collate_fn is not None:
+                from .data.torch_interop import to_numpy as _to_np
+
+                collate_fn = (lambda samples, _c=collate_fn: _to_np(_c(samples)))
+            else:
+                collate_fn = torch_cfg["collate_fn"]
         dl = DataLoader(
             dataset,
-            batch_size,
-            shuffle=shuffle,
+            batch_size if batch_size is not None else 1,
+            shuffle=bool(shuffle),
             seed=seed if seed is not None else 0,
-            drop_last=drop_last,
+            drop_last=bool(drop_last),
             collate_fn=collate_fn,
             mesh=self.mesh,
             spec=spec,

@@ -1,0 +1,149 @@
+"""torch interop: accept torch Datasets / DataLoaders at the prepare boundary.
+
+The reference's entire data surface is `torch.utils.data` — its users hand
+`Accelerator.prepare` a torch DataLoader and get a wrapped one back
+(reference `prepare_data_loader`, `data_loader.py:988`). Migrating code
+should not have to rewrite its dataset plumbing first, so:
+
+- a torch **Dataset** (map-style `__len__`/`__getitem__`) works directly as
+  this framework's sized dataset; samples are converted tensor->numpy at
+  collate time;
+- a torch **DataLoader** is unwrapped: its dataset, batch size, drop_last,
+  and collate_fn carry over, and the framework's own sharding/shuffling
+  replaces the torch sampler (exactly what the reference does — it swaps
+  the sampler for its sharded one, keeping the dataset).
+
+torch is an optional dependency: everything here degrades to no-ops when it
+is not importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError:  # pragma: no cover - torch is baked into CI images
+        return None
+
+
+def is_torch_dataloader(obj: Any) -> bool:
+    torch = _torch()
+    return torch is not None and isinstance(obj, torch.utils.data.DataLoader)
+
+
+def to_numpy(obj: Any) -> Any:
+    """Recursively convert torch tensors to numpy (CPU) in a sample pytree."""
+    torch = _torch()
+    if torch is not None and isinstance(obj, torch.Tensor):
+        return obj.detach().cpu().numpy()
+    if isinstance(obj, dict):
+        return {k: to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*[to_numpy(v) for v in obj])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(to_numpy(v) for v in obj)
+    return obj
+
+
+class TorchDatasetAdapter:
+    """Sized view over a torch map-style dataset.
+
+    ``convert=True`` hands out numpy samples (for the framework's default
+    collate); ``convert=False`` hands out the raw torch samples (a kept
+    user collate expects tensors — only its OUTPUT is converted)."""
+
+    def __init__(self, dataset: Any, convert: bool = True) -> None:
+        self.dataset = dataset
+        self.convert = convert
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, i: int) -> Any:
+        sample = self.dataset[int(i)]
+        return to_numpy(sample) if self.convert else sample
+
+
+class TorchIterableAdapter:
+    """Iterable view over a torch IterableDataset with numpy samples (the
+    framework loader's iterable path batches it)."""
+
+    def __init__(self, dataset: Any) -> None:
+        self.dataset = dataset
+
+    def __iter__(self):
+        for sample in self.dataset:
+            yield to_numpy(sample)
+
+
+def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> dict[str, Any]:
+    """Extract (dataset, batch_size, drop_last, shuffle, collate_fn) from a
+    torch DataLoader so the framework loader can replace it wholesale.
+
+    Shuffle intent is inferred from the sampler type (SequentialSampler ->
+    False, RandomSampler -> True; anything else warns and asks for an
+    explicit ``shuffle=``); the torch sampler itself is NOT carried over —
+    cross-process sharding needs the framework's deterministic seeded
+    sampler, the same substitution the reference performs.
+
+    ``has_user_collate``: the caller supplies their own collate to the
+    framework loader — samples are then handed out RAW (torch tensors),
+    and the caller's collate output is converted by the accelerator.
+    """
+    import warnings
+
+    torch = _torch()
+    sampler = getattr(loader, "sampler", None)
+    shuffle = None
+    if torch is not None and sampler is not None:
+        if isinstance(sampler, torch.utils.data.RandomSampler):
+            shuffle = True
+        elif isinstance(sampler, torch.utils.data.SequentialSampler):
+            shuffle = False
+        else:
+            warnings.warn(
+                f"Cannot infer shuffle intent from torch sampler "
+                f"{type(sampler).__name__}; the sampler is replaced by the "
+                "framework's sharded seeded sampler — pass shuffle= "
+                "explicitly to prepare_data_loader.",
+                stacklevel=3,
+            )
+    if loader.batch_size is None:
+        raise ValueError(
+            "This torch DataLoader has no batch_size (batch_sampler= or "
+            "batch_size=None): its batching logic cannot carry over — pass "
+            "the dataset and an explicit batch_size to prepare_data_loader."
+        )
+
+    collate = getattr(loader, "collate_fn", None)
+    # torch's default_collate stacks into torch tensors; the framework's
+    # numpy collate replaces it. A torch-side USER collate is kept, wrapped
+    # with tensor->numpy conversion on its output.
+    is_default = torch is not None and collate is torch.utils.data.default_collate
+
+    wrapped_collate = None
+    if collate is not None and not is_default and not has_user_collate:
+        def wrapped_collate(samples, _c=collate):
+            return to_numpy(_c(samples))
+
+    raw_samples = wrapped_collate is not None or has_user_collate
+    if torch is not None and isinstance(loader.dataset, torch.utils.data.IterableDataset):
+        dataset: Any = (
+            loader.dataset if raw_samples else TorchIterableAdapter(loader.dataset)
+        )
+    else:
+        dataset = TorchDatasetAdapter(loader.dataset, convert=not raw_samples)
+    return {
+        "dataset": dataset,
+        "batch_size": loader.batch_size,
+        "drop_last": bool(getattr(loader, "drop_last", False)),
+        "shuffle": shuffle,
+        "collate_fn": wrapped_collate,
+    }

@@ -1,0 +1,163 @@
+"""torch interop: torch Datasets / DataLoaders at the prepare boundary
+(`data/torch_interop.py`) — the migration path for reference users whose
+data plumbing is all `torch.utils.data`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import accelerate_tpu as atx
+
+
+def _torch_dataset(n=32, seq=8):
+    g = torch.Generator().manual_seed(0)
+    x = torch.randint(0, 100, (n, seq), generator=g)
+    y = torch.randint(0, 4, (n,), generator=g)
+    return torch.utils.data.TensorDataset(x, y)
+
+
+class TestTorchInterop:
+    def test_prepare_torch_dataloader_carries_settings(self):
+        ds = _torch_dataset()
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=4, shuffle=True, drop_last=True)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        assert loader.batch_size == 4
+        assert loader.drop_last
+        assert loader.sampler.shuffle
+        batch = next(iter(loader))
+        x, y = batch
+        # global batch = per-process batch x dp world (8-device sim mesh)
+        assert x.shape[0] == loader.total_batch_size
+        assert x.shape[1] == 8
+        assert isinstance(np.asarray(x), np.ndarray)
+
+    def test_every_sample_seen_once(self):
+        ds = _torch_dataset(n=32)
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=2, shuffle=False)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        seen = []
+        for x, y in loader:
+            seen.extend(np.asarray(x)[:, 0].tolist())
+        expected = sorted(np.asarray(ds.tensors[0][:, 0]).tolist())
+        assert sorted(seen) == expected
+
+    def test_plain_torch_dataset_works_directly(self):
+        """Map-style torch datasets need no adapter: numpy collate converts."""
+        ds = _torch_dataset(n=16)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(ds, batch_size=2)
+        x, y = next(iter(loader))
+        assert x.shape == (loader.total_batch_size, 8)
+
+    def test_custom_collate_preserved(self):
+        ds = _torch_dataset(n=16)
+
+        def collate(samples):
+            xs = torch.stack([s[0] for s in samples])
+            return {"tokens": xs + 1}
+
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=2, collate_fn=collate)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        batch = next(iter(loader))
+        assert "tokens" in batch
+        np.testing.assert_array_equal(
+            np.asarray(batch["tokens"])[0], np.asarray(ds.tensors[0][0]) + 1
+        )
+
+    def test_trains_end_to_end_from_torch_loader(self):
+        from accelerate_tpu.models import gpt
+        import optax
+
+        ds = _torch_dataset(n=64, seq=16)
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=2, shuffle=True)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        config = gpt.GPTConfig.tiny(vocab_size=128, max_seq_len=16)
+        state = acc.create_train_state(lambda r: gpt.init(r, config), optax.adam(1e-3))
+        step = acc.make_train_step(
+            lambda p, b, r: gpt.loss_fn(p, {"input_ids": b[0]}, config, r)
+        )
+        losses = []
+        for epoch in range(3):
+            loader.set_epoch(epoch)
+            for batch in loader:
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+
+class TestTorchInteropEdgeCases:
+    def test_iterable_dataset_unwraps_to_iterable_path(self):
+        class Stream(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for i in range(16):
+                    yield {"x": torch.tensor([float(i)])}
+
+        torch_dl = torch.utils.data.DataLoader(Stream(), batch_size=2)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        batches = list(loader)
+        assert batches
+        vals = sorted(float(v) for b in batches for v in np.asarray(b["x"]).ravel())
+        assert vals[:16] == [float(i) for i in range(16)]  # wraparound may repeat
+
+    def test_unknown_sampler_warns(self):
+        import warnings
+
+        ds = _torch_dataset(n=16)
+        sampler = torch.utils.data.SubsetRandomSampler(range(16))
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=2, sampler=sampler)
+        acc = atx.Accelerator(seed=0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            acc.prepare_data_loader(torch_dl)
+        assert any("shuffle" in str(w.message) for w in caught)
+
+    def test_explicit_args_beat_inherited(self):
+        ds = _torch_dataset(n=16)
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=8, shuffle=True, drop_last=True)
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl, batch_size=1, shuffle=False, drop_last=False)
+        assert loader.batch_size == 1
+        assert not loader.sampler.shuffle
+        assert not loader.drop_last
+
+    def test_batch_sampler_loader_rejected(self):
+        ds = _torch_dataset(n=16)
+        bs = torch.utils.data.BatchSampler(
+            torch.utils.data.SequentialSampler(ds), batch_size=4, drop_last=False
+        )
+        torch_dl = torch.utils.data.DataLoader(ds, batch_sampler=bs)
+        acc = atx.Accelerator(seed=0)
+        with pytest.raises(ValueError, match="batch_size"):
+            acc.prepare_data_loader(torch_dl)
+
+    def test_caller_collate_gets_raw_torch_samples(self):
+        ds = _torch_dataset(n=16)
+        torch_dl = torch.utils.data.DataLoader(ds, batch_size=2)
+
+        def collate(samples):
+            assert isinstance(samples[0][0], torch.Tensor)  # raw, not numpy
+            return {"tokens": torch.stack([s[0] for s in samples])}
+
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl, collate_fn=collate)
+        batch = next(iter(loader))
+        assert np.asarray(batch["tokens"]).shape[1] == 8
+
+    def test_namedtuple_samples_convert(self):
+        from collections import namedtuple
+
+        Sample = namedtuple("Sample", ["x", "y"])
+        from accelerate_tpu.data.torch_interop import to_numpy
+
+        s = Sample(torch.ones(3), torch.zeros(2))
+        out = to_numpy(s)
+        assert isinstance(out, Sample)
+        assert isinstance(out.x, np.ndarray)
