@@ -118,7 +118,7 @@ def interactive_config() -> LaunchConfig:
         "Sharding strategy (DATA_PARALLEL/ZERO1/ZERO2/FSDP/TENSOR_PARALLEL/HYBRID)",
         "FSDP" if cfg.mesh_fsdp > 1 else "DATA_PARALLEL",
     ).upper()
-    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16)", "bf16")
+    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
     cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
     if _ask("Launching on a GCE TPU pod via gcloud? (y/n)", "n").lower().startswith("y"):
         cfg.tpu_name = _ask("TPU name", "")

@@ -42,7 +42,7 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--coordinator_address", default=None, help="host:port of process 0")
     p.add_argument("--coordinator_port", type=int, default=None)
-    p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16"])
+    p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
     p.add_argument(
         "--strategy",
         default=None,
