@@ -100,9 +100,14 @@ def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> d
     import warnings
 
     torch = _torch()
+    is_iterable = torch is not None and isinstance(
+        loader.dataset, torch.utils.data.IterableDataset
+    )
     sampler = getattr(loader, "sampler", None)
     shuffle = None
-    if torch is not None and sampler is not None:
+    # Iterable datasets have no sampler intent to infer (torch installs an
+    # internal infinite sampler); ordering is the stream's own.
+    if torch is not None and sampler is not None and not is_iterable:
         if isinstance(sampler, torch.utils.data.RandomSampler):
             shuffle = True
         elif isinstance(sampler, torch.utils.data.SequentialSampler):
@@ -134,7 +139,7 @@ def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> d
             return to_numpy(_c(samples))
 
     raw_samples = wrapped_collate is not None or has_user_collate
-    if torch is not None and isinstance(loader.dataset, torch.utils.data.IterableDataset):
+    if is_iterable:
         dataset: Any = (
             loader.dataset if raw_samples else TorchIterableAdapter(loader.dataset)
         )
