@@ -167,6 +167,7 @@ class Accelerator:
                 num_steps=gradient_accumulation_steps if gradient_accumulation_steps > 1 else None
             )
         self.gradient_state = GradientState(gradient_accumulation_plugin.num_steps)
+        self.gradient_accumulation_plugin = gradient_accumulation_plugin
         self.policy = MixedPrecisionPolicy.from_precision(self.state.mixed_precision)
         if strategy is None:
             # Launcher env contract (ATX_SHARDING_STRATEGY) fallback.
@@ -313,6 +314,7 @@ class Accelerator:
             batch_size = batch_size if batch_size is not None else torch_cfg["batch_size"]
             shuffle = shuffle if shuffle is not None else torch_cfg["shuffle"]
             drop_last = drop_last if drop_last is not None else torch_cfg["drop_last"]
+            seed = seed if seed is not None else torch_cfg["seed"]
             if collate_fn is not None:
                 from .data.torch_interop import to_numpy as _to_np
 
@@ -414,6 +416,40 @@ class Accelerator:
         return state.params
 
     unwrap_model = unwrap
+
+    # ------------------------------------------------------------ scheduler
+    def prepare_scheduler(self, schedule: Callable[[Any], Any]) -> Callable[[Any], Any]:
+        """Adapt an optax schedule to gradient accumulation (reference
+        `AcceleratedScheduler`, `scheduler.py:62`).
+
+        With ``adjust_scheduler=True`` (the plugin default) the reference
+        advances the LR schedule once per *batch* even on non-sync
+        accumulation steps, so a schedule denominated in batches completes
+        on time. Optax schedules count optimizer updates — which advance
+        ``num_steps``× slower under accumulation — so the returned schedule
+        evaluates the original at ``count * num_steps``. The schedule you
+        pass in must therefore be denominated in *microbatches* (reference
+        batches): with ``total_updates`` optimizer steps planned that is
+        ``total_updates * num_steps``, NOT ``len(loader) * epochs`` (a
+        framework dataloader batch is the whole accumulation window). Pass
+        the result as the ``learning_rate`` of your optax optimizer::
+
+            microbatches = total_updates * accelerator.gradient_accumulation_steps
+            sched = accelerator.prepare_scheduler(
+                optax.cosine_decay_schedule(3e-4, decay_steps=microbatches))
+            tx = optax.adamw(learning_rate=sched)
+
+        With ``adjust_scheduler=False`` (or no accumulation) the schedule is
+        returned unchanged.
+        """
+        accum = self.gradient_state.num_steps
+        if accum <= 1 or not self.gradient_accumulation_plugin.adjust_scheduler:
+            return schedule
+
+        def adjusted(count):
+            return schedule(count * accum)
+
+        return adjusted
 
     # ----------------------------------------------------------- train step
     def make_train_step(
@@ -790,6 +826,15 @@ class Accelerator:
 
             with accelerator.autocast() as cast:
                 out = model_fn(cast(params), batch)
+
+        fp8 pitfall: the matmul mode is read at *trace* time and is not part
+        of jit's cache key. A function you ``jax.jit`` yourself and first
+        call inside this context bakes fp8 contractions into its cached
+        executable (and keeps them outside the context); traced first
+        outside, it never gets fp8. Either trace the function fresh per mode
+        (e.g. pass a ``static_argnum`` flag derived from the policy) or keep
+        fp8 work inside the Accelerator's own compiled steps, which close
+        over the mode correctly.
         """
         import contextlib
 

@@ -386,6 +386,16 @@ def prepare_data_loader(
 
 def skip_first_batches(dataloader: DataLoader, num_batches: int = 0) -> DataLoader:
     """Mid-epoch resume helper (reference `skip_first_batches`,
-    `data_loader.py:1349`): returns a loader that skips ``num_batches``."""
-    dataloader.skip_batches = num_batches
-    return dataloader
+    `data_loader.py:1349`): returns a NEW loader over the same dataset that
+    skips ``num_batches``. The argument is left untouched (the reference also
+    constructs a fresh dataloader — callers may keep iterating the original
+    without silently losing batches)."""
+    import copy
+
+    new = copy.copy(dataloader)
+    if dataloader.sampler is not None:
+        new.sampler = copy.copy(dataloader.sampler)
+    new.skip_batches = num_batches
+    new._batches_yielded = 0
+    new.end_of_dataloader = False
+    return new

@@ -42,7 +42,15 @@ def to_numpy(obj: Any) -> Any:
     """Recursively convert torch tensors to numpy (CPU) in a sample pytree."""
     torch = _torch()
     if torch is not None and isinstance(obj, torch.Tensor):
-        return obj.detach().cpu().numpy()
+        t = obj.detach().cpu()
+        # numpy has no bf16 (or fp8) dtype — upcast rather than crash a
+        # migrating pipeline at the prepare boundary; the loader's device put
+        # re-casts per the precision policy anyway.
+        if t.dtype == torch.bfloat16 or (
+            hasattr(torch, "float8_e4m3fn") and "float8" in str(t.dtype)
+        ):
+            t = t.float()
+        return t.numpy()
     if isinstance(obj, dict):
         return {k: to_numpy(v) for k, v in obj.items()}
     if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
@@ -138,6 +146,18 @@ def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> d
         def wrapped_collate(samples, _c=collate):
             return to_numpy(_c(samples))
 
+    # Carry the torch generator seed into the framework sampler so a
+    # migrated run stays deterministic in the seed the user chose (the
+    # *order* still differs — numpy PCG64 vs torch's Philox — which is the
+    # same substitution the reference performs with its seeded sampler).
+    seed = None
+    gen = getattr(loader, "generator", None) or getattr(sampler, "generator", None)
+    if gen is not None:
+        try:
+            seed = int(gen.initial_seed()) & 0x7FFFFFFF
+        except Exception:
+            seed = None
+
     raw_samples = wrapped_collate is not None or has_user_collate
     if is_iterable:
         dataset: Any = (
@@ -151,4 +171,5 @@ def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> d
         "drop_last": bool(getattr(loader, "drop_last", False)),
         "shuffle": shuffle,
         "collate_fn": wrapped_collate,
+        "seed": seed,
     }

@@ -30,10 +30,23 @@ _DEFAULT_THREADS = min(8, os.cpu_count() or 1)
 
 def _build_and_load() -> Any:
     """Compile (if needed) and dlopen the native library. Raises on failure."""
+    # Per-user cache path (uid suffix, like torch's cpp_extension): a shared
+    # predictable path in /tmp would let another local user pre-plant a .so
+    # that ctypes.CDLL then executes in this process.
     cache_dir = os.environ.get(
-        "ATX_NATIVE_CACHE", os.path.join(tempfile.gettempdir(), "atx_native")
+        "ATX_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), f"atx_native_{os.getuid()}"),
     )
-    os.makedirs(cache_dir, exist_ok=True)
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    st = os.stat(cache_dir)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        raise RuntimeError(
+            f"Refusing to load native kernels from {cache_dir!r}: the cache "
+            f"directory is owned by uid {st.st_uid} with mode "
+            f"{oct(st.st_mode & 0o777)} (must be owned by this user and not "
+            "group/world-writable). Set ATX_NATIVE_CACHE to a private "
+            "directory."
+        )
     src_mtime = int(os.path.getmtime(_SRC))
     so_path = os.path.join(cache_dir, f"hostloader_{src_mtime}.so")
     if not os.path.exists(so_path):

@@ -14,7 +14,8 @@
 //                      (deterministic in the seed, O(n), no numpy RNG
 //                      state to carry).
 //
-// Built by native/build.py with `g++ -O3 -shared -fPIC`; loaded via ctypes
+// Built on first use by native/__init__.py (_build_and_load) with
+// `g++ -O3 -shared -fPIC`; loaded via ctypes
 // (no pybind11 in the image). Every entry point is plain C ABI.
 
 #include <cstdint>

@@ -143,9 +143,21 @@ class MixedPrecisionPolicy:
 class GradientAccumulationPlugin:
     """Reference `GradientAccumulationPlugin` (`dataclasses.py:920`).
 
-    ``adjust_scheduler`` and ``sync_with_dataloader`` keep their reference
-    meanings; ``sync_each_batch`` is irrelevant on TPU (accumulation happens
-    inside one compiled step, there is no unsynced gradient hook to manage).
+    ``adjust_scheduler`` keeps its reference meaning (`scheduler.py:62`: the
+    LR schedule advances once per *microbatch*, not once per optimizer
+    update): it is consumed by `Accelerator.prepare_scheduler`, which wraps
+    an optax schedule so ``schedule(count)`` is evaluated at
+    ``count * num_steps``.
+
+    ``sync_with_dataloader=True`` (reference `accelerator.py:1092`: reset
+    the accumulation window at end of dataloader) is guaranteed *by
+    construction* here — the whole window lives inside one compiled step, so
+    a window can never span a dataloader boundary. ``False`` (let a window
+    straddle epochs) is inexpressible in the intra-step design and is
+    rejected loudly rather than silently ignored.
+
+    ``sync_each_batch`` is irrelevant on TPU (there is no unsynced gradient
+    hook to manage) and intentionally has no field.
     """
 
     num_steps: int | None = None
@@ -155,6 +167,14 @@ class GradientAccumulationPlugin:
     def __post_init__(self) -> None:
         if self.num_steps is None:
             self.num_steps = get_int_from_env(("ATX_GRADIENT_ACCUMULATION_STEPS",), 1)
+        if not self.sync_with_dataloader:
+            raise ValueError(
+                "sync_with_dataloader=False (accumulation windows spanning a "
+                "dataloader boundary) is not supported: accumulation runs "
+                "inside one compiled step, so every window both starts and "
+                "syncs within a single global batch. Drop the flag — the "
+                "True behavior is structural."
+            )
 
 
 @dataclass

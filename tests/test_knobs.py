@@ -100,3 +100,39 @@ def test_zero2_is_documented_alias_of_zero1():
     # and both actually shard the moment (params stay replicated)
     assert "data" in shardings["ZERO2"][0]
     assert shardings["ZERO2"][1] == "PartitionSpec()"
+
+
+def test_prepare_scheduler_adjusts_for_accumulation():
+    # Reference semantics (`scheduler.py:62`): with adjust_scheduler=True the
+    # LR schedule advances per microbatch, so at optimizer update k it reads
+    # schedule(k * num_steps).
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    sched = optax.linear_schedule(1.0, 0.0, transition_steps=100)
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(seed=0, gradient_accumulation_steps=4)
+    adjusted = acc.prepare_scheduler(sched)
+    for k in (0, 5, 25):
+        np.testing.assert_allclose(adjusted(k), sched(k * 4))
+
+    # adjust_scheduler=False (or accum == 1) passes through unchanged.
+    AcceleratorState._reset_state()
+    acc = Accelerator(
+        seed=0,
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=4, adjust_scheduler=False
+        ),
+    )
+    assert acc.prepare_scheduler(sched) is sched
+    AcceleratorState._reset_state()
+    acc = Accelerator(seed=0)
+    assert acc.prepare_scheduler(sched) is sched
+
+
+def test_sync_with_dataloader_false_rejected():
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    with pytest.raises(ValueError, match="sync_with_dataloader"):
+        GradientAccumulationPlugin(num_steps=2, sync_with_dataloader=False)

@@ -111,10 +111,16 @@ def test_skip_first_batches_and_state_dict():
     dl = DataLoader(ds, batch_size=2, mesh=mesh)
     all_batches = [np.asarray(b["x"]) for b in dl]
     dl2 = DataLoader(ds, batch_size=2, mesh=mesh)
-    skip_first_batches(dl2, 1)
-    rest = [np.asarray(b["x"]) for b in dl2]
+    skipped = skip_first_batches(dl2, 1)
+    rest = [np.asarray(b["x"]) for b in skipped]
     assert len(rest) == len(all_batches) - 1
     np.testing.assert_array_equal(rest[0], all_batches[1])
+    # The argument is NOT aliased (reference builds a fresh dataloader too):
+    # the original loader still yields the full epoch.
+    assert skipped is not dl2 and dl2.skip_batches == 0
+    full_again = [np.asarray(b["x"]) for b in dl2]
+    assert len(full_again) == len(all_batches)
+    np.testing.assert_array_equal(full_again[0], all_batches[0])
     # state_dict round trip resumes mid-epoch
     dl3 = DataLoader(ds, batch_size=2, mesh=mesh)
     it = iter(dl3)

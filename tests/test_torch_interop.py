@@ -161,3 +161,26 @@ class TestTorchInteropEdgeCases:
         out = to_numpy(s)
         assert isinstance(out, Sample)
         assert isinstance(out.x, np.ndarray)
+
+
+class TestInteropEdgeCases:
+    def test_bf16_tensors_convert_via_upcast(self):
+        from accelerate_tpu.data.torch_interop import to_numpy
+
+        t = torch.ones((4, 2), dtype=torch.bfloat16)
+        out = to_numpy({"h": t})
+        assert out["h"].dtype == np.float32
+        np.testing.assert_allclose(out["h"], 1.0)
+
+    def test_generator_seed_carries_into_sampler(self):
+        ds = _torch_dataset()
+        g = torch.Generator().manual_seed(1234)
+        torch_dl = torch.utils.data.DataLoader(
+            ds, batch_size=4, shuffle=True, generator=g
+        )
+        acc = atx.Accelerator(seed=0)
+        loader = acc.prepare_data_loader(torch_dl)
+        assert loader.sampler.seed == 1234 & 0x7FFFFFFF
+        # Explicit seed= still wins.
+        loader2 = acc.prepare_data_loader(torch_dl, seed=7)
+        assert loader2.sampler.seed == 7
