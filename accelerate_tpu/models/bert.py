@@ -61,7 +61,7 @@ class BertConfig:
 
     def param_count(self) -> int:
         d, f = self.d_model, self.d_ff
-        block = 4 * d * d + 2 * d * f + 5 * d + f  # matmuls + 2 norms + mlp biases
+        block = 4 * d * d + 2 * d * f + 9 * d + f  # matmuls + 2 norms + mlp&attn biases
         embed = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * d + 2 * d
         heads = d * d + d + d * self.num_labels + self.num_labels  # pooler + classifier
         return self.n_layers * block + embed + heads
@@ -70,7 +70,7 @@ class BertConfig:
 def init_block(rng: jax.Array, config: BertConfig, dtype=jnp.float32) -> Params:
     ka, km = jax.random.split(rng)
     return {
-        "attn": init_attention(ka, config.attention_spec, dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype, bias=True),
         "attn_norm_scale": jnp.ones((config.d_model,), dtype),
         "attn_norm_bias": jnp.zeros((config.d_model,), dtype),
         "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),

@@ -90,7 +90,7 @@ class GPTConfig:
         return cls(**{**dict(d_model=1600, n_layers=48, num_heads=25, d_ff=6400), **overrides})
 
     def param_count(self) -> int:
-        attn = 4 * self.d_model * self.d_model
+        attn = 4 * self.d_model * self.d_model + 4 * self.d_model  # + q/k/v/o biases
         ffn = 2 * self.d_model * self.d_ff + self.d_ff + self.d_model
         norms = 2 * 2 * self.d_model
         block = attn + ffn + norms
@@ -107,7 +107,7 @@ def init_block(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
     return {
         "ln1_scale": jnp.ones((config.d_model,), dtype),
         "ln1_bias": jnp.zeros((config.d_model,), dtype),
-        "attn": init_attention(ka, config.attention_spec, dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype, bias=True),
         "ln2_scale": jnp.ones((config.d_model,), dtype),
         "ln2_bias": jnp.zeros((config.d_model,), dtype),
         "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),

@@ -167,26 +167,43 @@ class AttentionSpec:
         return self.num_kv_heads * self.head_dim
 
 
-def init_attention(rng: jax.Array, spec: AttentionSpec, dtype=jnp.float32) -> Params:
+def init_attention(
+    rng: jax.Array, spec: AttentionSpec, dtype=jnp.float32, *, bias: bool = False
+) -> Params:
+    """``bias=True`` adds per-head q/k/v biases and an output bias (BERT /
+    GPT-2 / ViT convention; llama-family attention is bias-free)."""
     kq, kk, kv, ko = jax.random.split(rng, 4)
     std = 1.0 / np.sqrt(spec.d_model)
-    return {
+    params = {
         "wq": truncated_normal_init(kq, (spec.d_model, spec.num_heads, spec.head_dim), std, dtype),
         "wk": truncated_normal_init(kk, (spec.d_model, spec.num_kv_heads, spec.head_dim), std, dtype),
         "wv": truncated_normal_init(kv, (spec.d_model, spec.num_kv_heads, spec.head_dim), std, dtype),
         "wo": truncated_normal_init(ko, (spec.num_heads, spec.head_dim, spec.d_model), std, dtype),
     }
+    if bias:
+        params["bq"] = jnp.zeros((spec.num_heads, spec.head_dim), dtype)
+        params["bk"] = jnp.zeros((spec.num_kv_heads, spec.head_dim), dtype)
+        params["bv"] = jnp.zeros((spec.num_kv_heads, spec.head_dim), dtype)
+        params["bo"] = jnp.zeros((spec.d_model,), dtype)
+    return params
 
 
 def attention_qkv(params: Params, x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     q = matmul_einsum("bsd,dhk->bshk", x, params["wq"])
     k = matmul_einsum("bsd,dhk->bshk", x, params["wk"])
     v = matmul_einsum("bsd,dhk->bshk", x, params["wv"])
+    if "bq" in params:
+        q = q + params["bq"].astype(q.dtype)
+        k = k + params["bk"].astype(k.dtype)
+        v = v + params["bv"].astype(v.dtype)
     return q, k, v
 
 
 def attention_out(params: Params, attn: jax.Array) -> jax.Array:
-    return matmul_einsum("bshk,hkd->bsd", attn, params["wo"])
+    out = matmul_einsum("bshk,hkd->bsd", attn, params["wo"])
+    if "bo" in params:
+        out = out + params["bo"].astype(out.dtype)
+    return out
 
 
 # ------------------------------------------------------------------------ mlp

@@ -88,7 +88,7 @@ class ViTConfig:
 
     def param_count(self) -> int:
         d, f = self.d_model, self.d_ff
-        block = 4 * d * d + 2 * d * f + f + d + 4 * d
+        block = 4 * d * d + 2 * d * f + f + d + 8 * d  # incl. q/k/v/o biases
         patch = self.patch_dim * d + d
         pos = (self.n_patches + 1) * d
         head = d * self.num_classes + self.num_classes
@@ -100,7 +100,7 @@ def init_block(rng: jax.Array, config: ViTConfig, dtype=jnp.float32) -> Params:
     return {
         "ln1_scale": jnp.ones((config.d_model,), dtype),
         "ln1_bias": jnp.zeros((config.d_model,), dtype),
-        "attn": init_attention(ka, config.attention_spec, dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype, bias=True),
         "ln2_scale": jnp.ones((config.d_model,), dtype),
         "ln2_bias": jnp.zeros((config.d_model,), dtype),
         "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),

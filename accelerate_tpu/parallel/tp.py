@@ -77,6 +77,8 @@ register_tp_plan(
     (
         (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
         (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/attn/b[qkv]$", P(None, T, None)),
+        (r"blocks/attn/bo$", P()),
         (r"blocks/mlp/w_in$", P(None, F, T)),
         (r"blocks/mlp/b_in$", P(None, T)),
         (r"blocks/mlp/w_out$", P(None, T, F)),
@@ -109,6 +111,8 @@ register_tp_plan(
     (
         (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
         (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/attn/b[qkv]$", P(None, T, None)),
+        (r"blocks/attn/bo$", P()),
         (r"blocks/mlp/w_in$", P(None, F, T)),
         (r"blocks/mlp/b_in$", P(None, T)),
         (r"blocks/mlp/w_out$", P(None, T, F)),
@@ -124,6 +128,8 @@ register_tp_plan(
     (
         (r"blocks/attn/w[qkv]$", P(None, F, T, None)),
         (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/attn/b[qkv]$", P(None, T, None)),
+        (r"blocks/attn/bo$", P()),
         (r"blocks/mlp/w_in$", P(None, F, T)),
         (r"blocks/mlp/b_in$", P(None, T)),
         (r"blocks/mlp/w_out$", P(None, T, F)),

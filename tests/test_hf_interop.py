@@ -1,0 +1,185 @@
+"""HF-checkpoint ingestion (`models/hf.py`): zero-key-map loading of real
+Hugging Face repo layouts, numerically verified against `transformers`'
+own forward pass (the strongest possible parity check — reference
+`load_checkpoint_in_model`, `utils/modeling.py:1787`)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from accelerate_tpu.big_modeling import infer_sharding_plan
+from accelerate_tpu.models import bert, gpt, hf, llama, vit
+from accelerate_tpu.parallel import MeshConfig, build_mesh
+
+
+def _save_hf(model, tmp_path, name):
+    d = tmp_path / name
+    model.save_pretrained(str(d), safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama(tmp_path_factory):
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = _save_hf(model, tmp_path_factory.mktemp("hf"), "llama")
+    return model, d
+
+
+class TestLlamaParity:
+    def test_config_translation(self, tiny_hf_llama):
+        _, repo = tiny_hf_llama
+        family, config = hf.from_hf_config(repo)
+        assert family == "llama"
+        assert (config.d_model, config.n_layers, config.num_heads,
+                config.num_kv_heads, config.d_ff) == (64, 2, 4, 2, 128)
+        assert config.rope_theta == 10000.0
+
+    def test_forward_matches_transformers(self, tiny_hf_llama):
+        model, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 256
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+    def test_offloaded_leaves_loadable(self, tiny_hf_llama):
+        _, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        family, config = hf.from_hf_config(repo)
+        shapes = jax.eval_shape(lambda: llama.init(jax.random.PRNGKey(0), config))
+        total = sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize for l in jax.tree.leaves(shapes)
+        )
+        plan = infer_sharding_plan(shapes, mesh, hbm_budget=total // 16)
+        assert plan.offload
+        params = hf.load_hf_checkpoint(
+            shapes, repo, plan, family=family, config=config
+        )
+        from accelerate_tpu.parallel.sharding import _path_str
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        for p, leaf in flat:
+            if _path_str(p) in plan.offload:
+                assert isinstance(leaf, np.ndarray)
+
+    def test_dtype_cast(self, tiny_hf_llama):
+        _, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig())
+        loaded = hf.load_pretrained(repo, mesh=mesh, dtype=jnp.bfloat16)
+        assert all(
+            l.dtype == jnp.bfloat16 for l in jax.tree.leaves(loaded.params)
+        )
+
+    def test_missing_tensor_error_is_actionable(self, tiny_hf_llama, tmp_path):
+        _, repo = tiny_hf_llama
+        # A repo whose config promises more layers than its weights have.
+        cfg = json.load(open(f"{repo}/config.json"))
+        cfg["num_hidden_layers"] = 4
+        broken = tmp_path / "broken"
+        broken.mkdir()
+        json.dump(cfg, open(broken / "config.json", "w"))
+        import shutil
+
+        for f in ("model.safetensors",):
+            shutil.copy(f"{repo}/{f}", broken / f)
+        mesh = build_mesh(MeshConfig())
+        with pytest.raises(KeyError, match="model.layers.2"):
+            hf.load_pretrained(str(broken), mesh=mesh)
+
+
+class TestGPT2Parity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        )
+        torch.manual_seed(1)
+        model = transformers.GPT2LMHeadModel(cfg).eval()
+        repo = _save_hf(model, tmp_path, "gpt2")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.family == "gpt"
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(
+            gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+
+class TestBertParity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, num_labels=3,
+        )
+        torch.manual_seed(2)
+        model = transformers.BertForSequenceClassification(cfg).eval()
+        repo = _save_hf(model, tmp_path, "bert")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(
+            bert.classify(
+                loaded.params, {"input_ids": jnp.asarray(tokens)}, loaded.config
+            )
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+
+class TestViTParity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.ViTConfig(
+            image_size=32, patch_size=8, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64, num_labels=5,
+        )
+        torch.manual_seed(3)
+        model = transformers.ViTForImageClassification(cfg).eval()
+        repo = _save_hf(model, tmp_path, "vit")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        rng = np.random.RandomState(0)
+        images = rng.rand(2, 32, 32, 3).astype(np.float32)
+        ours = np.asarray(
+            vit.forward(loaded.params, jnp.asarray(images), loaded.config)
+        )
+        with torch.no_grad():
+            # HF ViT eats NCHW; this framework eats NHWC.
+            theirs = model(
+                torch.from_numpy(images.transpose(0, 3, 1, 2))
+            ).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+
+class TestDefaultSharding:
+    def test_default_rules_shard_over_mesh(self, tiny_hf_llama):
+        # Regression: with no explicit rules, load_pretrained must apply the
+        # family TP plan — NOT replicate every leaf on every device.
+        _, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        wq = loaded.params["blocks"]["attn"]["wq"]
+        n_devices = 8
+        # Sharded: each device holds a strict fraction of the leaf.
+        shard_elems = wq.addressable_shards[0].data.size
+        assert shard_elems * n_devices == wq.size
