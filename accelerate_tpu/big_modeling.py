@@ -355,30 +355,52 @@ def load_checkpoint_and_dispatch(
     fp32 checkpoints).
     """
     source = _open_source(checkpoint_path)
+
+    def make_fetch(key: str, leaf: Any) -> Callable[[tuple], np.ndarray]:
+        src_key = key_map(key) if key_map else key
+        return lambda idx, _k=src_key: np.asarray(source.read_slice(_k, tuple(idx)))
+
+    try:
+        return dispatch_leaves(shapes, plan, make_fetch, dtype=dtype)
+    finally:
+        source.close()
+
+
+def dispatch_leaves(
+    shapes: Any,
+    plan: ShardingPlan,
+    make_fetch: Callable[[str, Any], Callable[[tuple], np.ndarray]],
+    *,
+    dtype: Any | None = None,
+) -> Any:
+    """Shared streaming-dispatch core: for each leaf of ``shapes``,
+    ``make_fetch(plan_key, leaf)`` returns a host-side callback mapping a
+    slice index to the leaf's content; sharded leaves are built with
+    `jax.make_array_from_callback` (each device pulls exactly its planned
+    slice), ``plan.offload`` leaves come back as full host numpy arrays.
+    Both `load_checkpoint_and_dispatch` and the HF-named streaming loader
+    (`models/hf.py`) ride this loop."""
     mesh = plan.mesh
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
     spec_leaves = jax.tree.leaves(
         plan.specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
     )
     out = []
-    try:
-        for (path, leaf), spec in zip(flat, spec_leaves):
-            key = _path_str(path)
-            src_key = key_map(key) if key_map else key
-            shape = tuple(leaf.shape)
-            target_dtype = np.dtype(dtype) if dtype is not None else np.dtype(leaf.dtype)
-            if key in plan.offload:
-                full = source.read_slice(src_key, tuple(slice(0, d) for d in shape))
-                out.append(np.asarray(full, dtype=target_dtype))
-                continue
-            sharding = NamedSharding(mesh, spec)
+    for (path, leaf), spec in zip(flat, spec_leaves):
+        key = _path_str(path)
+        shape = tuple(leaf.shape)
+        target_dtype = np.dtype(dtype) if dtype is not None else np.dtype(leaf.dtype)
+        fetch = make_fetch(key, leaf)
+        if key in plan.offload:
+            full = fetch(tuple(slice(0, d) for d in shape))
+            out.append(np.asarray(full, dtype=target_dtype))
+            continue
+        sharding = NamedSharding(mesh, spec)
 
-            def fetch(idx: tuple[slice, ...], _k=src_key, _dt=target_dtype) -> np.ndarray:
-                return np.asarray(source.read_slice(_k, idx), dtype=_dt)
+        def device_fetch(idx, _f=fetch, _dt=target_dtype) -> np.ndarray:
+            return np.asarray(_f(idx), dtype=_dt)
 
-            out.append(jax.make_array_from_callback(shape, sharding, fetch))
-    finally:
-        source.close()
+        out.append(jax.make_array_from_callback(shape, sharding, device_fetch))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

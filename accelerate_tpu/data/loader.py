@@ -210,24 +210,95 @@ class DataLoader:
                     collated = broadcast_object_list([collated])[0]
                 yield collated
         else:
-            buf: list[Any] = []
-            first: list[Any] | None = None
-            for element in self.dataset:
-                buf.append(element)
-                if len(buf) == self.total_batch_size:
-                    if first is None:
-                        first = list(buf)
-                    yield self.collate_fn(buf)
-                    buf = []
-            if buf and not self.drop_last:
+            yield from self._iterable_host_batches()
+
+    def _iterable_collated(self) -> Iterator[Any]:
+        """Collated batches straight off the iterable dataset's stream."""
+        buf: list[Any] = []
+        first: list[Any] | None = None
+        for element in self.dataset:
+            buf.append(element)
+            if len(buf) == self.total_batch_size:
                 if first is None:
                     first = list(buf)
-                if self.config.even_batches:
-                    while len(buf) < self.total_batch_size:
-                        buf += first
-                    yield self.collate_fn(buf[: self.total_batch_size])
-                else:
-                    yield self.collate_fn(buf)
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            if first is None:
+                first = list(buf)
+            if self.config.even_batches:
+                while len(buf) < self.total_batch_size:
+                    buf += first
+                yield self.collate_fn(buf[: self.total_batch_size])
+            else:
+                yield self.collate_fn(buf)
+
+    def _iterable_host_batches(self) -> Iterator[Any]:
+        """Iterable-dataset path with the reference's dispatch default.
+
+        ``dispatch_batches=None`` resolves to **True** here (reference
+        `data_loader.py:1085-1089`): per-process iterable streams can
+        diverge (network readers, unseeded generators), and divergent
+        streams silently produce inconsistent global arrays in shard mode.
+        Under dispatch, only the main process consumes the stream and
+        broadcasts each batch (with an end-of-stream signal, since workers
+        cannot know the length).
+
+        Explicit ``dispatch_batches=False`` keeps shard mode — every process
+        must then iterate an IDENTICAL stream; with ``ATX_DEBUG_MODE=1`` the
+        first batch's content digest is compared across processes to catch
+        divergence loudly.
+        """
+        dispatch = self.config.dispatch_batches
+        if dispatch is None:
+            dispatch = True
+        it = self._iterable_collated()
+        if dispatch and self.state.num_processes > 1:
+            from ..ops.collectives import broadcast_object_list
+
+            if self.state.is_main_process:
+                # The end-of-stream sentinel must go out on EVERY exit path —
+                # a stream that raises mid-epoch (the motivating network-
+                # reader case) or an early consumer break would otherwise
+                # leave the worker ranks blocked in broadcast forever.
+                try:
+                    for collated in it:
+                        broadcast_object_list([(True, collated)])
+                        yield collated
+                finally:
+                    broadcast_object_list([(False, None)])
+            else:
+                while True:
+                    more, collated = broadcast_object_list([None])[0]
+                    if not more:
+                        return
+                    yield collated
+            return
+        checked = dispatch or self.state.num_processes == 1 or not self.state.debug
+        for collated in it:
+            if not checked:
+                checked = True
+                self._verify_shard_stream(collated)
+            yield collated
+
+    def _verify_shard_stream(self, collated: Any) -> None:
+        """Debug-mode digest check: shard-mode iterable streams must agree."""
+        import hashlib
+
+        from ..ops.collectives import DistributedOperationException, gather_object
+
+        md5 = hashlib.md5()
+        for leaf in jax.tree.leaves(collated):
+            md5.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        digests = gather_object([md5.hexdigest()])
+        if len(set(digests)) > 1:
+            raise DistributedOperationException(
+                "Iterable dataset streams DIVERGE across processes in shard "
+                f"mode (first-batch digests: {digests}). Every process must "
+                "iterate an identical stream when dispatch_batches=False; "
+                "seed the stream identically, or drop the flag to use the "
+                "default dispatch mode (main process reads, others receive)."
+            )
 
     def _device_batches(self) -> Iterator[Any]:
         for i, host_batch in enumerate(self._host_batches()):

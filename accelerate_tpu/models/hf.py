@@ -36,8 +36,6 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
-
 Params = Any
 
 # A fetcher maps (read, out_idx, out_shape) -> np array for ONE layer (or the
@@ -348,6 +346,24 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     if mt in ("llama", "mistral"):
         from .llama import LlamaConfig
 
+        # Refuse architecture-affecting knobs this family doesn't implement:
+        # loading would succeed but every forward pass would silently diverge
+        # from transformers' output — the opposite of the parity contract.
+        if config.get("rope_scaling") is not None:
+            raise ValueError(
+                "This checkpoint uses rope_scaling "
+                f"({config['rope_scaling'].get('rope_type') or config['rope_scaling'].get('type')!r}), "
+                "which the llama family here does not implement yet; logits "
+                "would silently diverge from the original model. Use a "
+                "non-rope-scaled checkpoint (e.g. Llama-3.0-style)."
+            )
+        if config.get("sliding_window"):
+            raise ValueError(
+                "This checkpoint uses sliding-window attention "
+                f"(window={config['sliding_window']}), which this llama "
+                "family does not implement; logits would silently diverge."
+            )
+
         return "llama", LlamaConfig(
             vocab_size=config["vocab_size"],
             d_model=config["hidden_size"],
@@ -479,9 +495,9 @@ def load_hf_checkpoint(
 ) -> Params:
     """Stream an HF-named checkpoint into sharded device buffers per
     ``plan`` using the built-in family map (the key-mapped sibling of
-    `load_checkpoint_and_dispatch`)."""
-    from ..big_modeling import _open_source
-    from ..parallel.sharding import _path_str
+    `load_checkpoint_and_dispatch`; both ride
+    `big_modeling.dispatch_leaves`)."""
+    from ..big_modeling import _open_source, dispatch_leaves
 
     specs_map = hf_key_specs(family, config)
     source = _open_source(path)
@@ -508,63 +524,46 @@ def load_hf_checkpoint(
             f"({'ambiguous: ' + str(cands) if cands else 'no suffix match'})."
         )
 
-    mesh = plan.mesh
-    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
-    spec_leaves = jax.tree.leaves(
-        plan.specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
-    )
-    out = []
+    def make_fetch(plan_key: str, leaf: Any):
+        # Plan paths are '/'-joined; the maps here use '.' (HF style).
+        key = plan_key.replace("/", ".")
+        if key not in specs_map:
+            raise KeyError(
+                f"No HF mapping for model leaf {key!r} (family "
+                f"{family!r}). Mapped leaves: {sorted(specs_map)}"
+            )
+        src = specs_map[key]
+        # Resolve every needed tensor up front so a truncated repo (config
+        # promising more layers than the weights hold) fails loudly before
+        # any device allocation.
+        if src.per_layer:
+            for i in range(int(leaf.shape[0])):
+                resolve(src.key.format(i=i))
+        else:
+            resolve(src.key)
+        shape = tuple(leaf.shape)
+
+        def fetch_host(idx: tuple, _src=src, _shape=shape) -> np.ndarray:
+            idx = _norm_idx(idx, _shape)
+            if _src.per_layer:
+                layers = idx[0]
+                sub_idx, sub_shape = idx[1:], _shape[1:]
+                planes = []
+                for i in range(layers.start, layers.stop):
+                    k = resolve(_src.key.format(i=i))
+                    read = lambda s_idx, _k=k: np.asarray(
+                        source.read_slice(_k, tuple(s_idx))
+                    )
+                    planes.append(_src.fetch(read, sub_idx, sub_shape))
+                return np.stack(planes)
+            read = lambda s_idx: np.asarray(
+                source.read_slice(resolve(_src.key), tuple(s_idx))
+            )
+            return _src.fetch(read, idx, _shape)
+
+        return fetch_host
+
     try:
-        for (leaf_path, leaf), spec in zip(flat, spec_leaves):
-            # Plan paths are '/'-joined; the maps here use '.' (HF style).
-            plan_key = _path_str(leaf_path)
-            key = plan_key.replace("/", ".")
-            if key not in specs_map:
-                raise KeyError(
-                    f"No HF mapping for model leaf {key!r} (family "
-                    f"{family!r}). Mapped leaves: {sorted(specs_map)}"
-                )
-            src = specs_map[key]
-            # Resolve every needed tensor up front so a truncated repo
-            # (config promising more layers than the weights hold) fails
-            # loudly before any device allocation.
-            if src.per_layer:
-                n_layers = int(leaf.shape[0])
-                for i in range(n_layers):
-                    resolve(src.key.format(i=i))
-            else:
-                resolve(src.key)
-            shape = tuple(leaf.shape)
-            target_dtype = np.dtype(dtype) if dtype is not None else np.dtype(leaf.dtype)
-
-            def fetch_host(idx: tuple, _src=src, _shape=shape) -> np.ndarray:
-                idx = _norm_idx(idx, _shape)
-                if _src.per_layer:
-                    layers = idx[0]
-                    sub_idx, sub_shape = idx[1:], _shape[1:]
-                    planes = []
-                    for i in range(layers.start, layers.stop):
-                        k = resolve(_src.key.format(i=i))
-                        read = lambda s_idx, _k=k: np.asarray(
-                            source.read_slice(_k, tuple(s_idx))
-                        )
-                        planes.append(_src.fetch(read, sub_idx, sub_shape))
-                    return np.stack(planes)
-                read = lambda s_idx: np.asarray(
-                    source.read_slice(resolve(_src.key), tuple(s_idx))
-                )
-                return _src.fetch(read, idx, _shape)
-
-            if plan_key in plan.offload:
-                full = fetch_host(tuple(slice(0, d) for d in shape))
-                out.append(np.asarray(full, dtype=target_dtype))
-                continue
-            sharding = NamedSharding(mesh, spec)
-
-            def fetch_device(idx, _f=fetch_host, _dt=target_dtype):
-                return np.asarray(_f(idx), dtype=_dt)
-
-            out.append(jax.make_array_from_callback(shape, sharding, fetch_device))
+        return dispatch_leaves(shapes, plan, make_fetch, dtype=dtype)
     finally:
         source.close()
-    return jax.tree_util.tree_unflatten(treedef, out)

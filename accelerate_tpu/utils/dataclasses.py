@@ -184,7 +184,13 @@ class DataLoaderConfiguration:
     Two reference knobs intentionally have no analog here: samplers are
     always deterministic-seedable (`use_seedable_sampler` is permanently on
     by construction, `data/sampler.py`), and host->device prefetch is always
-    asynchronous (`non_blocking`)."""
+    asynchronous (`non_blocking`).
+
+    ``dispatch_batches=None`` resolves per dataset kind exactly like the
+    reference (`data_loader.py:1085-1089`): False for indexable datasets
+    (the seeded sampler guarantees identical shards), True for iterable
+    datasets (per-process streams may diverge; the main process reads and
+    broadcasts)."""
 
     split_batches: bool = False
     dispatch_batches: bool | None = None

@@ -172,6 +172,67 @@ def check_dispatch_loader(ps: ProcessState) -> None:
     assert len(flat) >= 24
 
 
+def check_iterable_dispatch(ps: ProcessState) -> None:
+    """Iterable datasets default to dispatch mode (reference
+    `data_loader.py:1085-1089`): per-process streams may diverge, so rank 0's
+    stream is authoritative. A rank-dependent stream proves it: every rank
+    must observe rank 0's values. Then shard mode (explicit
+    dispatch_batches=False) with ATX_DEBUG_MODE must catch the divergence."""
+    from accelerate_tpu.ops.collectives import DistributedOperationException
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    class DivergentStream:
+        """Yields values offset by the process index — a stand-in for any
+        unseeded/network-backed stream that differs per process."""
+
+        def __iter__(self):
+            base = ps.process_index * 1000
+            for i in range(8):
+                yield {"x": np.float32([base + i])}
+
+    # Default config: dispatch_batches=None -> True for iterables.
+    loader = atx.DataLoader(
+        DivergentStream(), batch_size=2, config=DataLoaderConfiguration(prefetch_size=0)
+    )
+    got = []
+    for batch in loader:
+        x = batch["x"]
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            local = np.concatenate(
+                [np.asarray(s.data).ravel() for s in x.addressable_shards]
+            )
+        else:
+            local = np.asarray(x).ravel()
+        got.extend(local.tolist())
+    # Rank 0's stream is [0..7]; each rank holds its own SHARD of the global
+    # batch, so no rank may see values >= 1000 (its own divergent stream) and
+    # the union across ranks must reproduce rank 0's stream exactly.
+    assert got and all(v < 1000 for v in got), got
+    all_got = ops.gather_object([got])
+    union = sorted(v for g in all_got for v in g)
+    assert union == [float(i) for i in range(8)], union
+
+    # Shard mode + debug: the first-batch digest check must fire on the
+    # divergent stream with actionable guidance.
+    old_debug = ps.debug
+    ps.debug = True
+    try:
+        loader = atx.DataLoader(
+            DivergentStream(),
+            batch_size=2,
+            config=DataLoaderConfiguration(dispatch_batches=False, prefetch_size=0),
+        )
+        try:
+            next(iter(loader))
+        except DistributedOperationException as e:
+            assert "DIVERGE" in str(e)
+        else:
+            raise AssertionError("divergent shard-mode stream not detected")
+    finally:
+        ps.debug = old_debug
+    ps.wait_for_everyone()
+
+
 def check_gather_for_metrics(
     ps: ProcessState, acc: "atx.Accelerator", state: "atx.TrainState"
 ) -> None:
@@ -217,6 +278,7 @@ def main() -> int:
     check_object_channel(ps)
     check_split_between_processes(ps)
     check_dispatch_loader(ps)
+    check_iterable_dispatch(ps)
     if args.ckpt_dir:
         acc, trained_state = check_training_and_checkpoint(ps, args.ckpt_dir)
         check_gather_for_metrics(ps, acc, trained_state)
