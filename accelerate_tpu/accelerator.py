@@ -359,7 +359,9 @@ class Accelerator:
         """fp16 compute requires a dynamic loss scaler (fp16's 5-bit exponent
         underflows real gradients); bf16/fp32 need none."""
         if self.policy.compute_dtype == jnp.float16:
-            return DynamicLossScale.create()
+            return jax.device_put(
+                DynamicLossScale.create(), NamedSharding(self.mesh, PartitionSpec())
+            )
         return None
 
     def create_train_state(
@@ -389,8 +391,12 @@ class Accelerator:
             params = shard_pytree(init_fn, param_specs, self.mesh)
         opt_sh = to_named_shardings(opt_specs, self.mesh)
         opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+        # The step counter must be mesh-replicated like every other scalar in
+        # the state: a single-device scalar here gives the first jitted step
+        # a different input layout than every later one (one wasted compile).
+        replicated = NamedSharding(self.mesh, PartitionSpec())
         return TrainState(
-            step=jnp.zeros((), jnp.int32),
+            step=jax.device_put(jnp.zeros((), jnp.int32), replicated),
             params=params,
             opt_state=opt_state,
             apply_fn=apply_fn,
@@ -405,7 +411,16 @@ class Accelerator:
         loss_scale = state.loss_scale
         if loss_scale is None:
             loss_scale = self._maybe_loss_scale()
+        else:
+            # A restored scaler may carry single-device layout; replicate it
+            # like every other state scalar or the first step recompiles.
+            loss_scale = jax.device_put(
+                loss_scale, NamedSharding(self.mesh, PartitionSpec())
+            )
         return state.replace(
+            step=jax.device_put(
+                state.step, NamedSharding(self.mesh, PartitionSpec())
+            ),
             params=shard_pytree(state.params, param_specs, self.mesh),
             opt_state=shard_pytree(state.opt_state, opt_specs, self.mesh),
             loss_scale=loss_scale,
@@ -481,6 +496,27 @@ class Accelerator:
         policy = self.policy
         max_grad_norm = self.max_grad_norm
         use_scaler = policy.compute_dtype == jnp.float16
+        # Capture the planned specs NOW (create_train_state time), not at
+        # trace time: a later create_train_state for a second model would
+        # overwrite self._param_specs and this step would pin the wrong
+        # layout (or crash on tree mismatch) when it finally traces.
+        planned_param_specs = getattr(self, "_param_specs", None)
+        planned_opt_specs = getattr(self, "_opt_specs", None)
+
+        def _pin(tree: Any, spec_tree: Any) -> Any:
+            """Constrain `tree` to its planned shardings; skipped when no
+            plan exists or the structures disagree (a state this step was
+            not planned for)."""
+            if spec_tree is None:
+                return tree
+            is_spec = lambda x: isinstance(x, PartitionSpec)
+            if jax.tree.structure(tree) != jax.tree.structure(spec_tree, is_leaf=is_spec):
+                return tree
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint,
+                tree,
+                to_named_shardings(spec_tree, self.mesh),
+            )
 
         def compute_loss(params: Any, batch: Any, rng: jax.Array, scale: jax.Array):
             cparams = policy.cast_for_compute(params)
@@ -598,6 +634,15 @@ class Accelerator:
                 )
                 metrics["loss_scale"] = new_scale
                 metrics["grads_finite"] = finite
+            # Pin the updated params/opt-state to their PLANNED shardings.
+            # Without this, jit is free to return them in whatever layout the
+            # partitioner found cheapest for this program (e.g. ZERO1's
+            # sharded-update output params came back sharded instead of
+            # replicated) — which silently changes the strategy's memory
+            # story AND forces a recompile when the state round-trips into
+            # the next step with a different input layout.
+            new_params = _pin(new_params, planned_param_specs)
+            new_opt_state = _pin(new_opt_state, planned_opt_specs)
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,

@@ -256,41 +256,68 @@ class DataLoader:
         if dispatch and self.state.num_processes > 1:
             from ..ops.collectives import broadcast_object_list
 
+            # Message protocol: ("batch", b) per batch, then exactly one
+            # terminal ("end", None) on clean exhaustion or ("error", repr)
+            # when the main rank's stream raises mid-epoch (workers re-raise,
+            # keeping all ranks convergent instead of silently finishing a
+            # failed epoch). An early consumer `break` is SPMD-symmetric —
+            # every rank stops consuming at the same step, so no terminal
+            # message is sent (a sentinel then would itself be the unmatched
+            # collective).
             if self.state.is_main_process:
-                # The end-of-stream sentinel must go out on EVERY exit path —
-                # a stream that raises mid-epoch (the motivating network-
-                # reader case) or an early consumer break would otherwise
-                # leave the worker ranks blocked in broadcast forever.
                 try:
                     for collated in it:
-                        broadcast_object_list([(True, collated)])
+                        broadcast_object_list([("batch", collated)])
                         yield collated
-                finally:
-                    broadcast_object_list([(False, None)])
+                except GeneratorExit:
+                    raise
+                except BaseException as e:
+                    broadcast_object_list([("error", repr(e))])
+                    raise
+                else:
+                    broadcast_object_list([("end", None)])
             else:
                 while True:
-                    more, collated = broadcast_object_list([None])[0]
-                    if not more:
+                    kind, payload = broadcast_object_list([None])[0]
+                    if kind == "end":
                         return
-                    yield collated
+                    if kind == "error":
+                        raise RuntimeError(
+                            f"Main process's iterable dataset stream failed "
+                            f"mid-epoch: {payload}"
+                        )
+                    yield payload
             return
-        checked = dispatch or self.state.num_processes == 1 or not self.state.debug
-        for collated in it:
-            if not checked:
-                checked = True
-                self._verify_shard_stream(collated)
-            yield collated
+        if dispatch or self.state.num_processes == 1 or not self.state.debug:
+            yield from it
+            return
+        # Debug shard mode: digest-compare the first batch on EVERY rank —
+        # including ranks whose divergent stream yields nothing (an empty
+        # digest is itself a divergence the collective check must see, not a
+        # silent skip that would deadlock the other ranks' gather).
+        first = next(it, _SENTINEL)
+        self._verify_shard_stream(None if first is _SENTINEL else first)
+        if first is _SENTINEL:
+            return
+        yield first
+        yield from it
 
     def _verify_shard_stream(self, collated: Any) -> None:
-        """Debug-mode digest check: shard-mode iterable streams must agree."""
+        """Debug-mode digest check: shard-mode iterable streams must agree.
+        ``collated=None`` means this rank's stream was empty — still a digest
+        (streams of different lengths diverge too)."""
         import hashlib
 
         from ..ops.collectives import DistributedOperationException, gather_object
 
-        md5 = hashlib.md5()
-        for leaf in jax.tree.leaves(collated):
-            md5.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
-        digests = gather_object([md5.hexdigest()])
+        if collated is None:
+            digest = "<empty stream>"
+        else:
+            md5 = hashlib.md5()
+            for leaf in jax.tree.leaves(collated):
+                md5.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+            digest = md5.hexdigest()
+        digests = gather_object([digest])
         if len(set(digests)) > 1:
             raise DistributedOperationException(
                 "Iterable dataset streams DIVERGE across processes in shard "

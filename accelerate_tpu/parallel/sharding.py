@@ -196,9 +196,29 @@ def infer_opt_specs(
     return jax.tree.map(map_subtree, opt_state_shapes, is_leaf=is_params_like)
 
 
+def canonicalize_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Normalize a spec to the form XLA hands back: size-1 mesh axes shard
+    nothing (drop them) and trailing ``None`` entries are implicit. Without
+    this, a planned ``P(('data','fsdp'), None)`` on an fsdp=1 mesh and the
+    ``P('data')`` XLA returns for it compare unequal, so a train step whose
+    output constraint uses the planned form recompiles when the state round
+    -trips into the next call."""
+    entries: list[Any] = []
+    for e in spec:
+        if e is None:
+            entries.append(None)
+            continue
+        axes = (e,) if isinstance(e, str) else tuple(e)
+        axes = tuple(a for a in axes if mesh.shape[a] > 1)
+        entries.append(None if not axes else (axes[0] if len(axes) == 1 else axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
 def to_named_shardings(spec_tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
+        lambda s: NamedSharding(mesh, canonicalize_spec(s, mesh)),
         spec_tree,
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )
