@@ -38,6 +38,10 @@ class LaunchConfig:
     mixed_precision: str = "bf16"
     sharding_strategy: str = "DATA_PARALLEL"
     gradient_accumulation_steps: int = 1
+    # Relaunch the whole worker group (fresh coordinator port) up to this
+    # many times after a worker death — the torch-elastic max_restarts analog
+    # (reference `commands/launch.py:142-771`). 0 = fail on first death.
+    max_restarts: int = 0
     # TPU pod orchestration (reference tpu_pod_launcher, commands/launch.py:909)
     tpu_name: str = ""
     tpu_zone: str = ""

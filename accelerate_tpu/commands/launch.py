@@ -64,6 +64,14 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="Simulate N CPU devices per process (sets "
         "--xla_force_host_platform_device_count; testing without TPUs)",
     )
+    p.add_argument(
+        "--max_restarts",
+        type=int,
+        default=None,
+        help="Relaunch the worker group (fresh coordinator port) up to N "
+        "times after a worker death (torch-elastic max_restarts analog); "
+        "default 0 = fail on first death",
+    )
     p.add_argument("--dry_run", action="store_true", help="Print commands, don't run")
     p.add_argument("script", help="Training script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER, help="Script arguments")
@@ -92,6 +100,7 @@ def _merge_config(args: argparse.Namespace) -> LaunchConfig:
         "tpu_name": args.tpu_name,
         "tpu_zone": args.tpu_zone,
         "tpu_project": args.tpu_project,
+        "max_restarts": args.max_restarts,
     }
     for key, value in overrides.items():
         if value is not None:
@@ -134,17 +143,19 @@ def build_child_env(
     return env
 
 
-def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
-    """Spawn num_processes children on this machine (rendezvous over
-    localhost) — the CPU-simulation / single-host-multi-proc path that the
-    reference covers with its gloo `debug_launcher` (`launchers.py:268`)."""
-    if not cfg.coordinator_address:
-        cfg.coordinator_address = f"127.0.0.1:{cfg.coordinator_port}"
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
+    """Spawn one group of num_processes children and babysit it: first
+    worker death tears the whole group down (the reference relies on
+    torch-elastic for this; here the launcher owns it)."""
     procs: list[subprocess.Popen] = []
-    if args.dry_run:
-        for i in range(cfg.num_processes):
-            print(f"[proc {i}] {' '.join(shlex.quote(c) for c in cmd)}")
-        return 0
     try:
         for i in range(cfg.num_processes):
             env = build_child_env(cfg, i, host_devices=args.host_devices)
@@ -156,10 +167,11 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
                 if ret is None:
                     continue
                 procs.remove(p)
-                if ret != 0:
+                if ret != 0 and exit_code == 0:
+                    # Keep the FIRST failure's code: the peers reaped after
+                    # the teardown die with -SIGTERM, which would mask the
+                    # root cause in the restart log and the final status.
                     exit_code = ret
-                    # One worker died: tear the job down (the reference relies
-                    # on torch-elastic for this; here the launcher owns it).
                     for q in procs:
                         q.send_signal(signal.SIGTERM)
             if procs:
@@ -168,6 +180,45 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     finally:
         for p in procs:
             p.kill()
+
+
+def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
+    """Spawn num_processes children on this machine (rendezvous over
+    localhost) — the CPU-simulation / single-host-multi-proc path that the
+    reference covers with its gloo `debug_launcher` (`launchers.py:268`).
+
+    With ``max_restarts > 0``, a dead worker group is relaunched whole, on a
+    FRESH coordinator port (the old rendezvous may linger in TIME_WAIT /
+    stale `jax.distributed` state), up to the limit — the torch-elastic
+    restart policy the reference forwards (`commands/launch.py:142-771`).
+    Restarted scripts resume from their own checkpoints exactly as they
+    would under torch-elastic.
+    """
+    if args.dry_run:
+        for i in range(cfg.num_processes):
+            print(f"[proc {i}] {' '.join(shlex.quote(c) for c in cmd)}")
+        return 0
+    pinned_address = cfg.coordinator_address  # user-supplied: reuse as-is
+    exit_code = 0
+    for attempt in range(cfg.max_restarts + 1):
+        if pinned_address:
+            cfg.coordinator_address = pinned_address
+        elif attempt == 0:
+            cfg.coordinator_address = f"127.0.0.1:{cfg.coordinator_port}"
+        else:
+            cfg.coordinator_address = f"127.0.0.1:{_free_port()}"
+        exit_code = _run_worker_group(cfg, cmd, args)
+        if exit_code == 0:
+            return 0
+        if attempt < cfg.max_restarts:
+            print(
+                f"[accelerate-tpu launch] worker group failed (exit "
+                f"{exit_code}); restarting group "
+                f"({attempt + 1}/{cfg.max_restarts})",
+                file=sys.stderr,
+                flush=True,
+            )
+    return exit_code
 
 
 def build_tpu_ssh_command(
@@ -193,7 +244,10 @@ def build_tpu_ssh_command(
 
 def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     """Run the training command on every pod worker via gcloud SSH
-    (reference `tpu_pod_launcher`, `commands/launch.py:909`)."""
+    (reference `tpu_pod_launcher`, `commands/launch.py:909`). A nonzero pod
+    run is retried up to ``max_restarts`` times (same elastic policy as the
+    local group path; the pod re-rendezvouses through TPU metadata, so no
+    port rotation is needed)."""
     env_exports = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in build_child_env(cfg, None, base={}).items()
@@ -203,7 +257,19 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in gcloud))
         return 0
-    return subprocess.call(gcloud)
+    exit_code = 0
+    for attempt in range(cfg.max_restarts + 1):
+        exit_code = subprocess.call(gcloud)
+        if exit_code == 0:
+            return 0
+        if attempt < cfg.max_restarts:
+            print(
+                f"[accelerate-tpu launch] pod run failed (exit {exit_code}); "
+                f"restarting ({attempt + 1}/{cfg.max_restarts})",
+                file=sys.stderr,
+                flush=True,
+            )
+    return exit_code
 
 
 def run(args: argparse.Namespace) -> int:
@@ -215,6 +281,13 @@ def run(args: argparse.Namespace) -> int:
     if cfg.num_processes > 1:
         return _local_multiprocess_launch(cfg, cmd, args)
     # Single host process: exec in place with the env contract.
+    if cfg.max_restarts:
+        print(
+            "[accelerate-tpu launch] --max_restarts applies to worker groups "
+            "(num_processes > 1 or pod launches); a single exec'd process is "
+            "not restarted.",
+            file=sys.stderr,
+        )
     env = build_child_env(cfg, None, host_devices=args.host_devices)
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in cmd))

@@ -139,3 +139,50 @@ class TestDiagnostic:
         from accelerate_tpu.test_utils import diagnostic
 
         assert diagnostic.main() == 0
+
+
+def test_max_restarts_recovers_crashed_group(tmp_path):
+    """A rank crashes on the first group attempt; --max_restarts relaunches
+    the whole group on a fresh coordinator port and the job completes
+    (the torch-elastic restart analog, reference commands/launch.py:142-771)."""
+    from tests.launch_helpers import REPO_ROOT, clean_env
+
+    marker = str(tmp_path / "crashed_once")
+    script = os.path.join(REPO_ROOT, "tests", "scripts", "crash_once.py")
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+        "--num_processes", "2", "--host_devices", "1",
+        "--max_restarts", "2", "--mixed_precision", "no",
+        script, marker,
+    ]
+    proc = subprocess.run(
+        cmd, cwd=REPO_ROOT, env=clean_env(), capture_output=True, text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+    assert "CRASHING ONCE" in proc.stdout
+    assert "restarting group (1/2)" in proc.stderr
+    for rank in range(2):
+        assert f"[proc {rank}] RESTART OK" in proc.stdout, proc.stdout
+    assert os.path.exists(marker)
+
+
+def test_max_restarts_exhausted_fails(tmp_path):
+    """A persistently-crashing rank exhausts the restart budget and the
+    launcher reports the failure exit code."""
+    from tests.launch_helpers import REPO_ROOT, clean_env
+
+    script = os.path.join(REPO_ROOT, "tests", "scripts", "crash_once.py")
+    # Point the marker at an uncreatable path so rank 1 crashes every time.
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+        "--num_processes", "2", "--host_devices", "1",
+        "--max_restarts", "1", "--mixed_precision", "no",
+        script, "/dev/null/nope/marker",
+    ]
+    proc = subprocess.run(
+        cmd, cwd=REPO_ROOT, env=clean_env(), capture_output=True, text=True,
+        timeout=240,
+    )
+    assert proc.returncode != 0
+    assert "restarting group (1/1)" in proc.stderr
