@@ -1,0 +1,20 @@
+# Test lanes (the reference splits CI the same way, Makefile:25-60).
+#
+#   make test        - fast lane: skips tests marked `heavy` (< ~5 min)
+#   make test-heavy  - ONLY the heavy lane (compile-heavy, subprocess launches)
+#   make test-all    - everything
+#
+# The heavy marker lives on whole files (attention kernels, model-zoo
+# forward parity, HF interop, HLO verification, examples, CLI/multiprocess
+# launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
+
+.PHONY: test test-heavy test-all
+
+test:
+	python -m pytest tests/ -q
+
+test-heavy:
+	python -m pytest tests/ -q -m heavy
+
+test-all:
+	python -m pytest tests/ -q --heavy

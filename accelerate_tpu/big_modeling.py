@@ -372,6 +372,7 @@ def dispatch_leaves(
     make_fetch: Callable[[str, Any], Callable[[tuple], np.ndarray]],
     *,
     dtype: Any | None = None,
+    leaf_override: Callable[[str, Any, Callable], Any] | None = None,
 ) -> Any:
     """Shared streaming-dispatch core: for each leaf of ``shapes``,
     ``make_fetch(plan_key, leaf)`` returns a host-side callback mapping a
@@ -379,7 +380,11 @@ def dispatch_leaves(
     `jax.make_array_from_callback` (each device pulls exactly its planned
     slice), ``plan.offload`` leaves come back as full host numpy arrays.
     Both `load_checkpoint_and_dispatch` and the HF-named streaming loader
-    (`models/hf.py`) ride this loop."""
+    (`models/hf.py`) ride this loop.
+
+    ``leaf_override(plan_key, leaf, fetch)`` may return a replacement for a
+    leaf (already placed however it likes — the quantize-on-load hook) or
+    None to take the normal path."""
     mesh = plan.mesh
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
     spec_leaves = jax.tree.leaves(
@@ -391,6 +396,11 @@ def dispatch_leaves(
         shape = tuple(leaf.shape)
         target_dtype = np.dtype(dtype) if dtype is not None else np.dtype(leaf.dtype)
         fetch = make_fetch(key, leaf)
+        if leaf_override is not None:
+            replaced = leaf_override(key, leaf, fetch)
+            if replaced is not None:
+                out.append(replaced)
+                continue
         if key in plan.offload:
             full = fetch(tuple(slice(0, d) for d in shape))
             out.append(np.asarray(full, dtype=target_dtype))

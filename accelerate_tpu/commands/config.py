@@ -123,6 +123,13 @@ def interactive_config() -> LaunchConfig:
         "FSDP" if cfg.mesh_fsdp > 1 else "DATA_PARALLEL",
     ).upper()
     cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
+    if cfg.mixed_precision == "fp8":
+        print(
+            "  NOTE: fp8 only pays off on chips with native fp8 MXU support; "
+            "on other hardware (e.g. TPU v5e) XLA upcasts the fp8 values — "
+            "you keep the quantization error and get NO speedup. Check "
+            "`bench.py`'s fp8_matmul_speedup field on your chip first."
+        )
     cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
     if _ask("Launching on a GCE TPU pod via gcloud? (y/n)", "n").lower().startswith("y"):
         cfg.tpu_name = _ask("TPU name", "")

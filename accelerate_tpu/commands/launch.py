@@ -275,6 +275,14 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
 def run(args: argparse.Namespace) -> int:
     cfg = _merge_config(args)
     cmd = [sys.executable, args.script, *args.script_args]
+    if cfg.mixed_precision == "fp8":
+        print(
+            "[accelerate-tpu launch] fp8 selected: only beneficial on chips "
+            "with native fp8 MXU support; elsewhere XLA upcasts the values — "
+            "quantization error with no speedup (see bench.py "
+            "fp8_matmul_speedup).",
+            file=sys.stderr,
+        )
 
     if cfg.tpu_name:
         return _tpu_pod_launch(cfg, cmd, args)

@@ -118,6 +118,10 @@ class DataLoader:
         )
         self._epoch = 0
         self._batches_yielded = 0
+        # batch index -> stream state for stateful iterable datasets (kept
+        # only for the window the prefetch thread can run ahead).
+        self._dataset_states: dict[int, Any] = {}
+        self._stateful_resume_offset = 0
         self.end_of_dataloader = False
         self._rebind(mesh, self.config)
 
@@ -213,16 +217,33 @@ class DataLoader:
             yield from self._iterable_host_batches()
 
     def _iterable_collated(self) -> Iterator[Any]:
-        """Collated batches straight off the iterable dataset's stream."""
+        """Collated batches straight off the iterable dataset's stream.
+
+        Stateful streams (``dataset.state_dict`` — the torchdata protocol,
+        reference `data_loader.py:413-497`): the state is snapshotted at
+        every batch boundary, keyed by the batch index it resumes AT, so a
+        checkpoint taken while the prefetch thread runs ahead still pairs
+        the consumer-visible position with the right stream state."""
+        stateful = hasattr(self.dataset, "state_dict")
+        # A stateful resume continues mid-stream: batch indices (and the
+        # states recorded under them) continue from the restored offset so
+        # they stay aligned with `_batches_yielded`.
+        produced = self._stateful_resume_offset
         buf: list[Any] = []
         first: list[Any] | None = None
-        for element in self.dataset:
+        if stateful:
+            self._record_dataset_state(produced)
+        it = iter(self.dataset)
+        for element in it:
             buf.append(element)
             if len(buf) == self.total_batch_size:
                 if first is None:
                     first = list(buf)
                 yield self.collate_fn(buf)
                 buf = []
+                produced += 1
+                if stateful:
+                    self._record_dataset_state(produced)
         if buf and not self.drop_last:
             if first is None:
                 first = list(buf)
@@ -382,7 +403,7 @@ class DataLoader:
         self.begin()
         # Position within the epoch includes batches skipped on resume, so a
         # checkpoint taken later in the resumed epoch records the true offset.
-        self._batches_yielded = self.skip_batches
+        self._batches_yielded = self.skip_batches + self._stateful_resume_offset
         stop = threading.Event()
         it = self._device_batches()
         if self.config.prefetch_size > 0:
@@ -430,6 +451,8 @@ class DataLoader:
         self._epoch += 1
         self._batches_yielded = 0
         self.skip_batches = 0
+        self._stateful_resume_offset = 0
+        self._dataset_states.clear()
         if self.sampler is not None:
             self.sampler.set_epoch(self._epoch)
 
@@ -442,16 +465,50 @@ class DataLoader:
         self.gradient_state._remove_dataloader(self)
 
     # ---------------------------------------------------------------- resume
+    def _record_dataset_state(self, batch_idx: int) -> None:
+        self._dataset_states[batch_idx] = self.dataset.state_dict()
+        # Keep only the lookahead window the prefetch thread can create.
+        horizon = batch_idx - (self.config.prefetch_size + 2)
+        for k in [k for k in self._dataset_states if k < horizon]:
+            del self._dataset_states[k]
+
     def state_dict(self) -> dict[str, Any]:
-        return {
+        state: dict[str, Any] = {
             "epoch": self._epoch,
             "batches_yielded": self._batches_yielded,
             "seed": getattr(self.sampler, "seed", None),
         }
+        ds_state = self._dataset_states.get(self._batches_yielded)
+        if ds_state is not None:
+            # The stream's own position (torchdata Stateful protocol,
+            # reference `data_loader.py:413-497`): base64-pickled so the
+            # checkpoint stays one JSON document.
+            import base64
+            import pickle
+
+            state["dataset"] = base64.b64encode(pickle.dumps(ds_state)).decode()
+        return state
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
         self._epoch = int(state.get("epoch", 0))
-        self.skip_batches = int(state.get("batches_yielded", 0))
+        ds_state = state.get("dataset")
+        if ds_state is not None and hasattr(self.dataset, "load_state_dict"):
+            import base64
+            import pickle
+
+            restored = pickle.loads(base64.b64decode(ds_state))
+            self.dataset.load_state_dict(restored)
+            # Position restored NATIVELY in the stream — replay-skipping on
+            # top of it would drop batches twice.
+            self.skip_batches = 0
+            self._stateful_resume_offset = int(state.get("batches_yielded", 0))
+            # A checkpoint taken right after restore (before any iteration)
+            # must reproduce THIS position, not report batch 0 of a fresh
+            # epoch — seed the bookkeeping as if we had just yielded here.
+            self._batches_yielded = self._stateful_resume_offset
+            self._dataset_states = {self._stateful_resume_offset: restored}
+        else:
+            self.skip_batches = int(state.get("batches_yielded", 0))
         if self.sampler is not None:
             self.sampler.set_epoch(self._epoch)
 
@@ -495,5 +552,6 @@ def skip_first_batches(dataloader: DataLoader, num_batches: int = 0) -> DataLoad
         new.sampler = copy.copy(dataloader.sampler)
     new.skip_batches = num_batches
     new._batches_yielded = 0
+    new._dataset_states = dict(dataloader._dataset_states)
     new.end_of_dataloader = False
     return new

@@ -81,7 +81,12 @@ class TorchDatasetAdapter:
 
 class TorchIterableAdapter:
     """Iterable view over a torch IterableDataset with numpy samples (the
-    framework loader's iterable path batches it)."""
+    framework loader's iterable path batches it).
+
+    Stateful streams (the torchdata `Stateful` protocol — `state_dict` /
+    `load_state_dict` on the dataset, reference `data_loader.py:413-497`)
+    are proxied through, so the framework loader checkpoints the stream
+    position natively instead of replay-skipping."""
 
     def __init__(self, dataset: Any) -> None:
         self.dataset = dataset
@@ -89,6 +94,13 @@ class TorchIterableAdapter:
     def __iter__(self):
         for sample in self.dataset:
             yield to_numpy(sample)
+
+    def __getattr__(self, name: str):
+        if name in ("state_dict", "load_state_dict") and hasattr(
+            self.dataset, name
+        ):
+            return getattr(self.dataset, name)
+        raise AttributeError(name)
 
 
 def unwrap_torch_dataloader(loader: Any, *, has_user_collate: bool = False) -> dict[str, Any]:

@@ -443,6 +443,7 @@ def load_pretrained(
     rules: Any = None,
     min_weight_size: int = 2**11,
     no_offload_patterns=(),
+    quantize_bits: int | None = None,
 ) -> PretrainedModel:
     """One-call HF repo ingestion: ``config.json`` -> family config, plan
     shardings, stream weights (reference `load_checkpoint_and_dispatch`
@@ -455,6 +456,15 @@ def load_pretrained(
     params land sharded over whatever mesh axes exist — pass ``rules=()``
     explicitly to replicate instead. Leaves the plan offloads stay
     host-resident numpy, ready for `streamed_scan`.
+
+    ``quantize_bits=8|4`` quantizes the big matmul weights ON THE WAY IN
+    (the `load_and_quantize_model` analog, reference `utils/bnb.py`): each
+    leaf is streamed to host, packed to int8/int4 with per-channel scales
+    there, and only the packed values reach HBM — an 8B bf16 repo loads
+    into ≈8/4 GiB of device memory without the full-precision weights ever
+    being resident. Embeddings/norms/heads stay full precision
+    (`utils/quantization.DEFAULT_SKIP_PATTERNS`); the model families
+    dequantize per layer inside their scan.
     """
     from .. import models
     from ..big_modeling import infer_sharding_plan
@@ -480,8 +490,71 @@ def load_pretrained(
         no_offload_patterns=no_offload_patterns,
         min_weight_size=min_weight_size,
     )
-    params = load_hf_checkpoint(shapes, path, plan, family=family, config=config, dtype=dtype)
+    params = load_hf_checkpoint(
+        shapes, path, plan, family=family, config=config, dtype=dtype,
+        quantize_bits=quantize_bits,
+    )
     return PretrainedModel(family, config, params, plan)
+
+
+def _make_quantize_override(plan, bits):
+    """leaf_override for `dispatch_leaves`: pack eligible weights on the
+    host, ship only int8/int4 + scales to device (specs sanitized to the
+    packed shapes). Stacked leaves quantize ONE stack slice at a time —
+    scales are per-slice, so the result is identical, but the transient
+    host buffer is a single layer's worth instead of 3x the whole leaf.
+    Leaves the plan offloads keep the normal host-resident bf16 path
+    (`streamed_scan` owns their lifecycle)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.sharding import _path_str, _sanitize_spec
+    from ..utils.quantization import leaf_quant_plan, quantize_array_host
+
+    spec_by_key: dict[str, Any] = {}
+
+    def spec_for(key):
+        if not spec_by_key:
+            leaves, _ = jax.tree_util.tree_flatten_with_path(
+                plan.specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+            )
+            for p, s in leaves:
+                spec_by_key[_path_str(p)] = s
+        return spec_by_key[key]
+
+    def quantize_streaming(leaf, fetch, stack):
+        shape = tuple(leaf.shape)
+        if stack is None and leaf.ndim >= 3:
+            stack = 1
+        if not stack:
+            full = fetch(tuple(slice(0, d) for d in shape))
+            return quantize_array_host(np.asarray(full), stack_dims=0, bits=bits)
+        out: dict[str, np.ndarray] = {}
+        for i in range(shape[0]):
+            idx = (slice(i, i + 1),) + tuple(slice(0, d) for d in shape[1:])
+            part = quantize_array_host(
+                np.asarray(fetch(idx)), stack_dims=stack, bits=bits
+            )
+            for name, arr in part.items():
+                if name not in out:
+                    out[name] = np.empty((shape[0],) + arr.shape[1:], arr.dtype)
+                out[name][i] = arr[0]
+        return out
+
+    def override(plan_key, leaf, fetch):
+        if plan_key in plan.offload:
+            return None
+        eligible, stack = leaf_quant_plan(plan_key, tuple(leaf.shape), leaf.dtype)
+        if not eligible:
+            return None
+        packed = quantize_streaming(leaf, fetch, stack)
+        spec = spec_for(plan_key)
+        placed = {}
+        for name, arr in packed.items():
+            s = _sanitize_spec(spec, arr.shape, plan.mesh)
+            placed[name] = jax.device_put(arr, NamedSharding(plan.mesh, s))
+        return placed
+
+    return override
 
 
 def load_hf_checkpoint(
@@ -492,6 +565,7 @@ def load_hf_checkpoint(
     family: str,
     config: Any,
     dtype: Any | None = None,
+    quantize_bits: int | None = None,
 ) -> Params:
     """Stream an HF-named checkpoint into sharded device buffers per
     ``plan`` using the built-in family map (the key-mapped sibling of
@@ -564,6 +638,16 @@ def load_hf_checkpoint(
         return fetch_host
 
     try:
-        return dispatch_leaves(shapes, plan, make_fetch, dtype=dtype)
+        return dispatch_leaves(
+            shapes,
+            plan,
+            make_fetch,
+            dtype=dtype,
+            leaf_override=(
+                _make_quantize_override(plan, quantize_bits)
+                if quantize_bits
+                else None
+            ),
+        )
     finally:
         source.close()

@@ -44,6 +44,28 @@ def is_quantized(x: Any) -> bool:
     return isinstance(x, dict) and (_QUANT_KEY in x or _QUANT4_KEY in x)
 
 
+def _quantize_impl(xp: Any, w32: Any, stack_dims: int | None, bits: int) -> dict[str, Any]:
+    """Shared int8/int4 packing math, parameterized on the array namespace
+    (``jnp`` on device, ``np`` for the host quantize-on-load path) so the
+    two entry points cannot drift apart."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if stack_dims is None:
+        stack_dims = 1 if w32.ndim >= 3 else 0
+    stack_dims = min(stack_dims, max(w32.ndim - 2, 0))
+    reduce_axes = tuple(range(stack_dims, w32.ndim - 1))
+    absmax = xp.max(xp.abs(w32), axis=reduce_axes, keepdims=True)
+    f32 = xp.float32
+    if bits == 4 and w32.shape[-1] % 2 == 0:
+        scale = xp.maximum(absmax, 1e-12) / 7.0
+        q = (xp.clip(xp.round(w32 / scale), -7, 7).astype(xp.int8) + 8).astype(xp.uint8)
+        packed = (q[..., 0::2] << 4) | q[..., 1::2]
+        return {_QUANT4_KEY: packed, "scale": scale.astype(f32)}
+    scale = xp.maximum(absmax, 1e-12) / 127.0
+    q = xp.clip(xp.round(w32 / scale), -127, 127).astype(xp.int8)
+    return {_QUANT_KEY: q, "scale": scale.astype(f32)}
+
+
 def quantize_array(
     w: jax.Array, stack_dims: int | None = None, bits: int = 8
 ) -> dict[str, jax.Array]:
@@ -59,23 +81,57 @@ def quantize_array(
     the sensitive leaves (norms/embeddings/head) excluded by the skip list.
     Falls back to int8 when the output axis is odd (can't pack pairs).
     """
-    if bits not in (4, 8):
-        raise ValueError(f"bits must be 4 or 8, got {bits}")
-    w32 = jnp.asarray(w, jnp.float32)
-    if stack_dims is None:
-        stack_dims = 1 if w32.ndim >= 3 else 0
-    stack_dims = min(stack_dims, max(w32.ndim - 2, 0))
-    reduce_axes = tuple(range(stack_dims, w32.ndim - 1))
-    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
-    if bits == 4 and w32.shape[-1] % 2 == 0:
-        scale = jnp.maximum(absmax, 1e-12) / 7.0
-        q = jnp.clip(jnp.round(w32 / scale), -7, 7).astype(jnp.int8) + 8
-        q = q.astype(jnp.uint8)
-        packed = (q[..., 0::2] << 4) | q[..., 1::2]
-        return {_QUANT4_KEY: packed, "scale": scale.astype(jnp.float32)}
-    scale = jnp.maximum(absmax, 1e-12) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {_QUANT_KEY: q, "scale": scale.astype(jnp.float32)}
+    return _quantize_impl(jnp, jnp.asarray(w, jnp.float32), stack_dims, bits)
+
+
+# Path patterns whose weights carry EXTRA leading stack axes beyond the
+# scan-over-layers one (value = total stack dims). MoE experts are stacked
+# (layer, expert, ...): each expert must keep independent scales.
+DEFAULT_STACK_DIM_PATTERNS: tuple[tuple[str, int], ...] = (
+    (r"moe", 2),
+    (r"expert", 2),
+)
+
+
+def quantize_array_host(
+    w: "np.ndarray", stack_dims: int | None = None, bits: int = 8
+) -> dict[str, "np.ndarray"]:
+    """`quantize_array` semantics in pure numpy on the HOST — the
+    quantize-on-load path streams checkpoint leaves through here so the
+    full-precision tensor never touches HBM (only the packed int8/int4
+    values and scales are device_put). Same `_quantize_impl` math, so it
+    cannot drift from the device version."""
+    import numpy as np
+
+    return _quantize_impl(np, np.asarray(w, dtype=np.float32), stack_dims, bits)
+
+
+def leaf_quant_plan(
+    path_s: str,
+    shape: tuple[int, ...],
+    dtype: Any,
+    *,
+    skip_patterns: tuple[str, ...] = DEFAULT_SKIP_PATTERNS,
+    min_size: int = 4096,
+    stack_dim_patterns: tuple[tuple[str, int], ...] = DEFAULT_STACK_DIM_PATTERNS,
+) -> tuple[bool, int | None]:
+    """Shared eligibility rule for quantization: ``(eligible, stack_dims)``.
+    Used by both `quantize_pytree` (in-memory) and the streaming
+    quantize-on-load path (`models/hf.py`) so the two can't disagree."""
+    import numpy as np
+
+    if any(re.search(pat, path_s) for pat in skip_patterns):
+        return False, None
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return False, None
+    if int(np.prod(shape)) < min_size or len(shape) < 2:
+        return False, None
+    stack = None
+    for pat, dims in stack_dim_patterns:
+        if re.search(pat, path_s) and len(shape) >= dims + 2:
+            stack = dims
+            break
+    return True, stack
 
 
 def dequantize_array(d: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.Array:
@@ -88,13 +144,6 @@ def dequantize_array(d: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.
     return (d[_QUANT_KEY].astype(jnp.float32) * d["scale"]).astype(dtype)
 
 
-# Path patterns whose weights carry EXTRA leading stack axes beyond the
-# scan-over-layers one (value = total stack dims). MoE experts are stacked
-# (layer, expert, ...): each expert must keep independent scales.
-DEFAULT_STACK_DIM_PATTERNS: tuple[tuple[str, int], ...] = (
-    (r"moe", 2),
-    (r"expert", 2),
-)
 
 
 def quantize_pytree(
@@ -117,18 +166,18 @@ def quantize_pytree(
     from ..parallel.sharding import _path_str  # lazy: avoids an import cycle
 
     def visit(path, leaf):
-        path_s = _path_str(path)
-        if any(re.search(pat, path_s) for pat in skip_patterns):
+        if not hasattr(leaf, "dtype"):
             return leaf
-        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+        eligible, stack = leaf_quant_plan(
+            _path_str(path),
+            tuple(leaf.shape),
+            leaf.dtype,
+            skip_patterns=skip_patterns,
+            min_size=min_size,
+            stack_dim_patterns=stack_dim_patterns,
+        )
+        if not eligible:
             return leaf
-        if leaf.size < min_size or leaf.ndim < 2:
-            return leaf
-        stack = None
-        for pat, dims in stack_dim_patterns:
-            if re.search(pat, path_s) and leaf.ndim >= dims + 2:
-                stack = dims
-                break
         return quantize_array(leaf, stack_dims=stack, bits=bits)
 
     return jax.tree_util.tree_map_with_path(visit, tree)

@@ -1,0 +1,73 @@
+"""Feature example: automatic gradient accumulation.
+
+Reference analog: `examples/by_feature/automatic_gradient_accumulation.py` —
+combine `find_executable_batch_size` with gradient accumulation so the
+OBSERVED batch size stays fixed while the per-step microbatch shrinks to
+whatever the chip can hold: each OOM retry halves the executable batch and
+doubles the accumulation steps, training math unchanged.
+
+Run: python examples/by_feature/automatic_gradient_accumulation.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--observed_batch_size", type=int, default=64)
+    parser.add_argument("--fail_below", type=int, default=0,
+                        help="Simulate OOM while the microbatch exceeds this "
+                        "(0 = first size fits; try 16 to watch the halving)")
+    parser.add_argument("--steps", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    attempts: list[int] = []
+
+    @atx.find_executable_batch_size(starting_batch_size=args.observed_batch_size)
+    def train(batch_size: int) -> dict:
+        attempts.append(batch_size)
+        if args.fail_below and batch_size > args.fail_below:
+            # Stand-in for XLA's RESOURCE_EXHAUSTED on a too-large microbatch.
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory (simulated)")
+        accum = args.observed_batch_size // batch_size
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc = atx.Accelerator(seed=0, gradient_accumulation_steps=accum)
+        state = acc.create_train_state(regression_init, optax.sgd(0.05))
+        step = acc.make_train_step(regression_loss)
+        ds = RegressionDataset(length=args.observed_batch_size)
+        batch = {"x": np.asarray(ds.x), "y": np.asarray(ds.y)}
+        for _ in range(args.steps):
+            state, metrics = step(state, batch)
+        return {
+            "microbatch": batch_size,
+            "accum": accum,
+            "loss": float(np.asarray(metrics["loss"])),
+        }
+
+    result = train()
+    print(f"attempted microbatch sizes: {attempts}")
+    print(
+        f"settled on microbatch {result['microbatch']} x accum "
+        f"{result['accum']} = observed {args.observed_batch_size}, "
+        f"final loss {result['loss']:.4f}"
+    )
+    return result["microbatch"]
+
+
+if __name__ == "__main__":
+    main()

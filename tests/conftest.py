@@ -39,3 +39,26 @@ def _reset_singletons():
     AcceleratorState._reset_state()
     GradientState._reset_state()
     ProcessState._reset_state()
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--heavy",
+        action="store_true",
+        default=False,
+        help="Include tests marked 'heavy' (compile-heavy / subprocess "
+        "launches). Default lane skips them so `pytest tests/` stays fast; "
+        "`make test-all` runs everything.",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Split CI lanes (reference Makefile:25-60 pattern): the default
+    `pytest tests/` run skips `heavy` tests; `--heavy` (or selecting them
+    explicitly with `-m heavy`) includes them."""
+    if config.getoption("--heavy") or config.getoption("-m"):
+        return
+    skip = pytest.mark.skip(reason="heavy lane: run with --heavy (or make test-all)")
+    for item in items:
+        if "heavy" in item.keywords:
+            item.add_marker(skip)

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu import MeshConfig
 from accelerate_tpu.models.layers import dot_product_attention
 from accelerate_tpu.ops.flash_attention import flash_attention

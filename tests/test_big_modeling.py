@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
 from jax.sharding import PartitionSpec
 
 from accelerate_tpu import (

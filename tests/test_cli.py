@@ -8,6 +8,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.commands.cli import main as cli_main
 from accelerate_tpu.commands.config import LaunchConfig
 from accelerate_tpu.commands.launch import build_child_env

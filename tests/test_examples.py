@@ -7,6 +7,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from launch_helpers import REPO_ROOT, launch
 
 EXAMPLES = os.path.join(REPO_ROOT, "examples")
@@ -116,6 +118,7 @@ def test_lm_example_learns_and_resumes(tmp_path):
         ("memory", ["--hbm_cap_gb", "0.00002", "--steps", "5"], lambda r: r < 4096),
         ("local_sgd", [], lambda r: r < 0.1),
         ("multi_process_metrics", [], lambda r: r == 77),
+        ("automatic_gradient_accumulation", ["--fail_below", "16"], lambda r: r == 16),
     ],
 )
 def test_by_feature_examples(name, args, check):
@@ -128,3 +131,15 @@ def test_by_feature_profiler(tmp_path):
     trace_dir = module.main(["--trace_dir", str(tmp_path / "trace"), "--steps", "3"])
     files = [f for _, _, fs in os.walk(trace_dir) for f in fs]
     assert files, "profiler example wrote no trace files"
+
+
+def test_by_feature_tracking(tmp_path):
+    module = _load("by_feature/tracking")
+    logged = module.main(["--logging_dir", str(tmp_path / "runs"), "--steps", "7"])
+    assert logged == 7
+
+
+def test_by_feature_checkpointing(tmp_path):
+    module = _load("by_feature/checkpointing")
+    rc = module.main(["--ckpt_dir", str(tmp_path / "ckpt")])
+    assert rc == 0.0

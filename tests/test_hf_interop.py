@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
@@ -183,3 +185,31 @@ class TestDefaultSharding:
         # Sharded: each device holds a strict fraction of the leaf.
         shard_elems = wq.addressable_shards[0].data.size
         assert shard_elems * n_devices == wq.size
+
+
+class TestQuantizedLoad:
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_quantize_on_load_forward_close(self, tiny_hf_llama, bits):
+        from accelerate_tpu.utils.quantization import is_quantized
+
+        model, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(
+            repo, mesh=mesh, min_weight_size=1, quantize_bits=bits,
+            dtype=jnp.float32,
+        )
+        blocks = loaded.params["blocks"]
+        # Big matmul weights packed; embeddings/norms full precision.
+        assert is_quantized(blocks["attn"]["wq"])
+        assert is_quantized(blocks["mlp"]["w_gate"])
+        assert not is_quantized(loaded.params["embed"])
+        assert not is_quantized(blocks["attn_norm"])
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 256
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        # Quantization error bounded: logits still track the fp32 model.
+        err = np.abs(ours - theirs).max()
+        assert err < (0.06 if bits == 8 else 0.6), err

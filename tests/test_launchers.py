@@ -6,6 +6,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.commands import cli
 from accelerate_tpu.launchers import debug_launcher, notebook_launcher
 from launch_helpers import REPO_ROOT, clean_env

@@ -13,6 +13,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu import Accelerator, MeshConfig
 from accelerate_tpu.models import bert, gpt, llama, t5, vit
 from accelerate_tpu.parallel.sharding import ShardingStrategy, infer_param_specs, shard_pytree

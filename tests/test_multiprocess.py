@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from launch_helpers import REPO_ROOT, assert_all_ranks, clean_env, free_port, launch
 
 DRIVER = os.path.join(REPO_ROOT, "tests", "scripts", "distributed_checks.py")

@@ -178,3 +178,23 @@ class TestInt4:
         )[0, 1]
         assert np.isfinite(np.asarray(q4)).all()
         assert corr > 0.98, corr
+
+
+class TestHostQuantize:
+    def test_host_matches_device_quantize(self):
+        import numpy as np
+
+        from accelerate_tpu.utils.quantization import (
+            quantize_array,
+            quantize_array_host,
+        )
+
+        rng = np.random.RandomState(0)
+        for shape, stack in [((6, 32, 16), None), ((64, 32), None), ((2, 3, 16, 8), 2)]:
+            for bits in (8, 4):
+                w = rng.randn(*shape).astype(np.float32)
+                dev = quantize_array(jnp.asarray(w), stack_dims=stack, bits=bits)
+                host = quantize_array_host(w, stack_dims=stack, bits=bits)
+                assert sorted(dev.keys()) == sorted(host.keys())
+                for k in dev:
+                    np.testing.assert_array_equal(np.asarray(dev[k]), host[k])

@@ -19,6 +19,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.parallel.mesh import batch_sharding
