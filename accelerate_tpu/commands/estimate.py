@@ -36,7 +36,15 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p = subparsers.add_parser(
         "estimate", help="Estimate HBM usage for a model family preset"
     )
-    p.add_argument("model", choices=sorted(_MODEL_PRESETS), help="Model preset")
+    p.add_argument(
+        "model",
+        nargs="?",
+        help="Model preset name (see --list) OR a path to a local HF repo / "
+        "config.json — any supported model_type estimates without a preset "
+        "(the Hub-model analog of reference estimate.py:64; no network, so "
+        "the repo must be on disk)",
+    )
+    p.add_argument("--list", action="store_true", help="List built-in presets")
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--seq_len", type=int, default=2048)
     p.add_argument("--precision", default="bf16", choices=["no", "bf16", "fp16"])
@@ -67,6 +75,31 @@ def _human(n_bytes: float) -> str:
     return f"{n_bytes:.2f} PB"
 
 
+def _resolve_model(model: str) -> tuple[str, Any]:
+    """Preset name -> (family, config); otherwise treat as a local HF repo
+    directory / config.json and translate via `models.hf.from_hf_config`."""
+    import os
+
+    from .. import models
+
+    if model in _MODEL_PRESETS:
+        family, preset = _MODEL_PRESETS[model]
+        module = getattr(models, family)
+        config_cls = next(
+            v for k, v in module.__dict__.items()
+            if k.lower() == f"{family}config" and isinstance(v, type)
+        )
+        return family, getattr(config_cls, preset)()
+    if os.path.exists(model):
+        from ..models.hf import from_hf_config
+
+        return from_hf_config(model)
+    raise SystemExit(
+        f"Unknown model {model!r}: not a preset "
+        f"({', '.join(sorted(_MODEL_PRESETS))}) and no such path exists."
+    )
+
+
 def estimate(model: str, batch_size: int, seq_len: int, precision: str,
              optimizer: str, shards: int, remat: bool) -> dict[str, Any]:
     import jax
@@ -74,13 +107,8 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
 
     from .. import models
 
-    family, preset = _MODEL_PRESETS[model]
+    family, config = _resolve_model(model)
     module = getattr(models, family)
-    config_cls = next(
-        v for k, v in module.__dict__.items()
-        if k.lower() == f"{family}config" and isinstance(v, type)
-    )
-    config = getattr(config_cls, preset)()
 
     # Exact parameter count via abstract evaluation — nothing materializes.
     shapes = jax.eval_shape(lambda rng: module.init(rng, config), jax.random.PRNGKey(0))
@@ -115,6 +143,7 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
 
     total = params_b + compute_copy_b + grads_b + opt_b + act_b + logits_b
     return {
+        "family": family,
         "config": config,
         "seq_len": eff_seq,
         "n_params": n_params,
@@ -132,6 +161,12 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
 
 
 def run(args: argparse.Namespace) -> int:
+    if args.list:
+        for name in sorted(_MODEL_PRESETS):
+            print(name)
+        return 0
+    if args.model is None:
+        raise SystemExit("estimate: provide a model preset or HF repo path (see --list)")
     r = estimate(
         args.model, args.batch_size, args.seq_len, args.precision,
         args.optimizer, args.shards, args.remat,
@@ -179,7 +214,7 @@ def _plan_summary(args: argparse.Namespace, r: dict[str, Any]) -> str:
     from ..parallel.mesh import MeshConfig, build_mesh
     from ..parallel.tp import get_tp_plan, list_tp_plans
 
-    family, _ = _MODEL_PRESETS[args.model]
+    family = r["family"]
     config = r["config"]
     module = getattr(models, family)
     shapes = jax.eval_shape(lambda rng: module.init(rng, config), jax.random.PRNGKey(0))

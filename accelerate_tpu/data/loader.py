@@ -415,10 +415,11 @@ class DataLoader:
                 current = next(it)
             except StopIteration:
                 self.end_of_dataloader = True
-                if self.skip_batches:
+                if self.skip_batches or self._stateful_resume_offset:
                     # A resume that landed exactly on the epoch boundary
                     # (batches_yielded == total at save time) consumes the
-                    # whole offset here. Advance to the next epoch start —
+                    # whole offset here — replay-skip AND native stateful
+                    # resumes alike. Advance to the next epoch start;
                     # without this, the stale offset would suppress every
                     # subsequent epoch's batches too.
                     self._advance_epoch()
@@ -509,6 +510,10 @@ class DataLoader:
             self._dataset_states = {self._stateful_resume_offset: restored}
         else:
             self.skip_batches = int(state.get("batches_yielded", 0))
+            # A stale offset from a PRIOR stateful resume would double-count
+            # positions under this replay-skip restore.
+            self._stateful_resume_offset = 0
+            self._dataset_states.clear()
         if self.sampler is not None:
             self.sampler.set_epoch(self._epoch)
 

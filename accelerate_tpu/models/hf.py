@@ -23,8 +23,8 @@ repo never materializes a full tensor on any host (the streaming contract of
 `load_checkpoint_and_dispatch`).
 
 Supported ``model_type``s: llama, mistral (the llama family), gpt2, bert,
-vit. Norm weights are rebased for this framework's ``(1 + scale)`` RMSNorm
-parameterization where applicable.
+vit, t5 (v1.1 gated layout). Norm weights are rebased for this framework's
+``(1 + scale)`` RMSNorm parameterization where applicable.
 """
 
 from __future__ import annotations
@@ -303,11 +303,58 @@ def _vit_specs(config) -> dict[str, _Src]:
     }
 
 
+def _t5_specs(config) -> dict[str, _Src]:
+    """T5 **v1.1** layout (gated-gelu `DenseGatedActDense`, untied head).
+    The rel-bias tables live only on block 0 in HF; this framework keeps one
+    shared table per stack, which is the same tensor."""
+    h = config.head_dim
+    E = "encoder.block.{i}.layer."
+    D = "decoder.block.{i}.layer."
+    m = {
+        "embed": _Src("shared.weight"),
+        "enc_rel_bias": _Src(
+            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ),
+        "dec_rel_bias": _Src(
+            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ),
+        "enc_final_norm": _Src("encoder.final_layer_norm.weight", _minus1),
+        "dec_final_norm": _Src("decoder.final_layer_norm.weight", _minus1),
+        "encoder.attn_norm": _Src(E + "0.layer_norm.weight", _minus1, True),
+        "encoder.attn.wq": _Src(E + "0.SelfAttention.q.weight", _qkv(h), True),
+        "encoder.attn.wk": _Src(E + "0.SelfAttention.k.weight", _qkv(h), True),
+        "encoder.attn.wv": _Src(E + "0.SelfAttention.v.weight", _qkv(h), True),
+        "encoder.attn.wo": _Src(E + "0.SelfAttention.o.weight", _oproj(h), True),
+        "encoder.mlp_norm": _Src(E + "1.layer_norm.weight", _minus1, True),
+        "encoder.mlp.w_gate": _Src(E + "1.DenseReluDense.wi_0.weight", _t2, True),
+        "encoder.mlp.w_up": _Src(E + "1.DenseReluDense.wi_1.weight", _t2, True),
+        "encoder.mlp.w_down": _Src(E + "1.DenseReluDense.wo.weight", _t2, True),
+        "decoder.self_norm": _Src(D + "0.layer_norm.weight", _minus1, True),
+        "decoder.self_attn.wq": _Src(D + "0.SelfAttention.q.weight", _qkv(h), True),
+        "decoder.self_attn.wk": _Src(D + "0.SelfAttention.k.weight", _qkv(h), True),
+        "decoder.self_attn.wv": _Src(D + "0.SelfAttention.v.weight", _qkv(h), True),
+        "decoder.self_attn.wo": _Src(D + "0.SelfAttention.o.weight", _oproj(h), True),
+        "decoder.cross_norm": _Src(D + "1.layer_norm.weight", _minus1, True),
+        "decoder.cross_attn.wq": _Src(D + "1.EncDecAttention.q.weight", _qkv(h), True),
+        "decoder.cross_attn.wk": _Src(D + "1.EncDecAttention.k.weight", _qkv(h), True),
+        "decoder.cross_attn.wv": _Src(D + "1.EncDecAttention.v.weight", _qkv(h), True),
+        "decoder.cross_attn.wo": _Src(D + "1.EncDecAttention.o.weight", _oproj(h), True),
+        "decoder.mlp_norm": _Src(D + "2.layer_norm.weight", _minus1, True),
+        "decoder.mlp.w_gate": _Src(D + "2.DenseReluDense.wi_0.weight", _t2, True),
+        "decoder.mlp.w_up": _Src(D + "2.DenseReluDense.wi_1.weight", _t2, True),
+        "decoder.mlp.w_down": _Src(D + "2.DenseReluDense.wo.weight", _t2, True),
+    }
+    if not config.tie_embeddings:
+        m["lm_head"] = _Src("lm_head.weight", _t2)
+    return m
+
+
 _SPEC_BUILDERS: dict[str, Callable[[Any], dict[str, _Src]]] = {
     "llama": _llama_specs,
     "gpt": _gpt2_specs,
     "bert": _bert_specs,
     "vit": _vit_specs,
+    "t5": _t5_specs,
 }
 
 
@@ -420,9 +467,33 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             norm_eps=config.get("layer_norm_eps", 1e-12),
             num_classes=_num_labels(config),
         )
+    if mt == "t5":
+        from .t5 import T5Config
+
+        ff_proj = config.get("feed_forward_proj", "relu")
+        if "gated" not in ff_proj:
+            raise ValueError(
+                f"This T5 checkpoint uses feed_forward_proj={ff_proj!r} (the "
+                "original ungated relu MLP); the t5 family here implements "
+                "the v1.1 gated-gelu layout only — use a google/t5-v1_1-* "
+                "style checkpoint."
+            )
+        return "t5", T5Config(
+            vocab_size=config["vocab_size"],
+            d_model=config["d_model"],
+            n_encoder_layers=config["num_layers"],
+            n_decoder_layers=config.get("num_decoder_layers", config["num_layers"]),
+            num_heads=config["num_heads"],
+            head_dim=config["d_kv"],
+            d_ff=config["d_ff"],
+            rel_buckets=config.get("relative_attention_num_buckets", 32),
+            rel_max_distance=config.get("relative_attention_max_distance", 128),
+            norm_eps=config.get("layer_norm_epsilon", 1e-6),
+            tie_embeddings=config.get("tie_word_embeddings", True),
+        )
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: llama, mistral, gpt2, "
-        "bert, vit."
+        "bert, vit, t5 (v1.1 gated layout)."
     )
 
 

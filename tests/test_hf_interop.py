@@ -213,3 +213,42 @@ class TestQuantizedLoad:
         # Quantization error bounded: logits still track the fp32 model.
         err = np.abs(ours - theirs).max()
         assert err < (0.06 if bits == 8 else 0.6), err
+
+
+class TestT5Parity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.T5Config(
+            vocab_size=128, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+            num_decoder_layers=2, num_heads=4,
+            feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+            relative_attention_num_buckets=8, relative_attention_max_distance=16,
+        )
+        torch.manual_seed(4)
+        model = transformers.T5ForConditionalGeneration(cfg).eval()
+        repo = _save_hf(model, tmp_path, "t5")
+        from accelerate_tpu.models import t5
+
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.family == "t5"
+        enc_in = np.arange(16, dtype=np.int32).reshape(2, 8) % 128
+        dec_in = (np.arange(12, dtype=np.int32).reshape(2, 6) * 3) % 128
+        ours = np.asarray(
+            t5.forward(loaded.params, jnp.asarray(enc_in), jnp.asarray(dec_in), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(
+                input_ids=torch.from_numpy(enc_in).long(),
+                decoder_input_ids=torch.from_numpy(dec_in).long(),
+            ).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-3, rtol=5e-3)
+
+    def test_ungated_t5_rejected(self, tmp_path):
+        json.dump(
+            {"model_type": "t5", "vocab_size": 64, "d_model": 16, "d_kv": 4,
+             "d_ff": 32, "num_layers": 1, "num_heads": 4,
+             "feed_forward_proj": "relu"},
+            open(tmp_path / "config.json", "w"),
+        )
+        with pytest.raises(ValueError, match="gated"):
+            hf.from_hf_config(str(tmp_path))
