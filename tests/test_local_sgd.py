@@ -9,6 +9,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.local_sgd import (
     LocalSGD,

@@ -7,6 +7,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.models import llama
 from accelerate_tpu.ops.moe import init_moe, moe_forward, moe_reference

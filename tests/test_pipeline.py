@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.models import llama
 from accelerate_tpu.parallel.pipeline import (
     Pipeline,

@@ -8,6 +8,8 @@ import os
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu import tracking
 from accelerate_tpu.tracking import (
