@@ -9,11 +9,11 @@ module exposes: a frozen ``*Config``, ``init(rng, config) -> params``,
 """
 
 from . import bert, gpt, hf, llama, t5, vit
-from .hf import from_hf_config, load_pretrained
+from .hf import from_hf_config, load_pretrained, save_pretrained
 from .layers import cross_entropy_loss, dot_product_attention
 
 __all__ = [
     "bert", "gpt", "hf", "llama", "t5", "vit",
     "cross_entropy_loss", "dot_product_attention",
-    "from_hf_config", "load_pretrained",
+    "from_hf_config", "load_pretrained", "save_pretrained",
 ]

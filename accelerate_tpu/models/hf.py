@@ -22,9 +22,12 @@ a leaf reads only the matching byte ranges of the source tensors, so a 70B
 repo never materializes a full tensor on any host (the streaming contract of
 `load_checkpoint_and_dispatch`).
 
-Supported ``model_type``s: llama, mistral (the llama family), gpt2, bert,
-vit, t5 (v1.1 gated layout). Norm weights are rebased for this framework's
-``(1 + scale)`` RMSNorm parameterization where applicable.
+Supported ``model_type``s: llama, mistral, mixtral (the llama family —
+mixtral routes through the MoE blocks), gpt2, bert, vit, t5 (v1.1 gated
+layout). Norm weights are rebased for this framework's ``(1 + scale)``
+RMSNorm parameterization where applicable. `save_pretrained` writes the
+repo back out in HF layout (llama family) so `transformers` loads the
+export unchanged.
 """
 
 from __future__ import annotations
@@ -153,11 +156,44 @@ def _vec_heads(head_dim: int) -> Fetcher:
 
 @dataclass(frozen=True)
 class _Src:
-    """Where one target leaf comes from in the HF checkpoint."""
+    """Where one target leaf comes from in the HF checkpoint.
+
+    ``invert`` (when set) maps ONE per-layer slice of this framework's leaf
+    back to the HF tensor layout — the export direction
+    (`save_pretrained`)."""
 
     key: str  # tensor name; ``{i}`` substituted per layer when per_layer
     fetch: Fetcher = _ident
     per_layer: bool = False
+    invert: Callable[[np.ndarray], np.ndarray] | None = None
+    # Leaf carries a second stacked axis of per-expert HF tensors (``{e}``
+    # in the template) — the Mixtral block_sparse_moe layout.
+    per_expert: bool = False
+
+
+# Inverse layouts for the export direction.
+def _inv_ident(arr: np.ndarray) -> np.ndarray:
+    return arr
+
+
+def _inv_plus1(arr: np.ndarray) -> np.ndarray:
+    return arr + np.asarray(1, dtype=arr.dtype)
+
+
+def _inv_t2(arr: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(arr.T)
+
+
+def _inv_qkv(arr: np.ndarray) -> np.ndarray:
+    # (d, n_heads, h) -> (n_heads*h, d)
+    d = arr.shape[0]
+    return np.ascontiguousarray(arr.reshape(d, -1).T)
+
+
+def _inv_oproj(arr: np.ndarray) -> np.ndarray:
+    # (n_heads, h, d) -> (d, n_heads*h)
+    d = arr.shape[-1]
+    return np.ascontiguousarray(arr.reshape(-1, d).T)
 
 
 # --------------------------------------------------------------- family maps
@@ -165,20 +201,48 @@ def _llama_specs(config) -> dict[str, _Src]:
     h = config.resolved_head_dim
     L = "model.layers.{i}."
     m = {
-        "embed": _Src("model.embed_tokens.weight"),
-        "final_norm": _Src("model.norm.weight", _minus1),
-        "blocks.attn_norm": _Src(L + "input_layernorm.weight", _minus1, True),
-        "blocks.mlp_norm": _Src(L + "post_attention_layernorm.weight", _minus1, True),
-        "blocks.attn.wq": _Src(L + "self_attn.q_proj.weight", _qkv(h), True),
-        "blocks.attn.wk": _Src(L + "self_attn.k_proj.weight", _qkv(h), True),
-        "blocks.attn.wv": _Src(L + "self_attn.v_proj.weight", _qkv(h), True),
-        "blocks.attn.wo": _Src(L + "self_attn.o_proj.weight", _oproj(h), True),
-        "blocks.mlp.w_gate": _Src(L + "mlp.gate_proj.weight", _t2, True),
-        "blocks.mlp.w_up": _Src(L + "mlp.up_proj.weight", _t2, True),
-        "blocks.mlp.w_down": _Src(L + "mlp.down_proj.weight", _t2, True),
+        "embed": _Src("model.embed_tokens.weight", invert=_inv_ident),
+        "final_norm": _Src("model.norm.weight", _minus1, invert=_inv_plus1),
+        "blocks.attn_norm": _Src(
+            L + "input_layernorm.weight", _minus1, True, invert=_inv_plus1
+        ),
+        "blocks.mlp_norm": _Src(
+            L + "post_attention_layernorm.weight", _minus1, True, invert=_inv_plus1
+        ),
+        "blocks.attn.wq": _Src(
+            L + "self_attn.q_proj.weight", _qkv(h), True, invert=_inv_qkv
+        ),
+        "blocks.attn.wk": _Src(
+            L + "self_attn.k_proj.weight", _qkv(h), True, invert=_inv_qkv
+        ),
+        "blocks.attn.wv": _Src(
+            L + "self_attn.v_proj.weight", _qkv(h), True, invert=_inv_qkv
+        ),
+        "blocks.attn.wo": _Src(
+            L + "self_attn.o_proj.weight", _oproj(h), True, invert=_inv_oproj
+        ),
+        "blocks.mlp.w_gate": _Src(
+            L + "mlp.gate_proj.weight", _t2, True, invert=_inv_t2
+        ),
+        "blocks.mlp.w_up": _Src(L + "mlp.up_proj.weight", _t2, True, invert=_inv_t2),
+        "blocks.mlp.w_down": _Src(
+            L + "mlp.down_proj.weight", _t2, True, invert=_inv_t2
+        ),
     }
+    if config.n_experts:
+        # Mixtral block_sparse_moe layout: w1=gate, w3=up, w2=down, all
+        # torch (out, in); router `gate.weight` is (E, d).
+        E = L + "block_sparse_moe.experts.{e}."
+        for leaf in ("blocks.mlp.w_gate", "blocks.mlp.w_up", "blocks.mlp.w_down"):
+            del m[leaf]
+        m["blocks.moe.router"] = _Src(
+            L + "block_sparse_moe.gate.weight", _t2, True
+        )
+        m["blocks.moe.w_gate"] = _Src(E + "w1.weight", _t2, True, per_expert=True)
+        m["blocks.moe.w_up"] = _Src(E + "w3.weight", _t2, True, per_expert=True)
+        m["blocks.moe.w_down"] = _Src(E + "w2.weight", _t2, True, per_expert=True)
     if not config.tie_embeddings:
-        m["lm_head"] = _Src("lm_head.weight", _t2)
+        m["lm_head"] = _Src("lm_head.weight", _t2, invert=_inv_t2)
     return m
 
 
@@ -390,7 +454,7 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
         with open(path) as f:
             config = json.load(f)
     mt = config.get("model_type")
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "mixtral"):
         from .llama import LlamaConfig
 
         # Refuse architecture-affecting knobs this family doesn't implement:
@@ -425,6 +489,17 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             rope_theta=config.get("rope_theta", 10000.0),
             norm_eps=config.get("rms_norm_eps", 1e-5),
             tie_embeddings=config.get("tie_word_embeddings", False),
+            # Mixtral: routed experts replace every block's FFN. A capacity
+            # factor of E/k removes dropping entirely, matching HF's
+            # capacity-free routing exactly (ops/moe.py renormalizes kept
+            # gates the same way Mixtral softmaxes over the top-k).
+            n_experts=config.get("num_local_experts", 0),
+            moe_top_k=config.get("num_experts_per_tok", 2),
+            moe_capacity_factor=(
+                config["num_local_experts"] / config.get("num_experts_per_tok", 2)
+                if config.get("num_local_experts")
+                else 1.25
+            ),
         )
     if mt == "gpt2":
         from .gpt import GPTConfig
@@ -492,8 +567,8 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             tie_embeddings=config.get("tie_word_embeddings", True),
         )
     raise ValueError(
-        f"Unsupported HF model_type {mt!r}; supported: llama, mistral, gpt2, "
-        "bert, vit, t5 (v1.1 gated layout)."
+        f"Unsupported HF model_type {mt!r}; supported: llama, mistral, "
+        "mixtral, gpt2, bert, vit, t5 (v1.1 gated layout)."
     )
 
 
@@ -683,10 +758,19 @@ def load_hf_checkpoint(
         # any device allocation.
         if src.per_layer:
             for i in range(int(leaf.shape[0])):
-                resolve(src.key.format(i=i))
+                if src.per_expert:
+                    for e in range(int(leaf.shape[1])):
+                        resolve(src.key.format(i=i, e=e))
+                else:
+                    resolve(src.key.format(i=i))
         else:
             resolve(src.key)
         shape = tuple(leaf.shape)
+
+        def read_for(name: str):
+            return lambda s_idx, _k=resolve(name): np.asarray(
+                source.read_slice(_k, tuple(s_idx))
+            )
 
         def fetch_host(idx: tuple, _src=src, _shape=shape) -> np.ndarray:
             idx = _norm_idx(idx, _shape)
@@ -695,16 +779,25 @@ def load_hf_checkpoint(
                 sub_idx, sub_shape = idx[1:], _shape[1:]
                 planes = []
                 for i in range(layers.start, layers.stop):
-                    k = resolve(_src.key.format(i=i))
-                    read = lambda s_idx, _k=k: np.asarray(
-                        source.read_slice(_k, tuple(s_idx))
-                    )
-                    planes.append(_src.fetch(read, sub_idx, sub_shape))
+                    if _src.per_expert:
+                        experts = sub_idx[0]
+                        e_planes = [
+                            _src.fetch(
+                                read_for(_src.key.format(i=i, e=e)),
+                                sub_idx[1:],
+                                sub_shape[1:],
+                            )
+                            for e in range(experts.start, experts.stop)
+                        ]
+                        planes.append(np.stack(e_planes))
+                    else:
+                        planes.append(
+                            _src.fetch(
+                                read_for(_src.key.format(i=i)), sub_idx, sub_shape
+                            )
+                        )
                 return np.stack(planes)
-            read = lambda s_idx: np.asarray(
-                source.read_slice(resolve(_src.key), tuple(s_idx))
-            )
-            return _src.fetch(read, idx, _shape)
+            return _src.fetch(read_for(_src.key), idx, _shape)
 
         return fetch_host
 
@@ -722,3 +815,125 @@ def load_hf_checkpoint(
         )
     finally:
         source.close()
+
+
+# ----------------------------------------------------------------- export
+def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> dict:
+    """Family config -> HF ``config.json`` payload (inverse of
+    `from_hf_config`; llama only so far — the flagship migration loop)."""
+    if family == "llama":
+        return {
+            "model_type": "llama",
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": config.vocab_size,
+            "hidden_size": config.d_model,
+            "intermediate_size": config.d_ff,
+            "num_hidden_layers": config.n_layers,
+            "num_attention_heads": config.num_heads,
+            "num_key_value_heads": config.num_kv_heads,
+            "head_dim": config.resolved_head_dim,
+            "max_position_embeddings": config.max_seq_len,
+            "rope_theta": config.rope_theta,
+            "rms_norm_eps": config.norm_eps,
+            "tie_word_embeddings": config.tie_embeddings,
+            "hidden_act": "silu",
+            "torch_dtype": torch_dtype,
+        }
+    raise ValueError(
+        f"config_to_hf supports the llama family only (got {family!r})."
+    )
+
+
+def save_pretrained(
+    path: str,
+    family: str,
+    config: Any,
+    params: Params,
+    *,
+    max_shard_bytes: int = 4 << 30,
+) -> str:
+    """Export params to an HF-layout repo (``config.json`` + sharded
+    safetensors with HF tensor names + ``model.safetensors.index.json``) —
+    the return leg of the migration loop: a model trained here loads in
+    `transformers.AutoModel.from_pretrained` unchanged. Inverse of
+    `load_pretrained`; round-trip parity is tested against transformers.
+
+    Quantized params must be dequantized first
+    (`utils.quantization.dequantize_pytree`)."""
+    from ..utils.quantization import has_quantized
+
+    if has_quantized(params):
+        raise ValueError(
+            "save_pretrained needs full-precision params; run "
+            "utils.quantization.dequantize_pytree first."
+        )
+    specs_map = hf_key_specs(family, config)
+    missing = [k for k, s in specs_map.items() if s.invert is None]
+    if missing:
+        raise NotImplementedError(
+            f"Export has no inverse transform for leaves {missing[:4]} "
+            f"(family {family!r}) — dense llama models export; MoE/mixtral "
+            "and the other families are load-only for now."
+        )
+
+    def leaf_for(dotted: str) -> Any:
+        node: Any = params
+        for part in dotted.split("."):
+            node = node[part]
+        return node
+
+    # torch_dtype must reflect what lands on disk, or transformers
+    # re-instantiates the export at the wrong precision.
+    dtype_name = str(np.dtype(leaf_for(next(iter(specs_map))).dtype))
+    if dtype_name not in ("bfloat16", "float16"):
+        dtype_name = "float32"
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config_to_hf(family, config, torch_dtype=dtype_name), f, indent=2)
+
+    def tensors() -> Any:
+        for key, src in specs_map.items():
+            leaf = leaf_for(key)
+            if src.per_layer:
+                # One layer slice at a time: a 70B stacked leaf is tens of
+                # GiB — the full-leaf device_get would OOM the host, the
+                # per-slice gather keeps the spike to one layer's worth.
+                for i in range(leaf.shape[0]):
+                    arr = np.asarray(jax.device_get(leaf[i]))
+                    yield src.key.format(i=i), src.invert(arr)
+            else:
+                yield src.key, src.invert(np.asarray(jax.device_get(leaf)))
+
+    from safetensors.numpy import save_file
+
+    weight_map: dict[str, str] = {}
+    shard: dict[str, np.ndarray] = {}
+    shard_bytes = 0
+    shard_idx = 0
+
+    def flush() -> None:
+        nonlocal shard, shard_bytes, shard_idx
+        if not shard:
+            return
+        fname = f"model-{shard_idx:05d}.safetensors"
+        save_file(shard, os.path.join(path, fname))
+        for k in shard:
+            weight_map[k] = fname
+        shard = {}
+        shard_bytes = 0
+        shard_idx += 1
+
+    total = 0
+    for name, arr in tensors():
+        if shard_bytes + arr.nbytes > max_shard_bytes and shard:
+            flush()
+        shard[name] = arr
+        shard_bytes += arr.nbytes
+        total += arr.nbytes
+    flush()
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        # transformers' hub loader requires the metadata block.
+        json.dump(
+            {"metadata": {"total_size": total}, "weight_map": weight_map}, f
+        )
+    return path

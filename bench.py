@@ -450,7 +450,7 @@ def _synth_llama8b_repo(repo: str, cfg: dict | None = None) -> None:
             tensors[L + "mlp.down_proj.weight"] = rnd(d, ff)
         dump(f"model-layers-{start:02d}.safetensors", tensors)
     with open(os.path.join(repo, "model.safetensors.index.json"), "w") as f:
-        _json.dump({"weight_map": weight_map}, f)
+        _json.dump({"metadata": {"total_size": 0}, "weight_map": weight_map}, f)
     with open(os.path.join(repo, ".complete"), "w") as f:
         f.write("ok")
 

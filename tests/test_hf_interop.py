@@ -252,3 +252,54 @@ class TestT5Parity:
         )
         with pytest.raises(ValueError, match="gated"):
             hf.from_hf_config(str(tmp_path))
+
+
+class TestExportRoundTrip:
+    def test_transformers_loads_our_export(self, tiny_hf_llama, tmp_path):
+        """The return leg of the migration loop: load an HF repo, export it
+        back with save_pretrained, and let transformers load THE EXPORT —
+        logits must match the original torch model end to end."""
+        model, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        out_dir = str(tmp_path / "exported")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+
+        reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir).eval()
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 256
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+    def test_quantized_params_rejected(self, tiny_hf_llama, tmp_path):
+        _, repo = tiny_hf_llama
+        mesh = build_mesh(MeshConfig())
+        loaded = hf.load_pretrained(repo, mesh=mesh, quantize_bits=8)
+        with pytest.raises(ValueError, match="full-precision"):
+            hf.save_pretrained(
+                str(tmp_path / "q"), loaded.family, loaded.config, loaded.params
+            )
+
+
+class TestMixtralParity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+        )
+        torch.manual_seed(5)
+        model = transformers.MixtralForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "mixtral")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.family == "llama" and loaded.config.n_experts == 4
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
