@@ -22,9 +22,9 @@ a leaf reads only the matching byte ranges of the source tensors, so a 70B
 repo never materializes a full tensor on any host (the streaming contract of
 `load_checkpoint_and_dispatch`).
 
-Supported ``model_type``s: llama, mistral, mixtral (the llama family —
-mixtral routes through the MoE blocks), gpt2, bert, vit, t5 (v1.1 gated
-layout). Norm weights are rebased for this framework's ``(1 + scale)``
+Supported ``model_type``s: llama, mistral, mixtral, qwen2 (the llama
+family — mixtral routes through the MoE blocks, qwen2 adds q/k/v biases),
+gpt2, bert, vit, t5 (v1.1 gated layout). Norm weights are rebased for this framework's ``(1 + scale)``
 RMSNorm parameterization where applicable. `save_pretrained` writes the
 repo back out in HF layout (llama family) so `transformers` loads the
 export unchanged.
@@ -229,6 +229,20 @@ def _llama_specs(config) -> dict[str, _Src]:
             L + "mlp.down_proj.weight", _t2, True, invert=_inv_t2
         ),
     }
+    if config.attn_bias:
+        # Qwen2 layout: q/k/v projections carry biases (o_proj does not).
+        def _inv_vec(arr: np.ndarray) -> np.ndarray:
+            return np.ascontiguousarray(arr.reshape(-1))
+
+        m["blocks.attn.bq"] = _Src(
+            L + "self_attn.q_proj.bias", _vec_heads(h), True, invert=_inv_vec
+        )
+        m["blocks.attn.bk"] = _Src(
+            L + "self_attn.k_proj.bias", _vec_heads(h), True, invert=_inv_vec
+        )
+        m["blocks.attn.bv"] = _Src(
+            L + "self_attn.v_proj.bias", _vec_heads(h), True, invert=_inv_vec
+        )
     if config.n_experts:
         # Mixtral block_sparse_moe layout: w1=gate, w3=up, w2=down, all
         # torch (out, in); router `gate.weight` is (E, d).
@@ -454,7 +468,7 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
         with open(path) as f:
             config = json.load(f)
     mt = config.get("model_type")
-    if mt in ("llama", "mistral", "mixtral"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2"):
         from .llama import LlamaConfig
 
         # Refuse architecture-affecting knobs this family doesn't implement:
@@ -468,7 +482,26 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
                 "would silently diverge from the original model. Use a "
                 "non-rope-scaled checkpoint (e.g. Llama-3.0-style)."
             )
-        if config.get("sliding_window"):
+        # Community llama variants can carry q/k/v/o and MLP biases
+        # (LlamaConfig.attention_bias / mlp_bias); the block here models
+        # q/k/v biases only in the qwen2 layout — anything else would load
+        # with silently dropped tensors.
+        if mt != "qwen2" and config.get("attention_bias"):
+            raise ValueError(
+                "This llama-family checkpoint sets attention_bias=true "
+                "(biases on q/k/v/o projections); only the qwen2 bias "
+                "layout (q/k/v, no o_proj bias) is implemented — logits "
+                "would silently diverge if the biases were dropped."
+            )
+        if config.get("mlp_bias"):
+            raise ValueError(
+                "This checkpoint sets mlp_bias=true; the llama family here "
+                "has bias-free MLPs — loading would silently drop tensors."
+            )
+        sliding = config.get("sliding_window")
+        if mt == "qwen2" and not config.get("use_sliding_window", False):
+            sliding = None  # qwen2 ships the field but disables the feature
+        if sliding:
             raise ValueError(
                 "This checkpoint uses sliding-window attention "
                 f"(window={config['sliding_window']}), which this llama "
@@ -489,6 +522,8 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             rope_theta=config.get("rope_theta", 10000.0),
             norm_eps=config.get("rms_norm_eps", 1e-5),
             tie_embeddings=config.get("tie_word_embeddings", False),
+            # Qwen2 = llama block + q/k/v biases.
+            attn_bias=(mt == "qwen2"),
             # Mixtral: routed experts replace every block's FFN. A capacity
             # factor of E/k removes dropping entirely, matching HF's
             # capacity-free routing exactly (ops/moe.py renormalizes kept
@@ -568,7 +603,7 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
         )
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: llama, mistral, "
-        "mixtral, gpt2, bert, vit, t5 (v1.1 gated layout)."
+        "mixtral, qwen2, gpt2, bert, vit, t5 (v1.1 gated layout)."
     )
 
 
@@ -822,9 +857,10 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
     """Family config -> HF ``config.json`` payload (inverse of
     `from_hf_config`; llama only so far — the flagship migration loop)."""
     if family == "llama":
+        qwen = getattr(config, "attn_bias", False)
         return {
-            "model_type": "llama",
-            "architectures": ["LlamaForCausalLM"],
+            "model_type": "qwen2" if qwen else "llama",
+            "architectures": ["Qwen2ForCausalLM" if qwen else "LlamaForCausalLM"],
             "vocab_size": config.vocab_size,
             "hidden_size": config.d_model,
             "intermediate_size": config.d_ff,

@@ -77,6 +77,9 @@ class LlamaConfig:
     # is the single biggest activation at long S / large vocab
     # (layers.chunked_lm_loss). None = unchunked.
     loss_chunk_size: int | None = None
+    # Qwen2-style q/k/v biases (the only block-level deviation Qwen2 makes
+    # from llama); o_proj stays bias-free there, so only bq/bk/bv are added.
+    attn_bias: bool = False
     # Mixture-of-Experts: n_experts > 0 replaces every block's FFN with a
     # top-k routed expert layer (ops/moe.py); expert weights shard over the
     # `expert` mesh axis via the "llama" plan.
@@ -121,6 +124,8 @@ class LlamaConfig:
     def param_count(self) -> int:
         h = self.resolved_head_dim
         attn = self.d_model * h * (2 * self.num_heads + 2 * self.num_kv_heads)
+        if self.attn_bias:
+            attn += h * (self.num_heads + 2 * self.num_kv_heads)
         if self.n_experts:
             ffn = self.n_experts * 3 * self.d_model * self.d_ff + self.d_model * self.n_experts
         else:
@@ -136,9 +141,12 @@ class LlamaConfig:
 
 def init_block(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
     ka, km = jax.random.split(rng)
+    attn = init_attention(ka, config.attention_spec, dtype, bias=config.attn_bias)
+    if config.attn_bias:
+        del attn["bo"]  # Qwen2 convention: q/k/v biased, o_proj is not
     block = {
         "attn_norm": jnp.zeros((config.d_model,), dtype),
-        "attn": init_attention(ka, config.attention_spec, dtype),
+        "attn": attn,
         "mlp_norm": jnp.zeros((config.d_model,), dtype),
     }
     if config.n_experts:

@@ -56,6 +56,7 @@ register_tp_plan(
         (r"blocks/attn/wq$", P(None, F, T, None)),
         (r"blocks/attn/w[kv]$", P(None, F, T, None)),
         (r"blocks/attn/wo$", P(None, T, None, F)),
+        (r"blocks/attn/b[qkv]$", P(None, T, None)),
         (r"blocks/mlp/w_(gate|up)$", P(None, F, T)),
         (r"blocks/mlp/w_down$", P(None, T, F)),
         # MoE (present when LlamaConfig.n_experts > 0): experts shard over

@@ -303,3 +303,60 @@ class TestMixtralParity:
         with torch.no_grad():
             theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
         np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+
+class TestQwen2Parity:
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(6)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "qwen2")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.family == "llama" and loaded.config.attn_bias
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_export_round_trip(self, tmp_path):
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False,
+        )
+        torch.manual_seed(7)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "qwen2src")
+        mesh = build_mesh(MeshConfig())
+        loaded = hf.load_pretrained(repo, mesh=mesh)
+        out_dir = str(tmp_path / "qwen2exp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        reloaded = transformers.Qwen2ForCausalLM.from_pretrained(out_dir).eval()
+        tokens = np.arange(16, dtype=np.int32).reshape(2, 8) % 128
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+
+def test_llama_bias_variants_rejected(tmp_path):
+    """Community llama configs with attention_bias/mlp_bias must refuse
+    loudly — silently dropping their bias tensors would break parity."""
+    base = {"model_type": "llama", "vocab_size": 64, "hidden_size": 16,
+            "intermediate_size": 32, "num_hidden_layers": 1,
+            "num_attention_heads": 2, "num_key_value_heads": 2}
+    json.dump({**base, "attention_bias": True}, open(tmp_path / "config.json", "w"))
+    with pytest.raises(ValueError, match="attention_bias"):
+        hf.from_hf_config(str(tmp_path))
+    json.dump({**base, "mlp_bias": True}, open(tmp_path / "config.json", "w"))
+    with pytest.raises(ValueError, match="mlp_bias"):
+        hf.from_hf_config(str(tmp_path))
