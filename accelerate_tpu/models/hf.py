@@ -26,8 +26,9 @@ Supported ``model_type``s: llama, mistral, mixtral, qwen2 (the llama
 family — mixtral routes through the MoE blocks, qwen2 adds q/k/v biases),
 gpt2, bert, vit, t5 (v1.1 gated layout). Norm weights are rebased for this framework's ``(1 + scale)``
 RMSNorm parameterization where applicable. `save_pretrained` writes the
-repo back out in HF layout (llama family) so `transformers` loads the
-export unchanged.
+repo back out in HF layout (llama/qwen2/gpt2/bert/vit/t5) so
+`transformers` loads the export unchanged — round-trip logit parity is
+tested for every family.
 """
 
 from __future__ import annotations
@@ -176,6 +177,11 @@ def _inv_ident(arr: np.ndarray) -> np.ndarray:
     return arr
 
 
+def _inv_vec_heads(arr: np.ndarray) -> np.ndarray:
+    # (n_heads, h) -> (n_heads*h,)
+    return np.ascontiguousarray(arr.reshape(-1))
+
+
 def _inv_plus1(arr: np.ndarray) -> np.ndarray:
     return arr + np.asarray(1, dtype=arr.dtype)
 
@@ -231,17 +237,14 @@ def _llama_specs(config) -> dict[str, _Src]:
     }
     if config.attn_bias:
         # Qwen2 layout: q/k/v projections carry biases (o_proj does not).
-        def _inv_vec(arr: np.ndarray) -> np.ndarray:
-            return np.ascontiguousarray(arr.reshape(-1))
-
         m["blocks.attn.bq"] = _Src(
-            L + "self_attn.q_proj.bias", _vec_heads(h), True, invert=_inv_vec
+            L + "self_attn.q_proj.bias", _vec_heads(h), True, invert=_inv_vec_heads
         )
         m["blocks.attn.bk"] = _Src(
-            L + "self_attn.k_proj.bias", _vec_heads(h), True, invert=_inv_vec
+            L + "self_attn.k_proj.bias", _vec_heads(h), True, invert=_inv_vec_heads
         )
         m["blocks.attn.bv"] = _Src(
-            L + "self_attn.v_proj.bias", _vec_heads(h), True, invert=_inv_vec
+            L + "self_attn.v_proj.bias", _vec_heads(h), True, invert=_inv_vec_heads
         )
     if config.n_experts:
         # Mixtral block_sparse_moe layout: w1=gate, w3=up, w2=down, all
@@ -311,31 +314,31 @@ def _bert_specs(config) -> dict[str, _Src]:
     E = "embeddings."
     L = "encoder.layer.{i}."
     return {
-        "tok_embed": _Src(E + "word_embeddings.weight"),
-        "pos_embed": _Src(E + "position_embeddings.weight"),
-        "type_embed": _Src(E + "token_type_embeddings.weight"),
-        "embed_norm_scale": _Src(E + "LayerNorm.weight"),
-        "embed_norm_bias": _Src(E + "LayerNorm.bias"),
-        "blocks.attn.wq": _Src(L + "attention.self.query.weight", _qkv(h), True),
-        "blocks.attn.wk": _Src(L + "attention.self.key.weight", _qkv(h), True),
-        "blocks.attn.wv": _Src(L + "attention.self.value.weight", _qkv(h), True),
-        "blocks.attn.bq": _Src(L + "attention.self.query.bias", _vec_heads(h), True),
-        "blocks.attn.bk": _Src(L + "attention.self.key.bias", _vec_heads(h), True),
-        "blocks.attn.bv": _Src(L + "attention.self.value.bias", _vec_heads(h), True),
-        "blocks.attn.wo": _Src(L + "attention.output.dense.weight", _oproj(h), True),
-        "blocks.attn.bo": _Src(L + "attention.output.dense.bias", _ident, True),
-        "blocks.attn_norm_scale": _Src(L + "attention.output.LayerNorm.weight", _ident, True),
-        "blocks.attn_norm_bias": _Src(L + "attention.output.LayerNorm.bias", _ident, True),
-        "blocks.mlp.w_in": _Src(L + "intermediate.dense.weight", _t2, True),
-        "blocks.mlp.b_in": _Src(L + "intermediate.dense.bias", _ident, True),
-        "blocks.mlp.w_out": _Src(L + "output.dense.weight", _t2, True),
-        "blocks.mlp.b_out": _Src(L + "output.dense.bias", _ident, True),
-        "blocks.mlp_norm_scale": _Src(L + "output.LayerNorm.weight", _ident, True),
-        "blocks.mlp_norm_bias": _Src(L + "output.LayerNorm.bias", _ident, True),
-        "pooler.w": _Src("pooler.dense.weight", _t2),
-        "pooler.b": _Src("pooler.dense.bias"),
-        "classifier.w": _Src("classifier.weight", _t2),
-        "classifier.b": _Src("classifier.bias"),
+        "tok_embed": _Src(E + "word_embeddings.weight", invert=_inv_ident),
+        "pos_embed": _Src(E + "position_embeddings.weight", invert=_inv_ident),
+        "type_embed": _Src(E + "token_type_embeddings.weight", invert=_inv_ident),
+        "embed_norm_scale": _Src(E + "LayerNorm.weight", invert=_inv_ident),
+        "embed_norm_bias": _Src(E + "LayerNorm.bias", invert=_inv_ident),
+        "blocks.attn.wq": _Src(L + "attention.self.query.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wk": _Src(L + "attention.self.key.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wv": _Src(L + "attention.self.value.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.bq": _Src(L + "attention.self.query.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bk": _Src(L + "attention.self.key.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bv": _Src(L + "attention.self.value.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.wo": _Src(L + "attention.output.dense.weight", _oproj(h), True, _inv_oproj),
+        "blocks.attn.bo": _Src(L + "attention.output.dense.bias", _ident, True, _inv_ident),
+        "blocks.attn_norm_scale": _Src(L + "attention.output.LayerNorm.weight", _ident, True, _inv_ident),
+        "blocks.attn_norm_bias": _Src(L + "attention.output.LayerNorm.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_in": _Src(L + "intermediate.dense.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_in": _Src(L + "intermediate.dense.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_out": _Src(L + "output.dense.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_out": _Src(L + "output.dense.bias", _ident, True, _inv_ident),
+        "blocks.mlp_norm_scale": _Src(L + "output.LayerNorm.weight", _ident, True, _inv_ident),
+        "blocks.mlp_norm_bias": _Src(L + "output.LayerNorm.bias", _ident, True, _inv_ident),
+        "pooler.w": _Src("pooler.dense.weight", _t2, invert=_inv_t2),
+        "pooler.b": _Src("pooler.dense.bias", invert=_inv_ident),
+        "classifier.w": _Src("classifier.weight", _t2, invert=_inv_t2),
+        "classifier.b": _Src("classifier.bias", invert=_inv_ident),
     }
 
 
@@ -353,31 +356,41 @@ def _vit_specs(config) -> dict[str, _Src]:
         arr = np.transpose(arr, (2, 3, 1, 0)).reshape(-1, i1.stop - i1.start)
         return arr[i0]
 
+    def patch_invert(arr: np.ndarray) -> np.ndarray:
+        # (p*p*C, d) -> conv kernel (d, C, p, p)
+        p_sz, C = config.patch_size, config.channels
+        d = arr.shape[-1]
+        return np.ascontiguousarray(
+            arr.reshape(p_sz, p_sz, C, d).transpose(3, 2, 0, 1)
+        )
+
     return {
-        "patch_proj.w": _Src(E + "patch_embeddings.projection.weight", patch_fetch),
-        "patch_proj.b": _Src(E + "patch_embeddings.projection.bias"),
-        "cls_token": _Src(E + "cls_token", lambda r, i, s: r((slice(0, 1), slice(0, 1), i[0]))[0, 0]),
-        "pos_embed": _Src(E + "position_embeddings", lambda r, i, s: r((slice(0, 1), i[0], i[1]))[0]),
-        "lnf_scale": _Src("layernorm.weight"),
-        "lnf_bias": _Src("layernorm.bias"),
-        "blocks.ln1_scale": _Src(L + "layernorm_before.weight", _ident, True),
-        "blocks.ln1_bias": _Src(L + "layernorm_before.bias", _ident, True),
-        "blocks.ln2_scale": _Src(L + "layernorm_after.weight", _ident, True),
-        "blocks.ln2_bias": _Src(L + "layernorm_after.bias", _ident, True),
-        "blocks.attn.wq": _Src(L + "attention.attention.query.weight", _qkv(h), True),
-        "blocks.attn.wk": _Src(L + "attention.attention.key.weight", _qkv(h), True),
-        "blocks.attn.wv": _Src(L + "attention.attention.value.weight", _qkv(h), True),
-        "blocks.attn.bq": _Src(L + "attention.attention.query.bias", _vec_heads(h), True),
-        "blocks.attn.bk": _Src(L + "attention.attention.key.bias", _vec_heads(h), True),
-        "blocks.attn.bv": _Src(L + "attention.attention.value.bias", _vec_heads(h), True),
-        "blocks.attn.wo": _Src(L + "attention.output.dense.weight", _oproj(h), True),
-        "blocks.attn.bo": _Src(L + "attention.output.dense.bias", _ident, True),
-        "blocks.mlp.w_in": _Src(L + "intermediate.dense.weight", _t2, True),
-        "blocks.mlp.b_in": _Src(L + "intermediate.dense.bias", _ident, True),
-        "blocks.mlp.w_out": _Src(L + "output.dense.weight", _t2, True),
-        "blocks.mlp.b_out": _Src(L + "output.dense.bias", _ident, True),
-        "head.w": _Src("classifier.weight", _t2),
-        "head.b": _Src("classifier.bias"),
+        "patch_proj.w": _Src(E + "patch_embeddings.projection.weight", patch_fetch, invert=patch_invert),
+        "patch_proj.b": _Src(E + "patch_embeddings.projection.bias", invert=_inv_ident),
+        "cls_token": _Src(E + "cls_token", lambda r, i, s: r((slice(0, 1), slice(0, 1), i[0]))[0, 0],
+                          invert=lambda a: a[None, None, :]),
+        "pos_embed": _Src(E + "position_embeddings", lambda r, i, s: r((slice(0, 1), i[0], i[1]))[0],
+                          invert=lambda a: a[None]),
+        "lnf_scale": _Src("layernorm.weight", invert=_inv_ident),
+        "lnf_bias": _Src("layernorm.bias", invert=_inv_ident),
+        "blocks.ln1_scale": _Src(L + "layernorm_before.weight", _ident, True, _inv_ident),
+        "blocks.ln1_bias": _Src(L + "layernorm_before.bias", _ident, True, _inv_ident),
+        "blocks.ln2_scale": _Src(L + "layernorm_after.weight", _ident, True, _inv_ident),
+        "blocks.ln2_bias": _Src(L + "layernorm_after.bias", _ident, True, _inv_ident),
+        "blocks.attn.wq": _Src(L + "attention.attention.query.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wk": _Src(L + "attention.attention.key.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wv": _Src(L + "attention.attention.value.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.bq": _Src(L + "attention.attention.query.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bk": _Src(L + "attention.attention.key.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bv": _Src(L + "attention.attention.value.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.wo": _Src(L + "attention.output.dense.weight", _oproj(h), True, _inv_oproj),
+        "blocks.attn.bo": _Src(L + "attention.output.dense.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_in": _Src(L + "intermediate.dense.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_in": _Src(L + "intermediate.dense.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_out": _Src(L + "output.dense.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_out": _Src(L + "output.dense.bias", _ident, True, _inv_ident),
+        "head.w": _Src("classifier.weight", _t2, invert=_inv_t2),
+        "head.b": _Src("classifier.bias", invert=_inv_ident),
     }
 
 
@@ -389,41 +402,43 @@ def _t5_specs(config) -> dict[str, _Src]:
     E = "encoder.block.{i}.layer."
     D = "decoder.block.{i}.layer."
     m = {
-        "embed": _Src("shared.weight"),
+        "embed": _Src("shared.weight", invert=_inv_ident),
         "enc_rel_bias": _Src(
-            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+            invert=_inv_ident,
         ),
         "dec_rel_bias": _Src(
-            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight",
+            invert=_inv_ident,
         ),
-        "enc_final_norm": _Src("encoder.final_layer_norm.weight", _minus1),
-        "dec_final_norm": _Src("decoder.final_layer_norm.weight", _minus1),
-        "encoder.attn_norm": _Src(E + "0.layer_norm.weight", _minus1, True),
-        "encoder.attn.wq": _Src(E + "0.SelfAttention.q.weight", _qkv(h), True),
-        "encoder.attn.wk": _Src(E + "0.SelfAttention.k.weight", _qkv(h), True),
-        "encoder.attn.wv": _Src(E + "0.SelfAttention.v.weight", _qkv(h), True),
-        "encoder.attn.wo": _Src(E + "0.SelfAttention.o.weight", _oproj(h), True),
-        "encoder.mlp_norm": _Src(E + "1.layer_norm.weight", _minus1, True),
-        "encoder.mlp.w_gate": _Src(E + "1.DenseReluDense.wi_0.weight", _t2, True),
-        "encoder.mlp.w_up": _Src(E + "1.DenseReluDense.wi_1.weight", _t2, True),
-        "encoder.mlp.w_down": _Src(E + "1.DenseReluDense.wo.weight", _t2, True),
-        "decoder.self_norm": _Src(D + "0.layer_norm.weight", _minus1, True),
-        "decoder.self_attn.wq": _Src(D + "0.SelfAttention.q.weight", _qkv(h), True),
-        "decoder.self_attn.wk": _Src(D + "0.SelfAttention.k.weight", _qkv(h), True),
-        "decoder.self_attn.wv": _Src(D + "0.SelfAttention.v.weight", _qkv(h), True),
-        "decoder.self_attn.wo": _Src(D + "0.SelfAttention.o.weight", _oproj(h), True),
-        "decoder.cross_norm": _Src(D + "1.layer_norm.weight", _minus1, True),
-        "decoder.cross_attn.wq": _Src(D + "1.EncDecAttention.q.weight", _qkv(h), True),
-        "decoder.cross_attn.wk": _Src(D + "1.EncDecAttention.k.weight", _qkv(h), True),
-        "decoder.cross_attn.wv": _Src(D + "1.EncDecAttention.v.weight", _qkv(h), True),
-        "decoder.cross_attn.wo": _Src(D + "1.EncDecAttention.o.weight", _oproj(h), True),
-        "decoder.mlp_norm": _Src(D + "2.layer_norm.weight", _minus1, True),
-        "decoder.mlp.w_gate": _Src(D + "2.DenseReluDense.wi_0.weight", _t2, True),
-        "decoder.mlp.w_up": _Src(D + "2.DenseReluDense.wi_1.weight", _t2, True),
-        "decoder.mlp.w_down": _Src(D + "2.DenseReluDense.wo.weight", _t2, True),
+        "enc_final_norm": _Src("encoder.final_layer_norm.weight", _minus1, invert=_inv_plus1),
+        "dec_final_norm": _Src("decoder.final_layer_norm.weight", _minus1, invert=_inv_plus1),
+        "encoder.attn_norm": _Src(E + "0.layer_norm.weight", _minus1, True, _inv_plus1),
+        "encoder.attn.wq": _Src(E + "0.SelfAttention.q.weight", _qkv(h), True, _inv_qkv),
+        "encoder.attn.wk": _Src(E + "0.SelfAttention.k.weight", _qkv(h), True, _inv_qkv),
+        "encoder.attn.wv": _Src(E + "0.SelfAttention.v.weight", _qkv(h), True, _inv_qkv),
+        "encoder.attn.wo": _Src(E + "0.SelfAttention.o.weight", _oproj(h), True, _inv_oproj),
+        "encoder.mlp_norm": _Src(E + "1.layer_norm.weight", _minus1, True, _inv_plus1),
+        "encoder.mlp.w_gate": _Src(E + "1.DenseReluDense.wi_0.weight", _t2, True, _inv_t2),
+        "encoder.mlp.w_up": _Src(E + "1.DenseReluDense.wi_1.weight", _t2, True, _inv_t2),
+        "encoder.mlp.w_down": _Src(E + "1.DenseReluDense.wo.weight", _t2, True, _inv_t2),
+        "decoder.self_norm": _Src(D + "0.layer_norm.weight", _minus1, True, _inv_plus1),
+        "decoder.self_attn.wq": _Src(D + "0.SelfAttention.q.weight", _qkv(h), True, _inv_qkv),
+        "decoder.self_attn.wk": _Src(D + "0.SelfAttention.k.weight", _qkv(h), True, _inv_qkv),
+        "decoder.self_attn.wv": _Src(D + "0.SelfAttention.v.weight", _qkv(h), True, _inv_qkv),
+        "decoder.self_attn.wo": _Src(D + "0.SelfAttention.o.weight", _oproj(h), True, _inv_oproj),
+        "decoder.cross_norm": _Src(D + "1.layer_norm.weight", _minus1, True, _inv_plus1),
+        "decoder.cross_attn.wq": _Src(D + "1.EncDecAttention.q.weight", _qkv(h), True, _inv_qkv),
+        "decoder.cross_attn.wk": _Src(D + "1.EncDecAttention.k.weight", _qkv(h), True, _inv_qkv),
+        "decoder.cross_attn.wv": _Src(D + "1.EncDecAttention.v.weight", _qkv(h), True, _inv_qkv),
+        "decoder.cross_attn.wo": _Src(D + "1.EncDecAttention.o.weight", _oproj(h), True, _inv_oproj),
+        "decoder.mlp_norm": _Src(D + "2.layer_norm.weight", _minus1, True, _inv_plus1),
+        "decoder.mlp.w_gate": _Src(D + "2.DenseReluDense.wi_0.weight", _t2, True, _inv_t2),
+        "decoder.mlp.w_up": _Src(D + "2.DenseReluDense.wi_1.weight", _t2, True, _inv_t2),
+        "decoder.mlp.w_down": _Src(D + "2.DenseReluDense.wo.weight", _t2, True, _inv_t2),
     }
     if not config.tie_embeddings:
-        m["lm_head"] = _Src("lm_head.weight", _t2)
+        m["lm_head"] = _Src("lm_head.weight", _t2, invert=_inv_t2)
     return m
 
 
@@ -875,9 +890,73 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
             "hidden_act": "silu",
             "torch_dtype": torch_dtype,
         }
-    raise ValueError(
-        f"config_to_hf supports the llama family only (got {family!r})."
-    )
+    if family == "bert":
+        return {
+            "model_type": "bert",
+            "architectures": ["BertForSequenceClassification"],
+            "vocab_size": config.vocab_size,
+            "hidden_size": config.d_model,
+            "num_hidden_layers": config.n_layers,
+            "num_attention_heads": config.num_heads,
+            "intermediate_size": config.d_ff,
+            "max_position_embeddings": config.max_seq_len,
+            "type_vocab_size": config.type_vocab_size,
+            "layer_norm_eps": config.norm_eps,
+            "num_labels": config.num_labels,
+            "id2label": {str(i): f"LABEL_{i}" for i in range(config.num_labels)},
+            "torch_dtype": torch_dtype,
+        }
+    if family == "vit":
+        return {
+            "model_type": "vit",
+            "architectures": ["ViTForImageClassification"],
+            "image_size": config.image_size,
+            "patch_size": config.patch_size,
+            "hidden_size": config.d_model,
+            "num_hidden_layers": config.n_layers,
+            "num_attention_heads": config.num_heads,
+            "intermediate_size": config.d_ff,
+            "num_channels": config.channels,
+            "layer_norm_eps": config.norm_eps,
+            "num_labels": config.num_classes,
+            "id2label": {str(i): f"LABEL_{i}" for i in range(config.num_classes)},
+            "torch_dtype": torch_dtype,
+        }
+    if family == "t5":
+        return {
+            "model_type": "t5",
+            "architectures": ["T5ForConditionalGeneration"],
+            "vocab_size": config.vocab_size,
+            "d_model": config.d_model,
+            "d_kv": config.head_dim,
+            "d_ff": config.d_ff,
+            "num_layers": config.n_encoder_layers,
+            "num_decoder_layers": config.n_decoder_layers,
+            "num_heads": config.num_heads,
+            "relative_attention_num_buckets": config.rel_buckets,
+            "relative_attention_max_distance": config.rel_max_distance,
+            "layer_norm_epsilon": config.norm_eps,
+            "feed_forward_proj": "gated-gelu",
+            "tie_word_embeddings": config.tie_embeddings,
+            "is_encoder_decoder": True,
+            "torch_dtype": torch_dtype,
+        }
+    if family == "gpt":
+        return {
+            "model_type": "gpt2",
+            "architectures": ["GPT2LMHeadModel"],
+            "vocab_size": config.vocab_size,
+            "n_embd": config.d_model,
+            "n_layer": config.n_layers,
+            "n_head": config.num_heads,
+            "n_inner": config.d_ff,
+            "n_positions": config.max_seq_len,
+            "n_ctx": config.max_seq_len,
+            "layer_norm_epsilon": config.norm_eps,
+            "tie_word_embeddings": config.tie_embeddings,
+            "torch_dtype": torch_dtype,
+        }
+    raise ValueError(f"config_to_hf has no branch for family {family!r}.")
 
 
 def save_pretrained(
@@ -904,13 +983,14 @@ def save_pretrained(
             "utils.quantization.dequantize_pytree first."
         )
     specs_map = hf_key_specs(family, config)
-    missing = [k for k, s in specs_map.items() if s.invert is None]
-    if missing:
-        raise NotImplementedError(
-            f"Export has no inverse transform for leaves {missing[:4]} "
-            f"(family {family!r}) — dense llama models export; MoE/mixtral "
-            "and the other families are load-only for now."
-        )
+    if family != "gpt":
+        missing = [k for k, s in specs_map.items() if s.invert is None]
+        if missing:
+            raise NotImplementedError(
+                f"Export has no inverse transform for leaves {missing[:4]} "
+                f"(family {family!r}); MoE/mixtral params are load-only for "
+                "now."
+            )
 
     def leaf_for(dotted: str) -> Any:
         node: Any = params
@@ -928,6 +1008,9 @@ def save_pretrained(
         json.dump(config_to_hf(family, config, torch_dtype=dtype_name), f, indent=2)
 
     def tensors() -> Any:
+        if family == "gpt":
+            yield from _gpt2_export_tensors(config, params, leaf_for)
+            return
         for key, src in specs_map.items():
             leaf = leaf_for(key)
             if src.per_layer:
@@ -941,6 +1024,21 @@ def save_pretrained(
                 yield src.key, src.invert(np.asarray(jax.device_get(leaf)))
 
     from safetensors.numpy import save_file
+
+    # Task-model checkpoints prefix the backbone ("bert.embeddings...",
+    # "vit.encoder...") while head weights stay bare; transformers refuses
+    # the load otherwise. The maps here are canonical/unprefixed, so the
+    # prefix is applied on the way out.
+    prefix, exempt = {
+        "bert": ("bert.", ("classifier.",)),
+        "vit": ("vit.", ("classifier.",)),
+        "gpt": ("transformer.", ("lm_head.",)),
+    }.get(family, ("", ()))
+
+    def exported_name(name: str) -> str:
+        if prefix and not name.startswith(exempt):
+            return prefix + name
+        return name
 
     weight_map: dict[str, str] = {}
     shard: dict[str, np.ndarray] = {}
@@ -961,6 +1059,7 @@ def save_pretrained(
 
     total = 0
     for name, arr in tensors():
+        name = exported_name(name)
         if shard_bytes + arr.nbytes > max_shard_bytes and shard:
             flush()
         shard[name] = arr
@@ -973,3 +1072,46 @@ def save_pretrained(
             {"metadata": {"total_size": total}, "weight_map": weight_map}, f
         )
     return path
+
+
+def _gpt2_export_tensors(config, params, leaf_for):
+    """GPT-2 export: unlike the 1:1 families, q/k/v re-FUSE into Conv1D
+    ``c_attn`` (weights already (in, out) — concatenation, no transpose)."""
+
+    def get(dotted):
+        return np.asarray(jax.device_get(leaf_for(dotted)))
+
+    yield "wte.weight", get("wte")
+    yield "wpe.weight", get("wpe")
+    yield "ln_f.weight", get("lnf_scale")
+    yield "ln_f.bias", get("lnf_bias")
+    if not config.tie_embeddings:
+        # Untied head: params["lm_head"] is (d, V); HF stores (V, d).
+        yield "lm_head.weight", np.ascontiguousarray(get("lm_head").T)
+    d = config.d_model
+    for i in range(config.n_layers):
+        L = f"h.{i}."
+        blk = {k: np.asarray(jax.device_get(leaf_for(f"blocks.{k}")[i]))
+               for k in ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias")}
+        yield L + "ln_1.weight", blk["ln1_scale"]
+        yield L + "ln_1.bias", blk["ln1_bias"]
+        yield L + "ln_2.weight", blk["ln2_scale"]
+        yield L + "ln_2.bias", blk["ln2_bias"]
+        attn = params["blocks"]["attn"]
+        wq, wk, wv = (np.asarray(jax.device_get(attn[k][i])).reshape(d, -1)
+                      for k in ("wq", "wk", "wv"))
+        yield L + "attn.c_attn.weight", np.ascontiguousarray(
+            np.concatenate([wq, wk, wv], axis=1)
+        )
+        bq, bk, bv = (np.asarray(jax.device_get(attn[k][i])).reshape(-1)
+                      for k in ("bq", "bk", "bv"))
+        yield L + "attn.c_attn.bias", np.concatenate([bq, bk, bv])
+        yield L + "attn.c_proj.weight", np.ascontiguousarray(
+            np.asarray(jax.device_get(attn["wo"][i])).reshape(-1, d)
+        )
+        yield L + "attn.c_proj.bias", np.asarray(jax.device_get(attn["bo"][i]))
+        mlp = params["blocks"]["mlp"]
+        yield L + "mlp.c_fc.weight", np.asarray(jax.device_get(mlp["w_in"][i]))
+        yield L + "mlp.c_fc.bias", np.asarray(jax.device_get(mlp["b_in"][i]))
+        yield L + "mlp.c_proj.weight", np.asarray(jax.device_get(mlp["w_out"][i]))
+        yield L + "mlp.c_proj.bias", np.asarray(jax.device_get(mlp["b_out"][i]))

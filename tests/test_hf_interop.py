@@ -360,3 +360,81 @@ def test_llama_bias_variants_rejected(tmp_path):
     json.dump({**base, "mlp_bias": True}, open(tmp_path / "config.json", "w"))
     with pytest.raises(ValueError, match="mlp_bias"):
         hf.from_hf_config(str(tmp_path))
+
+
+class TestAllFamilyExports:
+    """Round-trip every exportable family: transformers must load our export
+    and reproduce the original logits."""
+
+    def _round_trip(self, model, repo, tmp_path, family_cls, fwd):
+        mesh = build_mesh(MeshConfig())
+        loaded = hf.load_pretrained(repo, mesh=mesh)
+        out_dir = str(tmp_path / "exp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        reloaded = family_cls.from_pretrained(out_dir).eval()
+        with torch.no_grad():
+            orig = fwd(model)
+            ours = fwd(reloaded)
+        np.testing.assert_allclose(ours, orig, atol=5e-5, rtol=2e-4)
+
+    def test_gpt2(self, tmp_path):
+        cfg = transformers.GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+        torch.manual_seed(8)
+        model = transformers.GPT2LMHeadModel(cfg).eval()
+        repo = _save_hf(model, tmp_path, "g")
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        self._round_trip(model, repo, tmp_path, transformers.GPT2LMHeadModel,
+                         lambda m: m(tokens).logits.numpy())
+
+    def test_bert(self, tmp_path):
+        cfg = transformers.BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                                      num_attention_heads=4, intermediate_size=64,
+                                      max_position_embeddings=64, num_labels=3)
+        torch.manual_seed(9)
+        model = transformers.BertForSequenceClassification(cfg).eval()
+        repo = _save_hf(model, tmp_path, "b")
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        self._round_trip(model, repo, tmp_path, transformers.BertForSequenceClassification,
+                         lambda m: m(tokens).logits.numpy())
+
+    def test_vit(self, tmp_path):
+        cfg = transformers.ViTConfig(image_size=32, patch_size=8, hidden_size=32,
+                                     num_hidden_layers=2, num_attention_heads=4,
+                                     intermediate_size=64, num_labels=5)
+        torch.manual_seed(10)
+        model = transformers.ViTForImageClassification(cfg).eval()
+        repo = _save_hf(model, tmp_path, "v")
+        images = torch.rand(2, 3, 32, 32)
+        self._round_trip(model, repo, tmp_path, transformers.ViTForImageClassification,
+                         lambda m: m(images).logits.numpy())
+
+    def test_t5(self, tmp_path):
+        cfg = transformers.T5Config(vocab_size=128, d_model=32, d_kv=8, d_ff=64,
+                                    num_layers=2, num_decoder_layers=2, num_heads=4,
+                                    feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+                                    relative_attention_num_buckets=8,
+                                    relative_attention_max_distance=16)
+        torch.manual_seed(11)
+        model = transformers.T5ForConditionalGeneration(cfg).eval()
+        repo = _save_hf(model, tmp_path, "t")
+        enc = torch.arange(16).reshape(2, 8) % 128
+        dec = (torch.arange(12).reshape(2, 6) * 3) % 128
+        self._round_trip(model, repo, tmp_path, transformers.T5ForConditionalGeneration,
+                         lambda m: m(input_ids=enc, decoder_input_ids=dec).logits.numpy())
+
+
+def test_gpt2_untied_head_exports(tmp_path):
+    """A natively-built untied-head GPT must export its lm_head (and config)
+    rather than silently re-tying on reload."""
+    from accelerate_tpu.models import gpt as gpt_mod
+
+    config = gpt_mod.GPTConfig.tiny(vocab_size=64, max_seq_len=32, tie_embeddings=False)
+    params = gpt_mod.init(jax.random.PRNGKey(0), config)
+    out = str(tmp_path / "g")
+    hf.save_pretrained(out, "gpt", config, params)
+    reloaded = transformers.GPT2LMHeadModel.from_pretrained(out).eval()
+    tokens = np.arange(16, dtype=np.int32).reshape(2, 8) % 64
+    ours = np.asarray(gpt_mod.forward(params, jnp.asarray(tokens), config))
+    with torch.no_grad():
+        theirs = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
