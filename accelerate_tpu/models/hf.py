@@ -290,6 +290,10 @@ def _gpt2_specs(config) -> dict[str, _Src]:
         "blocks.mlp.w_out": _Src(L + "mlp.c_proj.weight", _ident, True),
         "blocks.mlp.b_out": _Src(L + "mlp.c_proj.bias", _ident, True),
     }
+    if not config.tie_embeddings:
+        # Untied head (this framework's own exports write one): HF (V, d)
+        # -> (d, V).
+        m["lm_head"] = _Src("lm_head.weight", _t2)
     return m
 
 
@@ -563,7 +567,7 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             d_ff=config.get("n_inner") or 4 * d,
             max_seq_len=config.get("n_positions", 1024),
             norm_eps=config.get("layer_norm_epsilon", 1e-5),
-            tie_embeddings=True,
+            tie_embeddings=config.get("tie_word_embeddings", True),
         )
     if mt == "bert":
         from .bert import BertConfig
@@ -870,7 +874,7 @@ def load_hf_checkpoint(
 # ----------------------------------------------------------------- export
 def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> dict:
     """Family config -> HF ``config.json`` payload (inverse of
-    `from_hf_config`; llama only so far — the flagship migration loop)."""
+    `from_hf_config`) for every exportable family."""
     if family == "llama":
         qwen = getattr(config, "attn_bias", False)
         return {

@@ -1,0 +1,105 @@
+"""Feature example: the full HF migration loop.
+
+Load a Hugging Face repo with zero key mapping, fine-tune it with the
+Accelerator's compiled train step, and export the result back to HF layout
+so `transformers.from_pretrained` picks it up unchanged — ingest, train,
+return. (Reference analog: `from_pretrained` + `accelerator.prepare` +
+`save_pretrained`; here the tensor-name translation both ways is built in.)
+
+Run: python examples/by_feature/finetune_from_hf.py --hf_repo /path/to/repo
+     (no --hf_repo: synthesizes a tiny llama repo first)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.models import llama
+from accelerate_tpu.state import AcceleratorState
+
+
+def _make_tiny_repo(path: str) -> str:
+    """Synthesize a tiny HF-llama repo (stands in for a real download)."""
+    import torch
+    import transformers
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(cfg).save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--hf_repo", default=None, help="Local HF repo dir")
+    parser.add_argument("--out_dir", default=None, help="Where to export")
+    parser.add_argument("--steps", type=int, default=30)
+    args = parser.parse_args(argv)
+
+    # Fixed default output path (overwritten per run) and a self-cleaning
+    # synth dir: repeated runs must not accumulate checkpoints in /tmp.
+    work = tempfile.TemporaryDirectory(prefix="atx_finetune_src_")
+    repo = args.hf_repo or _make_tiny_repo(os.path.join(work.name, "src_repo"))
+    out_dir = args.out_dir or "/tmp/atx_finetuned_example"
+
+    AcceleratorState._reset_state()
+    acc = atx.Accelerator(seed=0)
+
+    # 1. Ingest: config.json -> family config, weights streamed in sharded.
+    loaded = atx.load_pretrained(repo, mesh=acc.mesh, min_weight_size=1)
+    if loaded.family != "llama":
+        raise SystemExit(
+            f"this example fine-tunes the llama family; {repo} is "
+            f"{loaded.family!r} — adapt the loss/forward calls for it"
+        )
+    config = loaded.config
+
+    # 2. Fine-tune on a toy corpus with the compiled train step.
+    state = acc.create_train_state(loaded.params, optax.adamw(1e-3))
+    step = acc.make_train_step(lambda p, b, r: llama.loss_fn(p, b, config, r))
+    rng = np.random.RandomState(0)
+    batch = jax.device_put(
+        {"input_ids": rng.randint(0, config.vocab_size, (8, 32)).astype(np.int32)}
+    )
+    first = last = None
+    for _ in range(args.steps):
+        state, metrics = step(state, batch)
+        last = float(np.asarray(metrics["loss"]))
+        first = first if first is not None else last
+
+    # 3. Export back to HF layout: transformers loads it unchanged.
+    atx.save_pretrained(out_dir, loaded.family, config, state.params)
+    print(f"loss {first:.3f} -> {last:.3f} over {args.steps} steps")
+    print(f"exported fine-tuned model to {out_dir} (HF layout)")
+
+    import torch
+    import transformers
+
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir).eval()
+    tokens = np.arange(16, dtype=np.int32).reshape(2, 8) % config.vocab_size
+    ours = np.asarray(llama.forward(state.params, tokens, config))
+    with torch.no_grad():
+        theirs = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+    drift = float(np.abs(ours - theirs).max())
+    print(f"transformers reload max |logit diff|: {drift:.2e}")
+    return drift if last < first else float("inf")
+
+
+if __name__ == "__main__":
+    if main() > 1e-3:
+        raise SystemExit("fine-tune/export loop failed")

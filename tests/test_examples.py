@@ -143,3 +143,10 @@ def test_by_feature_checkpointing(tmp_path):
     module = _load("by_feature/checkpointing")
     rc = module.main(["--ckpt_dir", str(tmp_path / "ckpt")])
     assert rc == 0.0
+
+
+def test_by_feature_finetune_from_hf():
+    pytest.importorskip("transformers")
+    module = _load("by_feature/finetune_from_hf")
+    drift = module.main(["--steps", "10"])
+    assert drift < 1e-3

@@ -438,3 +438,22 @@ def test_gpt2_untied_head_exports(tmp_path):
     with torch.no_grad():
         theirs = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+
+def test_gpt2_untied_export_reingests(tmp_path):
+    """Our own untied-GPT export must round-trip through load_pretrained
+    with the trained head intact (not silently re-tied)."""
+    from accelerate_tpu.models import gpt as gpt_mod
+
+    config = gpt_mod.GPTConfig.tiny(vocab_size=64, max_seq_len=32, tie_embeddings=False)
+    params = gpt_mod.init(jax.random.PRNGKey(3), config)
+    out = str(tmp_path / "g")
+    hf.save_pretrained(out, "gpt", config, params)
+    loaded = hf.load_pretrained(out, mesh=build_mesh(MeshConfig()))
+    assert not loaded.config.tie_embeddings
+    tokens = np.arange(16, dtype=np.int32).reshape(2, 8) % 64
+    ours = np.asarray(gpt_mod.forward(params, jnp.asarray(tokens), config))
+    theirs = np.asarray(
+        gpt_mod.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+    )
+    np.testing.assert_allclose(theirs, ours, atol=1e-5, rtol=1e-5)
