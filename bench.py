@@ -13,10 +13,35 @@ tiny config just to prove the path end-to-end.
 from __future__ import annotations
 
 import json
+import signal
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+# Partial results accumulate here; a timeout kill (SIGTERM) still emits one
+# valid JSON line with whatever finished instead of losing the whole run
+# (the 8B big-model phase makes the full bench ~20+ min).
+_RESULT: dict = {}
+
+
+def _emit_partial(signum, frame):  # pragma: no cover - signal path
+    # One-shot: disarm first so a signal racing the normal final print can
+    # never produce a second JSON line (the output contract is ONE line).
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    try:
+        if _RESULT:
+            _RESULT.setdefault("partial", True)
+            print(json.dumps(_RESULT), flush=True)
+    finally:
+        # sys.exit in finally: even a BrokenPipeError from the print must
+        # not fall back into the interrupted frame's `except Exception`
+        # (which would swallow the shutdown and keep the bench running).
+        sys.exit(1)
+
+
+signal.signal(signal.SIGTERM, _emit_partial)
 
 # bf16 peak FLOPs per chip by device kind (dense matmul).
 _PEAK_FLOPS = {
@@ -98,11 +123,24 @@ def main() -> None:
     # Free the Llama state/opt buffers before the BERT measurement — both
     # would not fit HBM together.
     final_loss = round(float(metrics["loss"]), 4)
+    _RESULT.update(
+        {
+            "metric": "llama_train_mfu",
+            "value": round(mfu, 4),
+            "unit": "MFU",
+            "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_time_ms": round(1000 * dt / steps, 2),
+            "params": n_params,
+            "device": getattr(device, "device_kind", str(device)),
+            "loss": final_loss,
+        }
+    )
     state, batch, metrics = acc.free_memory(state, batch, metrics)
     try:
-        bert_stats = _bench_bert(on_tpu, fetch_latency)
+        _RESULT.update(_bench_bert(on_tpu, fetch_latency))
     except Exception as e:  # never lose the headline MFU number
-        bert_stats = {"bert_error": f"{type(e).__name__}: {e}"[:200]}
+        _RESULT["bert_error"] = f"{type(e).__name__}: {e}"[:200]
     if on_tpu:
         extra_benches = [
             ("longctx", _bench_long_context),
@@ -114,26 +152,12 @@ def main() -> None:
         ]
         for name, fn in extra_benches:
             try:
-                bert_stats.update(fn())
+                _RESULT.update(fn())
             except Exception as e:  # keep the headline fields no matter what
-                bert_stats[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+                _RESULT[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    print(
-        json.dumps(
-            {
-                "metric": "llama_train_mfu",
-                "value": round(mfu, 4),
-                "unit": "MFU",
-                "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
-                "tokens_per_sec": round(tokens_per_sec, 1),
-                "step_time_ms": round(1000 * dt / steps, 2),
-                "params": n_params,
-                "device": getattr(device, "device_kind", str(device)),
-                "loss": final_loss,
-                **bert_stats,
-            }
-        )
-    )
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)  # past the point of partials
+    print(json.dumps(_RESULT))
 
 
 def _timed_steps(step, state, batch, steps: int, warmup: int, fetch_latency: float | None = None):
