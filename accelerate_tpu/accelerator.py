@@ -49,7 +49,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .data.loader import DataLoader
 from .ops import collectives as _ops
 from .ops import fp8 as _fp8
-from .parallel.mesh import BATCH_AXES, MeshConfig, batch_sharding, data_parallel_size
+from .parallel.mesh import (
+    BATCH_AXES,
+    TENSOR_AXIS,
+    MeshConfig,
+    batch_sharding,
+    data_parallel_size,
+)
 from .parallel.sharding import (
     ShardingStrategy,
     infer_opt_specs,
@@ -160,7 +166,27 @@ class Accelerator:
         log_with: Any = None,
         seed: int | None = None,
     ) -> None:
+        from .utils.dataclasses import TensorParallelPlugin
+
+        if isinstance(strategy, TensorParallelPlugin) and (strategy.tp_size or 1) > 1:
+            # The plugin's tp_size is a mesh request: build (or validate) a
+            # mesh whose `tensor` axis matches it, the way the reference's TP
+            # plugin sizes its device sub-group (`utils/dataclasses.py:1863`).
+            if mesh_config is None and MeshConfig.from_env() is None:
+                mesh_config = MeshConfig(tensor=strategy.tp_size)
         self.state = AcceleratorState(mesh_config=mesh_config, mixed_precision=mixed_precision)
+        if (
+            isinstance(strategy, TensorParallelPlugin)
+            and (strategy.tp_size or 1) > 1
+            and self.state.mesh.shape[TENSOR_AXIS] != strategy.tp_size
+        ):
+            raise ValueError(
+                f"TensorParallelPlugin(tp_size={strategy.tp_size}) does not "
+                f"match the active mesh's tensor axis "
+                f"({self.state.mesh.shape[TENSOR_AXIS]}); size the mesh's "
+                "`tensor` axis to tp_size (MeshConfig(tensor=...) / "
+                "ATX_MESH_TENSOR)."
+            )
         self.process_state = ProcessState()
         if gradient_accumulation_plugin is None:
             gradient_accumulation_plugin = GradientAccumulationPlugin(

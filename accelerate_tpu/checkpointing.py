@@ -350,7 +350,13 @@ def _clear_stale_files(directory: str, pattern: re.Pattern) -> None:
     if os.path.isdir(directory):
         for name in os.listdir(directory):
             if pattern.match(name):
-                os.remove(os.path.join(directory, name))
+                try:
+                    os.remove(os.path.join(directory, name))
+                except FileNotFoundError:
+                    # save_on_each_node on a *shared* filesystem (harmless-
+                    # redundant config): several processes clear the same dir
+                    # concurrently; losing the race is fine.
+                    pass
 
 
 def _clear_stale_shard_files(directory: str, process_state: Any | None = None) -> None:
@@ -521,7 +527,10 @@ def save_state(
     if jax.process_count() > 1:
         accelerator.process_state.wait_for_everyone()
     proc = jax.process_index()
-    if proc == 0:
+    if proc == 0 or accelerator.project_config.save_on_each_node:
+        # save_on_each_node: every process has its own filesystem, so each
+        # resolves (and later writes) locally; with automatic naming the
+        # broadcast below still forces process 0's choice everywhere.
         save_dir = _resolve_save_dir(accelerator, output_dir)
     else:
         save_dir = None
@@ -535,7 +544,10 @@ def save_state(
     # Same shrink-hosts staleness applies to per-process RNG files and
     # per-index custom-object pickles: a 2-host save followed by a 1-host
     # re-save must not leave rng_state_1.json for a later 2-host load.
-    if proc == 0:
+    if proc == 0 or accelerator.project_config.save_on_each_node:
+        # Per-node filesystems: each process clears its own local dir. On a
+        # shared FS this is redundant but safe: ALL clears complete before
+        # ANY write — _clear_stale_shard_files below ends in a barrier.
         _clear_stale_files(save_dir, _STATE_FILE_PATTERN)
     _clear_stale_shard_files(os.path.join(save_dir, MODEL_DIR), accelerator.process_state)
 
@@ -558,7 +570,12 @@ def save_state(
     with open(os.path.join(save_dir, RNG_FILE.format(proc=proc)), "w") as f:
         json.dump(_rng_state_bundle(accelerator), f)
 
-    if proc == 0:
+    # On a shared filesystem only process 0 writes the process-agnostic
+    # artifacts (metadata, dataloader states, custom objects); with
+    # save_on_each_node every process writes them so each node's local
+    # directory is self-contained (reference `ProjectConfiguration.
+    # save_on_each_node`, consumed at `accelerator.py:2979,3129`).
+    if proc == 0 or accelerator.project_config.save_on_each_node:
         dls = list(dataloaders) if dataloaders is not None else accelerator._dataloaders
         dl_states = [dl.state_dict() for dl in dls]
         with open(os.path.join(save_dir, DATALOADER_FILE), "w") as f:

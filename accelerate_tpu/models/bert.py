@@ -121,7 +121,8 @@ def block_forward(
     attn = dot_product_attention(q, k, v, mask=mask)
     h = _dropout(attention_out(block["attn"], attn), config.dropout_rate, r1)
     x = layer_norm(x + h, block["attn_norm_scale"], block["attn_norm_bias"], config.norm_eps)
-    h = _dropout(mlp_gelu(block["mlp"], x), config.dropout_rate, r2)
+    # HF BERT's hidden_act="gelu" is the exact erf gelu, not the tanh approx.
+    h = _dropout(mlp_gelu(block["mlp"], x, approximate=False), config.dropout_rate, r2)
     return layer_norm(x + h, block["mlp_norm_scale"], block["mlp_norm_bias"], config.norm_eps)
 
 

@@ -477,6 +477,38 @@ def _num_labels(config: dict, default: int = 2) -> int:
     return default
 
 
+def _parse_rope_scaling(rs: dict | None, RopeScaling: Any) -> Any:
+    """HF ``rope_scaling`` dict -> layers.RopeScaling (or None).
+
+    Implements the two schemes real llama-family checkpoints ship:
+    ``llama3`` (every Llama-3.1/3.2 repo) and ``linear`` position
+    interpolation; anything else (yarn, dynamic-NTK, longrope) still fails
+    loudly — those change the frequency tables per sequence length and are
+    not implemented."""
+    if rs is None:
+        return None
+    rtype = rs.get("rope_type") or rs.get("type") or "default"
+    if rtype == "default":
+        return None
+    if rtype == "llama3":
+        return RopeScaling(
+            rope_type="llama3",
+            factor=float(rs["factor"]),
+            low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(
+                rs.get("original_max_position_embeddings", 8192)
+            ),
+        )
+    if rtype == "linear":
+        return RopeScaling(rope_type="linear", factor=float(rs["factor"]))
+    raise ValueError(
+        f"This checkpoint uses rope_scaling rope_type={rtype!r}; implemented "
+        "types: 'llama3' (Llama-3.1+), 'linear'. Loading with plain RoPE "
+        "would silently diverge from the original model."
+    )
+
+
 def from_hf_config(config: Any) -> tuple[str, Any]:
     """Translate an HF ``config.json`` (dict, file path, or repo dir) into
     ``(family, FamilyConfig)`` for this framework's model zoo."""
@@ -488,19 +520,22 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             config = json.load(f)
     mt = config.get("model_type")
     if mt in ("llama", "mistral", "mixtral", "qwen2"):
+        from .layers import RopeScaling
         from .llama import LlamaConfig
 
         # Refuse architecture-affecting knobs this family doesn't implement:
         # loading would succeed but every forward pass would silently diverge
         # from transformers' output — the opposite of the parity contract.
-        if config.get("rope_scaling") is not None:
+        # (hidden_act is validated for the same reason: a llama variant with
+        # hidden_act="gelu" would load cleanly and silently diverge.)
+        act = config.get("hidden_act", "silu")
+        if act != "silu":
             raise ValueError(
-                "This checkpoint uses rope_scaling "
-                f"({config['rope_scaling'].get('rope_type') or config['rope_scaling'].get('type')!r}), "
-                "which the llama family here does not implement yet; logits "
-                "would silently diverge from the original model. Use a "
-                "non-rope-scaled checkpoint (e.g. Llama-3.0-style)."
+                f"This llama-family checkpoint uses hidden_act={act!r}; the "
+                "block here hardwires the standard silu/swiglu MLP — logits "
+                "would silently diverge if the activation were substituted."
             )
+        rope_scaling = _parse_rope_scaling(config.get("rope_scaling"), RopeScaling)
         # Community llama variants can carry q/k/v/o and MLP biases
         # (LlamaConfig.attention_bias / mlp_bias); the block here models
         # q/k/v biases only in the qwen2 layout — anything else would load
@@ -518,13 +553,36 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
                 "has bias-free MLPs — loading would silently drop tensors."
             )
         sliding = config.get("sliding_window")
-        if mt == "qwen2" and not config.get("use_sliding_window", False):
-            sliding = None  # qwen2 ships the field but disables the feature
-        if sliding:
+        if mt == "qwen2":
+            # HF qwen2 applies the window only to layers i >= max_window_layers
+            # (layer_types in Qwen2Config; default 28). Uniform SWA therefore
+            # means max_window_layers == 0; max_window_layers >= num layers
+            # means NO layer uses it (full attention everywhere).
+            mwl = config.get("max_window_layers", 28)
+            if not config.get("use_sliding_window", False):
+                sliding = None  # qwen2 ships the field but disables the feature
+            elif mwl >= config["num_hidden_layers"]:
+                sliding = None  # window enabled but banded past the last layer
+            elif mwl != 0:
+                # A mixed schedule (full attention below mwl, SWA above) would
+                # silently diverge on one band or the other; this family
+                # applies one attention pattern uniformly.
+                raise ValueError(
+                    "This qwen2 checkpoint enables sliding-window attention "
+                    f"on a subset of layers (max_window_layers={mwl} of "
+                    f"{config['num_hidden_layers']}); only uniform windows "
+                    "(max_window_layers=0) are implemented."
+                )
+        if mt == "mixtral" and sliding:
+            # Mixtral-8x7B-v0.1 ships sliding_window=4096 in some revisions
+            # but the released model was trained (and is served by
+            # transformers) with full attention when the context fits; the
+            # window composes with MoE untested here, so refuse loudly.
             raise ValueError(
-                "This checkpoint uses sliding-window attention "
-                f"(window={config['sliding_window']}), which this llama "
-                "family does not implement; logits would silently diverge."
+                "sliding_window on a mixtral checkpoint is not supported "
+                "(the MoE block + window composition is untested); edit the "
+                "config to sliding_window=null if the model was trained "
+                "with full attention."
             )
 
         return "llama", LlamaConfig(
@@ -539,6 +597,8 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             head_dim=config.get("head_dim"),
             max_seq_len=config.get("max_position_embeddings", 8192),
             rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=rope_scaling,
+            sliding_window=sliding,
             norm_eps=config.get("rms_norm_eps", 1e-5),
             tie_embeddings=config.get("tie_word_embeddings", False),
             # Qwen2 = llama block + q/k/v biases.
@@ -558,6 +618,13 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     if mt == "gpt2":
         from .gpt import GPTConfig
 
+        act = config.get("activation_function", "gelu_new")
+        if act != "gelu_new":
+            raise ValueError(
+                f"This GPT-2 checkpoint uses activation_function={act!r}; "
+                "the block here hardwires gelu_new (the tanh approximation) "
+                "— logits would silently diverge otherwise."
+            )
         d = config["n_embd"]
         return "gpt", GPTConfig(
             vocab_size=config["vocab_size"],
@@ -572,6 +639,13 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     if mt == "bert":
         from .bert import BertConfig
 
+        act = config.get("hidden_act", "gelu")
+        if act != "gelu":
+            raise ValueError(
+                f"This BERT checkpoint uses hidden_act={act!r}; the block "
+                "here hardwires the exact-erf gelu — logits would silently "
+                "diverge otherwise."
+            )
         return "bert", BertConfig(
             vocab_size=config["vocab_size"],
             d_model=config["hidden_size"],
@@ -586,6 +660,13 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     if mt == "vit":
         from .vit import ViTConfig
 
+        act = config.get("hidden_act", "gelu")
+        if act != "gelu":
+            raise ValueError(
+                f"This ViT checkpoint uses hidden_act={act!r}; the block "
+                "here hardwires the exact-erf gelu — logits would silently "
+                "diverge otherwise."
+            )
         return "vit", ViTConfig(
             image_size=config.get("image_size", 224),
             patch_size=config.get("patch_size", 16),
@@ -600,12 +681,12 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
         from .t5 import T5Config
 
         ff_proj = config.get("feed_forward_proj", "relu")
-        if "gated" not in ff_proj:
+        if ff_proj != "gated-gelu":
             raise ValueError(
-                f"This T5 checkpoint uses feed_forward_proj={ff_proj!r} (the "
-                "original ungated relu MLP); the t5 family here implements "
-                "the v1.1 gated-gelu layout only — use a google/t5-v1_1-* "
-                "style checkpoint."
+                f"This T5 checkpoint uses feed_forward_proj={ff_proj!r}; the "
+                "t5 family here implements the v1.1 gated-gelu layout only "
+                "(ungated relu and gated-silu would silently diverge) — use "
+                "a google/t5-v1_1-* style checkpoint."
             )
         return "t5", T5Config(
             vocab_size=config["vocab_size"],
@@ -877,9 +958,19 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
     `from_hf_config`) for every exportable family."""
     if family == "llama":
         qwen = getattr(config, "attn_bias", False)
-        return {
-            "model_type": "qwen2" if qwen else "llama",
-            "architectures": ["Qwen2ForCausalLM" if qwen else "LlamaForCausalLM"],
+        sliding = getattr(config, "sliding_window", None)
+        if qwen:
+            mt, arch = "qwen2", "Qwen2ForCausalLM"
+        elif sliding is not None:
+            # LlamaConfig (HF) has no sliding_window field; exporting a
+            # windowed model as model_type=llama would silently drop the
+            # window on reload. Mistral is the HF family with this layout.
+            mt, arch = "mistral", "MistralForCausalLM"
+        else:
+            mt, arch = "llama", "LlamaForCausalLM"
+        out = {
+            "model_type": mt,
+            "architectures": [arch],
             "vocab_size": config.vocab_size,
             "hidden_size": config.d_model,
             "intermediate_size": config.d_ff,
@@ -894,6 +985,24 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
             "hidden_act": "silu",
             "torch_dtype": torch_dtype,
         }
+        rs = getattr(config, "rope_scaling", None)
+        if rs is not None:
+            payload = {"rope_type": rs.rope_type, "factor": rs.factor}
+            if rs.rope_type == "llama3":
+                payload.update(
+                    low_freq_factor=rs.low_freq_factor,
+                    high_freq_factor=rs.high_freq_factor,
+                    original_max_position_embeddings=rs.original_max_position_embeddings,
+                )
+            out["rope_scaling"] = payload
+        if sliding is not None:
+            out["sliding_window"] = sliding
+            if qwen:
+                out["use_sliding_window"] = True
+                # 0 = every layer windowed (HF windows layers >= this index);
+                # n_layers here would silently disable SWA on reload.
+                out["max_window_layers"] = 0
+        return out
     if family == "bert":
         return {
             "model_type": "bert",

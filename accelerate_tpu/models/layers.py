@@ -79,9 +79,61 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-
 
 
 # ---------------------------------------------------------------------- rope
-def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> tuple[np.ndarray, np.ndarray]:
-    """Precomputed cos/sin tables, shape (max_len, head_dim/2), fp32."""
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Rotary-frequency rescaling (HF ``rope_scaling``), hashable so configs
+    carrying it stay valid jit static args / lru_cache keys.
+
+    ``rope_type``:
+      - ``"llama3"`` — Llama-3.1+ wavelength-banded rescale: low-frequency
+        (long-wavelength) components are slowed by ``factor``, high-frequency
+        ones kept, with a smooth ramp between the two bands (reference
+        semantics: transformers ``modeling_rope_utils._compute_llama3_parameters``).
+      - ``"linear"`` — position interpolation: every frequency divided by
+        ``factor``.
+    """
+
+    rope_type: str
+    factor: float
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_len: int,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Precomputed cos/sin tables, shape (max_len, head_dim/2), fp32.
+
+    Tables are built host-side in fp64 (they're tiny and computed once per
+    trace), so the scaled frequencies match transformers' fp32 tables to
+    rounding."""
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling is not None:
+        if scaling.rope_type == "linear":
+            inv_freq = inv_freq / scaling.factor
+        elif scaling.rope_type == "llama3":
+            old_len = scaling.original_max_position_embeddings
+            low_wavelen = old_len / scaling.low_freq_factor
+            high_wavelen = old_len / scaling.high_freq_factor
+            wavelen = 2.0 * np.pi / inv_freq
+            smooth = (old_len / wavelen - scaling.low_freq_factor) / (
+                scaling.high_freq_factor - scaling.low_freq_factor
+            )
+            smoothed = ((1.0 - smooth) / scaling.factor + smooth) * inv_freq
+            inv_freq = np.where(
+                wavelen > low_wavelen,
+                inv_freq / scaling.factor,
+                np.where(wavelen < high_wavelen, inv_freq, smoothed),
+            )
+        else:
+            raise ValueError(
+                f"Unimplemented rope_type {scaling.rope_type!r}; supported: "
+                "'llama3', 'linear'."
+            )
     t = np.arange(max_len, dtype=np.float64)
     freqs = np.outer(t, inv_freq)
     return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
@@ -241,9 +293,13 @@ def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) ->
     }
 
 
-def mlp_gelu(params: Params, x: jax.Array) -> jax.Array:
+def mlp_gelu(params: Params, x: jax.Array, *, approximate: bool = True) -> jax.Array:
+    """``approximate=True`` is GPT-2's tanh "gelu_new"; BERT/ViT use the
+    exact erf gelu (transformers ``ACT2FN["gelu"]``) — the two differ by up
+    to ~3e-3 at real activation scales, so the variant must match the
+    checkpoint's or logit parity quietly breaks."""
     h = matmul_einsum("bsd,df->bsf", x, params["w_in"]) + params["b_in"].astype(x.dtype)
-    h = jax.nn.gelu(h, approximate=True)
+    h = jax.nn.gelu(h, approximate=approximate)
     return matmul_einsum("bsf,fd->bsd", h, params["w_out"]) + params["b_out"].astype(x.dtype)
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from functools import partial
 from typing import Any
 
@@ -40,6 +41,7 @@ from .layers import (
     init_swiglu,
     rms_norm,
     remat_policy,
+    RopeScaling,
     rope_frequencies,
     swiglu,
     truncated_normal_init,
@@ -59,6 +61,12 @@ class LlamaConfig:
     head_dim: int | None = None
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # Rotary rescaling — Llama-3.1+ "llama3" banded rescale or "linear"
+    # position interpolation; None = plain RoPE.
+    rope_scaling: RopeScaling | None = None
+    # Mistral-style sliding-window attention: position i attends to keys in
+    # (i - sliding_window, i], uniformly across layers. None = full causal.
+    sliding_window: int | None = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = False
@@ -179,7 +187,51 @@ def init(rng: jax.Array, config: LlamaConfig, dtype=jnp.float32) -> Params:
 _remat_policy = remat_policy  # shared impl in layers.py
 
 
+def _rope_tables(config: LlamaConfig) -> tuple[jax.Array, jax.Array]:
+    cos_np, sin_np = rope_frequencies(
+        config.resolved_head_dim,
+        config.max_seq_len,
+        config.rope_theta,
+        scaling=config.rope_scaling,
+    )
+    return jnp.asarray(cos_np), jnp.asarray(sin_np)
+
+
+def _window_mask(
+    mask: jax.Array | None, positions: jax.Array, seq_len: int, window: int
+) -> jax.Array:
+    """Fold the sliding-window band into the (optional) user mask: key j is
+    visible from query position p iff ``p - j < window`` (HF Mistral
+    semantics — the window includes the current token; causality is applied
+    separately by the attention op). Returns a (B, S, T) boolean mask."""
+    j = jnp.arange(seq_len, dtype=jnp.int32)
+    win = (positions[:, :, None] - j[None, None, :]) < window
+    if mask is None:
+        return win
+    if mask.ndim == 2:
+        mask = mask[:, None, :]
+    return mask.astype(bool) & win
+
+
 def _attention(config: LlamaConfig, q, k, v, mask):
+    if config.sliding_window is not None and config.attention_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            f"sliding_window with attention_impl={config.attention_impl!r} "
+            "is not implemented (the band mask needs per-chunk plumbing); "
+            "use 'dot'."
+        )
+    if config.sliding_window is not None and config.attention_impl == "flash":
+        # flash_attention falls back to the unfused O(S^2) oracle whenever a
+        # full (B, S, T) mask is passed — at the long contexts windows exist
+        # to serve, that materializes the full logit matrix. Don't let that
+        # happen silently.
+        warnings.warn(
+            "sliding_window with attention_impl='flash' currently runs the "
+            "unfused O(S^2) attention path (the fused kernel has no band "
+            "support yet); expect oracle-level memory/speed at long sequence "
+            "lengths.",
+            stacklevel=3,
+        )
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
 
@@ -289,8 +341,9 @@ def forward(
         raise ValueError(f"sequence length {S} exceeds max_seq_len={config.max_seq_len}")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)
-    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    cos, sin = _rope_tables(config)
+    if config.sliding_window is not None:
+        mask = _window_mask(mask, positions, S, config.sliding_window)
 
     x = params["embed"][tokens]
 
@@ -349,12 +402,17 @@ def forward_with_cache(
     start = cache["length"]
     positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (B, T_new))
-    cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)
-    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    cos, sin = _rope_tables(config)
 
     # (B, T_new, max_len) attention mask: cached positions < start+1+i.
     cache_pos = jnp.arange(max_len, dtype=jnp.int32)
     mask = cache_pos[None, None, :] <= positions[:, :, None]
+    if config.sliding_window is not None:
+        # The cache is still a full ring-free buffer; the window is applied
+        # as a mask so positions older than (p - window) are invisible.
+        mask = mask & (
+            cache_pos[None, None, :] > positions[:, :, None] - config.sliding_window
+        )
 
     x = params["embed"][tokens]
 
@@ -463,9 +521,9 @@ def _offloaded_block_step(config: LlamaConfig):
     """Jitted per-layer step for the offloaded path, cached per config so
     repeated streamed forwards reuse the compilation."""
 
-    def step(block, x, cos, sin, positions):
+    def step(block, x, cos, sin, positions, mask):
         x, _aux = block_forward(
-            block, x, config=config, cos=cos, sin=sin, positions=positions, mask=None
+            block, x, config=config, cos=cos, sin=sin, positions=positions, mask=mask
         )
         return x
 
@@ -488,14 +546,18 @@ def forward_offloaded(
 
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    cos_np, sin_np = rope_frequencies(config.resolved_head_dim, config.max_seq_len, config.rope_theta)
-    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    cos, sin = _rope_tables(config)
+    mask = (
+        _window_mask(None, positions, S, config.sliding_window)
+        if config.sliding_window is not None
+        else None
+    )
     embed = jnp.asarray(params["embed"]).astype(compute_dtype)
     x = embed[tokens]
 
     block_step = _offloaded_block_step(config)
     x = streamed_scan(
-        lambda carry, block: block_step(block, carry, cos, sin, positions),
+        lambda carry, block: block_step(block, carry, cos, sin, positions, mask),
         x, params["blocks"],
         dtype=compute_dtype,
     )

@@ -150,7 +150,8 @@ def block_forward(block: Params, x: jax.Array, *, config: ViTConfig) -> jax.Arra
     q, k, v = attention_qkv(block["attn"], h)
     x = x + attention_out(block["attn"], dot_product_attention(q, k, v))
     h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
-    return x + mlp_gelu(block["mlp"], h)
+    # HF ViT's hidden_act="gelu" is the exact erf gelu, not the tanh approx.
+    return x + mlp_gelu(block["mlp"], h, approximate=False)
 
 
 def forward(params: Params, images: jax.Array, config: ViTConfig) -> jax.Array:

@@ -180,23 +180,27 @@ def llama_pipeline(
     ``forward(tokens [B,S], microbatch_size) -> logits [B,S,V]``.
     """
     from ..models import llama as _llama
-    from ..models.layers import rms_norm, rope_frequencies
+    from ..models.layers import rms_norm
 
-    cos_np, sin_np = rope_frequencies(
-        config.resolved_head_dim, config.max_seq_len, config.rope_theta
-    )
-    cos, sin = jnp.asarray(cos_np), jnp.asarray(sin_np)
+    # _rope_tables honours config.rope_scaling (Llama-3.1-style checkpoints
+    # would otherwise silently run plain RoPE through the pipeline path).
+    cos, sin = _llama._rope_tables(config)
 
     def stage_fn(stage_blocks: Any, x: jax.Array) -> jax.Array:
         B, S = x.shape[0], x.shape[1]
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        mask = (
+            _llama._window_mask(None, positions, S, config.sliding_window)
+            if getattr(config, "sliding_window", None) is not None
+            else None
+        )
         body = partial(
             _llama.block_forward,
             config=config,
             cos=cos,
             sin=sin,
             positions=positions,
-            mask=None,
+            mask=mask,
         )
 
         def scan_body(carry, block):

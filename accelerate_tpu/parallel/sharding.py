@@ -30,7 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..utils.dataclasses import FsdpPlugin, ShardingStrategyType
+from ..utils.dataclasses import FsdpPlugin, ShardingStrategyType, TensorParallelPlugin
 from .mesh import BATCH_AXES, FSDP_AXIS, TENSOR_AXIS
 
 Rules = Sequence[tuple[str, PartitionSpec]]
@@ -56,6 +56,24 @@ class ShardingStrategy:
             return cls(kind=ShardingStrategyType.DATA_PARALLEL, rules=rules)
         if isinstance(strategy, FsdpPlugin):
             return cls(kind=ShardingStrategyType.FSDP, rules=rules, fsdp=strategy)
+        if isinstance(strategy, TensorParallelPlugin):
+            if strategy.plan is not None:
+                from .tp import get_tp_plan
+
+                if rules:
+                    raise ValueError(
+                        "Pass either TensorParallelPlugin(plan=...) or "
+                        "explicit sharding_rules, not both — the plugin's "
+                        "named plan would silently shadow the rules."
+                    )
+                rules = tuple(get_tp_plan(strategy.plan))
+            elif not rules:
+                raise ValueError(
+                    "TENSOR_PARALLEL needs sharding rules: set "
+                    "TensorParallelPlugin(plan='<family>') (registered plans: "
+                    "parallel.tp.list_tp_plans()) or pass sharding_rules."
+                )
+            return cls(kind=ShardingStrategyType.TENSOR_PARALLEL, rules=rules)
         return cls(kind=ShardingStrategyType(str(strategy).upper()), rules=rules)
 
 

@@ -348,6 +348,290 @@ class TestQwen2Parity:
         np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
 
 
+class TestLlama31RopeScaling:
+    """Llama-3.1/3.2-style checkpoints: the `llama3` banded frequency rescale
+    must reproduce transformers' tables and logits (reference loads these
+    via its name-based loader, `utils/modeling.py:1787`)."""
+
+    _scaling = {
+        "rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 32,
+    }
+
+    def test_rope_tables_match_transformers(self):
+        from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+        from accelerate_tpu.models.layers import RopeScaling, rope_frequencies
+
+        cfg = transformers.LlamaConfig(
+            hidden_size=64, num_attention_heads=4, max_position_embeddings=128,
+            rope_theta=10000.0, rope_scaling=dict(self._scaling),
+        )
+        theirs_inv, _ = ROPE_INIT_FUNCTIONS["llama3"](cfg, device="cpu")
+        cos, _sin = rope_frequencies(
+            16, 128, 10000.0,
+            scaling=RopeScaling(
+                "llama3", 4.0, 1.0, 4.0, original_max_position_embeddings=32
+            ),
+        )
+        expected = np.cos(np.outer(np.arange(128), theirs_inv.numpy()))
+        np.testing.assert_allclose(cos, expected, atol=1e-6)
+
+    def test_forward_matches_transformers(self, tmp_path):
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            rope_scaling=dict(self._scaling), tie_word_embeddings=False,
+        )
+        torch.manual_seed(8)
+        model = transformers.LlamaForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "llama31")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.config.rope_scaling.rope_type == "llama3"
+        # S=64 spans positions past original_max_position_embeddings=32, so
+        # every frequency band (kept / scaled / smoothed) is exercised.
+        tokens = np.arange(128, dtype=np.int32).reshape(2, 64) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_linear_scaling_matches_transformers(self, tmp_path):
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            rope_scaling={"type": "linear", "factor": 2.0},  # old-style key
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(9)
+        model = transformers.LlamaForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "llamalin")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        assert loaded.config.rope_scaling.rope_type == "linear"
+        tokens = np.arange(96, dtype=np.int32).reshape(2, 48) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_export_round_trips_rope_scaling(self, tmp_path):
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            rope_scaling=dict(self._scaling), tie_word_embeddings=False,
+        )
+        torch.manual_seed(10)
+        model = transformers.LlamaForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "llama31src")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out_dir = str(tmp_path / "llama31exp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        exported = json.load(open(f"{out_dir}/config.json"))
+        assert exported["rope_scaling"]["rope_type"] == "llama3"
+        reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir).eval()
+        tokens = np.arange(96, dtype=np.int32).reshape(2, 48) % 128
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+    def test_unimplemented_rope_type_rejected(self, tmp_path):
+        base = {"model_type": "llama", "vocab_size": 64, "hidden_size": 16,
+                "intermediate_size": 32, "num_hidden_layers": 1,
+                "num_attention_heads": 2, "num_key_value_heads": 2,
+                "rope_scaling": {"rope_type": "yarn", "factor": 4.0}}
+        json.dump(base, open(tmp_path / "config.json", "w"))
+        with pytest.raises(ValueError, match="yarn"):
+            hf.from_hf_config(str(tmp_path))
+
+
+class TestMistralSlidingWindow:
+    """Published Mistral-7B configs all carry sliding_window; the window mask
+    must match transformers' eager-attention banding exactly."""
+
+    def _model(self, tmp_path, window=8):
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            sliding_window=window, attn_implementation="eager",
+        )
+        torch.manual_seed(11)
+        model = transformers.MistralForCausalLM(cfg).eval()
+        return model, _save_hf(model, tmp_path, "mistral")
+
+    def test_forward_matches_transformers(self, tmp_path):
+        model, repo = self._model(tmp_path)
+        mesh = build_mesh(MeshConfig(data=1, fsdp=4, tensor=2))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        assert loaded.config.sliding_window == 8
+        # S=24 is 3x the window, so most positions have truncated context.
+        tokens = np.arange(48, dtype=np.int32).reshape(2, 24) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_window_actually_masks(self, tmp_path):
+        """Guards against the mask silently not being applied (in which case
+        the parity test would only be comparing full-attention paths)."""
+        import dataclasses as dc
+
+        _, repo = self._model(tmp_path)
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        tokens = jnp.arange(24, dtype=jnp.int32)[None, :] % 128
+        windowed = llama.forward(loaded.params, tokens, loaded.config)
+        full = llama.forward(
+            loaded.params, tokens, dc.replace(loaded.config, sliding_window=None)
+        )
+        # Positions inside the first window see identical context...
+        np.testing.assert_allclose(windowed[:, :8], full[:, :8], atol=1e-5)
+        # ...later positions must differ, or the window did nothing.
+        assert np.abs(np.asarray(windowed[:, 12:]) - np.asarray(full[:, 12:])).max() > 1e-3
+
+    def test_decode_matches_forward(self, tmp_path):
+        """Incremental (prefill+decode) logits must equal the full forward at
+        the same positions — the cache path applies the same window."""
+        _, repo = self._model(tmp_path)
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        tokens = jnp.arange(20, dtype=jnp.int32)[None, :] % 128
+        full = llama.forward(loaded.params, tokens, loaded.config)
+        cache = llama.init_cache(loaded.config, 1, 32, dtype=jnp.float32)
+        logits, cache = llama.forward_with_cache(
+            loaded.params, tokens[:, :16], cache, loaded.config
+        )
+        np.testing.assert_allclose(logits, full[:, :16], atol=2e-4, rtol=2e-3)
+        for i in range(16, 20):
+            step, cache = llama.forward_with_cache(
+                loaded.params, tokens[:, i : i + 1], cache, loaded.config
+            )
+            np.testing.assert_allclose(
+                step[:, 0], full[:, i], atol=2e-4, rtol=2e-3
+            )
+
+    def test_export_round_trip(self, tmp_path):
+        model, repo = self._model(tmp_path)
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out_dir = str(tmp_path / "mistralexp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        exported = json.load(open(f"{out_dir}/config.json"))
+        assert exported["model_type"] == "mistral"
+        assert exported["sliding_window"] == 8
+        reloaded = transformers.MistralForCausalLM.from_pretrained(
+            out_dir, attn_implementation="eager"
+        ).eval()
+        tokens = np.arange(48, dtype=np.int32).reshape(2, 24) % 128
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+
+class TestQwen2SlidingWindow:
+    """HF qwen2 windows layers i >= max_window_layers, so uniform SWA is
+    max_window_layers=0 and mwl >= n_layers means no window at all."""
+
+    def _cfg(self, **kw):
+        base = dict(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            tie_word_embeddings=False, attn_implementation="eager",
+        )
+        base.update(kw)
+        return transformers.Qwen2Config(**base)
+
+    def test_uniform_window_parity(self, tmp_path):
+        cfg = self._cfg(use_sliding_window=True, sliding_window=8, max_window_layers=0)
+        torch.manual_seed(12)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "qwen2swa")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        assert loaded.config.sliding_window == 8
+        tokens = np.arange(48, dtype=np.int32).reshape(2, 24) % 128
+        ours = np.asarray(
+            llama.forward(loaded.params, jnp.asarray(tokens), loaded.config)
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_export_writes_uniform_band(self, tmp_path):
+        cfg = self._cfg(use_sliding_window=True, sliding_window=8, max_window_layers=0)
+        torch.manual_seed(13)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "qwen2swasrc")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out_dir = str(tmp_path / "qwen2swaexp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        exported = json.load(open(f"{out_dir}/config.json"))
+        # max_window_layers = n_layers would silently disable SWA on reload.
+        assert exported["use_sliding_window"] and exported["max_window_layers"] == 0
+        reloaded = transformers.Qwen2ForCausalLM.from_pretrained(
+            out_dir, attn_implementation="eager"
+        ).eval()
+        assert all(t == "sliding_attention" for t in reloaded.config.layer_types)
+        tokens = np.arange(48, dtype=np.int32).reshape(2, 24) % 128
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+    def test_banded_window_past_last_layer_is_full_attention(self, tmp_path):
+        # mwl >= n_layers: transformers runs full attention everywhere.
+        cfg = {"model_type": "qwen2", "vocab_size": 64, "hidden_size": 16,
+               "intermediate_size": 32, "num_hidden_layers": 2,
+               "num_attention_heads": 2, "num_key_value_heads": 2,
+               "use_sliding_window": True, "sliding_window": 8,
+               "max_window_layers": 2}
+        json.dump(cfg, open(tmp_path / "config.json", "w"))
+        _family, config = hf.from_hf_config(str(tmp_path))
+        assert config.sliding_window is None
+
+    def test_mixed_band_rejected(self, tmp_path):
+        cfg = {"model_type": "qwen2", "vocab_size": 64, "hidden_size": 16,
+               "intermediate_size": 32, "num_hidden_layers": 2,
+               "num_attention_heads": 2, "num_key_value_heads": 2,
+               "use_sliding_window": True, "sliding_window": 8,
+               "max_window_layers": 1}
+        json.dump(cfg, open(tmp_path / "config.json", "w"))
+        with pytest.raises(ValueError, match="max_window_layers"):
+            hf.from_hf_config(str(tmp_path))
+
+
+def test_nondefault_activations_rejected(tmp_path):
+    """A checkpoint whose activation differs from the family's hardwired one
+    must refuse loudly — substituting it would silently break parity."""
+    llama_cfg = {"model_type": "llama", "vocab_size": 64, "hidden_size": 16,
+                 "intermediate_size": 32, "num_hidden_layers": 1,
+                 "num_attention_heads": 2, "num_key_value_heads": 2,
+                 "hidden_act": "gelu"}
+    json.dump(llama_cfg, open(tmp_path / "config.json", "w"))
+    with pytest.raises(ValueError, match="hidden_act"):
+        hf.from_hf_config(str(tmp_path))
+    gpt_cfg = {"model_type": "gpt2", "vocab_size": 64, "n_embd": 16,
+               "n_layer": 1, "n_head": 2, "activation_function": "gelu"}
+    json.dump(gpt_cfg, open(tmp_path / "config.json", "w"))
+    with pytest.raises(ValueError, match="activation_function"):
+        hf.from_hf_config(str(tmp_path))
+    bert_cfg = {"model_type": "bert", "vocab_size": 64, "hidden_size": 16,
+                "intermediate_size": 32, "num_hidden_layers": 1,
+                "num_attention_heads": 2, "hidden_act": "relu"}
+    json.dump(bert_cfg, open(tmp_path / "config.json", "w"))
+    with pytest.raises(ValueError, match="hidden_act"):
+        hf.from_hf_config(str(tmp_path))
+
+
 def test_llama_bias_variants_rejected(tmp_path):
     """Community llama configs with attention_bias/mlp_bias must refuse
     loudly — silently dropping their bias tensors would break parity."""

@@ -136,3 +136,70 @@ def test_sync_with_dataloader_false_rejected():
 
     with pytest.raises(ValueError, match="sync_with_dataloader"):
         GradientAccumulationPlugin(num_steps=2, sync_with_dataloader=False)
+
+
+def test_tensor_parallel_plugin_wires_plan_and_mesh():
+    """TensorParallelPlugin(tp_size, plan) must actually size the mesh and
+    select the named rule-set (not sit decoratively next to string
+    selection)."""
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import (
+        ShardingStrategyType,
+        TensorParallelPlugin,
+    )
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(seed=0, strategy=TensorParallelPlugin(tp_size=2, plan="llama"))
+    assert acc.mesh.shape["tensor"] == 2
+    assert acc.strategy.kind is ShardingStrategyType.TENSOR_PARALLEL
+    assert len(acc.strategy.rules) > 0
+
+    # Plugin and explicit rules together is ambiguous -> loud error.
+    from jax.sharding import PartitionSpec
+
+    with pytest.raises(ValueError, match="not both"):
+        ShardingStrategy.resolve(
+            TensorParallelPlugin(plan="llama"),
+            rules=(("w", PartitionSpec("tensor")),),
+        )
+    # No plan and no rules -> loud error (TP with nothing sharded is a lie).
+    with pytest.raises(ValueError, match="sharding rules"):
+        ShardingStrategy.resolve(TensorParallelPlugin(tp_size=2))
+
+
+def test_tensor_parallel_plugin_mesh_mismatch_rejected():
+    from accelerate_tpu.parallel import MeshConfig
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import TensorParallelPlugin
+
+    AcceleratorState._reset_state()
+    with pytest.raises(ValueError, match="tensor axis"):
+        Accelerator(
+            seed=0,
+            mesh_config=MeshConfig(tensor=4),
+            strategy=TensorParallelPlugin(tp_size=2, plan="llama"),
+        )
+    AcceleratorState._reset_state()
+
+
+def test_save_on_each_node_writes_shared_artifacts_per_process(
+    monkeypatch, tmp_path
+):
+    """With save_on_each_node=True a non-zero rank must write the
+    process-agnostic artifacts (metadata/dataloader states) too — per-node
+    filesystems get a self-contained directory."""
+    import accelerate_tpu.checkpointing as ckpt
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(
+        seed=0,
+        project_config=ProjectConfiguration(save_on_each_node=True),
+    )
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+    monkeypatch.setattr(ckpt.jax, "process_index", lambda: 1)
+    out = acc.save_state(str(tmp_path / "ck"), state)
+    assert os.path.isfile(os.path.join(out, "metadata.json"))
+    assert os.path.isfile(os.path.join(out, "rng_state_1.json"))
+    assert os.path.isfile(os.path.join(out, "dataloaders.json"))

@@ -99,3 +99,32 @@ def test_llama_pipeline_matches_forward():
     pipe, stage_params, forward = llama_pipeline(params, config, n_stages=4)
     got = forward(tokens, microbatch_size=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-4, atol=2e-5)
+
+
+def test_llama_pipeline_honours_rope_scaling_and_window():
+    """The pipeline path must run the same rope tables and band mask as
+    llama.forward — a Llama-3.1/Mistral config through the pipeline silently
+    running plain RoPE / full attention is a parity break."""
+    from accelerate_tpu.models.layers import RopeScaling
+
+    config = llama.LlamaConfig.tiny(
+        n_layers=4,
+        rope_scaling=RopeScaling(
+            "llama3", 4.0, 1.0, 4.0, original_max_position_embeddings=32
+        ),
+        sliding_window=6,
+    )
+    params = llama.init(jax.random.PRNGKey(0), config)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, config.vocab_size)
+
+    expected = llama.forward(params, tokens, config)
+    pipe, stage_params, forward = llama_pipeline(params, config, n_stages=4)
+    got = forward(tokens, microbatch_size=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-4, atol=2e-5)
+    # And the config must actually change the output vs the plain variant.
+    import dataclasses as dc
+
+    plain = llama.forward(
+        params, tokens, dc.replace(config, rope_scaling=None, sliding_window=None)
+    )
+    assert np.abs(np.asarray(expected) - np.asarray(plain)).max() > 1e-3
