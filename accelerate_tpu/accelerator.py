@@ -88,6 +88,20 @@ def _warn_fp8_noop() -> None:
     )
 
 
+_UNPINNED_WARNED: set[str] = set()
+
+
+def _warn_unpinned_once(message: str) -> None:
+    """Trace-time warning for the silent-fallback paths in the train step's
+    output pinning (ADVICE r3: a skipped pin reintroduces the ZERO1
+    recompile/layout drift with no signal). Once per distinct reason."""
+    import warnings
+
+    if message not in _UNPINNED_WARNED:
+        _UNPINNED_WARNED.add(message)
+        warnings.warn(message, stacklevel=3)
+
+
 class DynamicLossScale(struct.PyTreeNode):
     """fp16 dynamic loss-scale state — the GradScaler analog (reference
     `utils/modeling.py:2054` `get_grad_scaler` + overflow-skip in
@@ -213,6 +227,7 @@ class Accelerator:
         self._checkpoint_registry: list[Any] = []
         self._param_specs: Any = None
         self._opt_specs: Any = None
+        self._opt_host_shardings: Any = None
         self._dataloaders: list[DataLoader] = []
         self._train_steps: dict[int, Callable] = {}
 
@@ -372,10 +387,13 @@ class Accelerator:
     def state_shardings(self, state_shapes: "TrainState") -> "TrainState":
         """TrainState-shaped pytree of NamedShardings (for jit out_shardings)."""
         replicated = NamedSharding(self.mesh, PartitionSpec())
+        opt_sh = getattr(self, "_opt_host_shardings", None)
         return TrainState(
             step=replicated,
             params=to_named_shardings(self._param_specs, self.mesh),
-            opt_state=to_named_shardings(self._opt_specs, self.mesh),
+            opt_state=opt_sh
+            if opt_sh is not None
+            else to_named_shardings(self._opt_specs, self.mesh),
             apply_fn=state_shapes.apply_fn,
             tx=state_shapes.tx,
             loss_scale=jax.tree.map(lambda _: replicated, state_shapes.loss_scale),
@@ -389,6 +407,33 @@ class Accelerator:
                 DynamicLossScale.create(), NamedSharding(self.mesh, PartitionSpec())
             )
         return None
+
+    def _offload_opt_placement(self, tx: Any, opt_shapes_fn: Callable, opt_sh: Any) -> Any:
+        """Apply the offload_optimizer placement policy to the optimizer
+        shardings: pinned-host float moments when the backend supports it
+        (and the optimizer is offload-aware), a loud fallback otherwise.
+        Records the host shardings for the train step's streaming path."""
+        self._opt_host_shardings = None
+        if not self.strategy.offload_optimizer:
+            return opt_sh
+        from .parallel import host_offload as _ho
+
+        if not _ho.host_offload_supported():
+            _ho.warn_host_offload_unsupported()
+            return opt_sh
+        if not isinstance(tx, _ho.HostOffloadedAdamW):
+            raise ValueError(
+                "offload_optimizer requires an offload-aware optimizer: use "
+                "accelerate_tpu.host_offloaded_adamw(...) instead of a plain "
+                "optax transformation — the streamed update must know the "
+                "optimizer's math (the DeepSpeedCPUAdam requirement, "
+                "reference utils/deepspeed.py:29)."
+            )
+        # ZeRO-Offload analog: float moments live in pinned host RAM and
+        # never materialize whole in HBM.
+        opt_sh = _ho.host_opt_shardings(opt_shapes_fn(), opt_sh)
+        self._opt_host_shardings = opt_sh
+        return opt_sh
 
     def create_train_state(
         self,
@@ -415,7 +460,21 @@ class Accelerator:
             params_shapes = jax.eval_shape(lambda: init_fn)
             param_specs, opt_specs = self._resolve_specs(params_shapes, tx)
             params = shard_pytree(init_fn, param_specs, self.mesh)
-        opt_sh = to_named_shardings(opt_specs, self.mesh)
+        if self.policy.param_dtype is not None:
+            # Explicit master-param dtype (policy.param_dtype docstring):
+            # cast float leaves; ints (embedding tables are float, token ids
+            # never live in params, but quantized int8 leaves do) stay put.
+            pd = self.policy.param_dtype
+            params = jax.tree.map(
+                lambda x: x.astype(pd)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                params,
+            )
+        opt_sh = self._offload_opt_placement(
+            tx, lambda: jax.eval_shape(tx.init, params),
+            to_named_shardings(opt_specs, self.mesh),
+        )
         opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
         # The step counter must be mesh-replicated like every other scalar in
         # the state: a single-device scalar here gives the first jitted step
@@ -443,12 +502,18 @@ class Accelerator:
             loss_scale = jax.device_put(
                 loss_scale, NamedSharding(self.mesh, PartitionSpec())
             )
+        opt_sh = self._offload_opt_placement(
+            state.tx, lambda: jax.eval_shape(lambda: state.opt_state),
+            to_named_shardings(opt_specs, self.mesh),
+        )
         return state.replace(
             step=jax.device_put(
                 state.step, NamedSharding(self.mesh, PartitionSpec())
             ),
             params=shard_pytree(state.params, param_specs, self.mesh),
-            opt_state=shard_pytree(state.opt_state, opt_specs, self.mesh),
+            opt_state=jax.tree.map(
+                lambda x, s: jax.device_put(x, s), state.opt_state, opt_sh
+            ),
             loss_scale=loss_scale,
         )
 
@@ -528,15 +593,39 @@ class Accelerator:
         # layout (or crash on tree mismatch) when it finally traces.
         planned_param_specs = getattr(self, "_param_specs", None)
         planned_opt_specs = getattr(self, "_opt_specs", None)
+        # Host-offloaded moments (create_train_state decided placement):
+        # the step moves them host->HBM right before the update and back
+        # after, all inside the jit so XLA overlaps the DMAs with compute.
+        opt_host_shardings = getattr(self, "_opt_host_shardings", None)
+        if opt_host_shardings is not None and use_scaler:
+            raise ValueError(
+                "offload_optimizer with fp16 dynamic loss scaling is not "
+                "supported (the overflow-skip select would have to span "
+                "memory spaces); use bf16 mixed precision."
+            )
 
         def _pin(tree: Any, spec_tree: Any) -> Any:
             """Constrain `tree` to its planned shardings; skipped when no
             plan exists or the structures disagree (a state this step was
-            not planned for)."""
+            not planned for). The skip warns once — a silently unpinned
+            output regresses the ZERO1 layout/recompile fix without any
+            signal."""
             if spec_tree is None:
+                _warn_unpinned_once(
+                    "make_train_step has no planned shardings to pin outputs "
+                    "to (create_train_state was not called on this "
+                    "Accelerator); output layouts are left to the "
+                    "partitioner, which may recompile or change the "
+                    "strategy's memory story."
+                )
                 return tree
             is_spec = lambda x: isinstance(x, PartitionSpec)
             if jax.tree.structure(tree) != jax.tree.structure(spec_tree, is_leaf=is_spec):
+                _warn_unpinned_once(
+                    "make_train_step's planned shardings do not match the "
+                    "state actually passed to the step (different model?); "
+                    "outputs are left unpinned."
+                )
                 return tree
             return jax.tree.map(
                 jax.lax.with_sharding_constraint,
@@ -616,7 +705,13 @@ class Accelerator:
             else:
                 (_, (loss, aux)), grads = grad_fn(state.params, batch, rng, scale)
 
-            metrics: dict[str, jax.Array] = {"loss": loss}
+            # Loss math stays fp32 throughout; output_dtype only changes the
+            # dtype the metric is *reported* in.
+            metrics: dict[str, jax.Array] = {
+                "loss": loss
+                if policy.output_dtype is None
+                else loss.astype(policy.output_dtype)
+            }
             if use_scaler:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 finite = jnp.all(
@@ -629,12 +724,37 @@ class Accelerator:
                 grads = jax.tree.map(
                     lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
                 )
+            grad_scale = None
             if max_grad_norm is not None:
                 gnorm = global_norm(grads)
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * clip, grads)
+                if opt_host_shardings is None:
+                    grads = jax.tree.map(lambda g: g * clip, grads)
+                else:
+                    # Folding the clip into the streamed per-layer update
+                    # avoids materializing a scaled copy of every gradient
+                    # (measured: 6 GiB of fp32 HLO temps at 1.6B).
+                    grad_scale = clip
                 metrics["grad_norm"] = gnorm
-            updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+            if opt_host_shardings is not None:
+                # Layer-streamed offloaded update (host_offload module
+                # docstring): moments stay pinned-host; one layer's slices
+                # at a time round-trip through HBM inside a lax.scan.
+                from .parallel.host_offload import streaming_adamw_update
+
+                updates, new_opt_state = streaming_adamw_update(
+                    state.tx,
+                    grads,
+                    state.opt_state,
+                    state.params,
+                    planned_param_specs,
+                    self.mesh,
+                    grad_scale=grad_scale,
+                )
+            else:
+                updates, new_opt_state = state.tx.update(
+                    grads, state.opt_state, state.params
+                )
             new_params = optax.apply_updates(state.params, updates)
             new_loss_scale = state.loss_scale
             if use_scaler:
@@ -668,7 +788,15 @@ class Accelerator:
             # story AND forces a recompile when the state round-trips into
             # the next step with a different input layout.
             new_params = _pin(new_params, planned_param_specs)
-            new_opt_state = _pin(new_opt_state, planned_opt_specs)
+            if opt_host_shardings is not None:
+                # Explicit host placement IS the output pinning here.
+                new_opt_state = jax.tree.map(
+                    lambda x, s: jax.device_put(x, s),
+                    new_opt_state,
+                    opt_host_shardings,
+                )
+            else:
+                new_opt_state = _pin(new_opt_state, planned_opt_specs)
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,

@@ -56,6 +56,12 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "--remat", action="store_true", help="Assume full activation rematerialization"
     )
     p.add_argument(
+        "--offload_optimizer",
+        action="store_true",
+        help="Optimizer moments in pinned host RAM (parallel/host_offload.py "
+        "ZeRO-Offload analog): moves their bytes off the HBM budget",
+    )
+    p.add_argument(
         "--hbm_gb", type=float, default=16.0, help="Per-chip HBM (v5e=16, v4=32, v5p=95)"
     )
     p.add_argument(
@@ -101,7 +107,8 @@ def _resolve_model(model: str) -> tuple[str, Any]:
 
 
 def estimate(model: str, batch_size: int, seq_len: int, precision: str,
-             optimizer: str, shards: int, remat: bool) -> dict[str, Any]:
+             optimizer: str, shards: int, remat: bool,
+             offload_optimizer: bool = False) -> dict[str, Any]:
     import jax
     import jax.numpy as jnp
 
@@ -141,8 +148,12 @@ def estimate(model: str, batch_size: int, seq_len: int, precision: str,
     vocab = getattr(config, "vocab_size", 0)
     logits_b = batch_size * seq_len * vocab * 4 if vocab else 0
 
+    host_opt_b = 0.0
+    if offload_optimizer:
+        host_opt_b, opt_b = opt_b, 0.0
     total = params_b + compute_copy_b + grads_b + opt_b + act_b + logits_b
     return {
+        "host_optimizer": host_opt_b,
         "family": family,
         "config": config,
         "seq_len": eff_seq,
@@ -170,6 +181,7 @@ def run(args: argparse.Namespace) -> int:
     r = estimate(
         args.model, args.batch_size, args.seq_len, args.precision,
         args.optimizer, args.shards, args.remat,
+        offload_optimizer=args.offload_optimizer,
     )
     print(f"Model: {args.model}  ({r['n_params']:,} params)")
     print(f"Assumptions: batch={args.batch_size} seq={r['seq_len']} "
@@ -181,6 +193,7 @@ def run(args: argparse.Namespace) -> int:
         (f"{args.precision} compute copy", r["compute_copy"]),
         ("gradients (fp32)", r["grads"]),
         ("optimizer moments", r["optimizer"]),
+        *([("host-resident moments", r["host_optimizer"])] if r["host_optimizer"] else []),
         ("activations", r["activations"]),
         ("logits + loss (fp32)", r["logits"]),
     ]

@@ -44,6 +44,12 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument("--coordinator_port", type=int, default=None)
     p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
     p.add_argument(
+        "--force_fp8",
+        action="store_true",
+        help="Run fp8 even on device kinds whose recorded fp8 matmul "
+        "speedup is <= 1x (where fp8 costs accuracy for zero gain)",
+    )
+    p.add_argument(
         "--strategy",
         default=None,
         help="DATA_PARALLEL | ZERO1 | ZERO2 | FSDP | TENSOR_PARALLEL | HYBRID",
@@ -151,6 +157,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _port_stolen(port: int) -> bool:
+    """After a group death: is the rendezvous port held by ANOTHER process?
+    Our own (dead) coordinator leaves at most a TIME_WAIT entry, which
+    SO_REUSEADDR binds through — so a failed bind here means someone else
+    grabbed the port between the `_free_port` probe and the coordinator's
+    bind, i.e. the failure was the launcher's race, not the workload's."""
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", port))
+            return False
+        except OSError:
+            return True
+
+
 def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
     """Spawn one group of num_processes children and babysit it: first
     worker death tears the whole group down (the reference relies on
@@ -200,16 +223,38 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
         return 0
     pinned_address = cfg.coordinator_address  # user-supplied: reuse as-is
     exit_code = 0
-    for attempt in range(cfg.max_restarts + 1):
+    # _free_port probes by bind-and-close, so another process can steal the
+    # port in the window before the coordinator binds it. Such a failure is
+    # the launcher's fault, not the workload's: retry the same attempt on a
+    # fresh port (bounded) instead of burning the user's max_restarts budget.
+    rendezvous_retries = 3
+    first_group = True
+    attempt = 0
+    while attempt <= cfg.max_restarts:
         if pinned_address:
             cfg.coordinator_address = pinned_address
-        elif attempt == 0:
+        elif first_group:
             cfg.coordinator_address = f"127.0.0.1:{cfg.coordinator_port}"
         else:
             cfg.coordinator_address = f"127.0.0.1:{_free_port()}"
+        first_group = False
         exit_code = _run_worker_group(cfg, cmd, args)
         if exit_code == 0:
             return 0
+        # Only launcher-chosen addresses are "127.0.0.1:<port>"; a pinned
+        # address may have no numeric port, so parse under the guard.
+        if not pinned_address and rendezvous_retries > 0 and _port_stolen(
+            chosen_port := int(cfg.coordinator_address.rsplit(":", 1)[1])
+        ):
+            rendezvous_retries -= 1
+            print(
+                "[accelerate-tpu launch] rendezvous port "
+                f"{chosen_port} was taken by another process; retrying on a "
+                "fresh port (not counted against --max_restarts)",
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
         if attempt < cfg.max_restarts:
             print(
                 f"[accelerate-tpu launch] worker group failed (exit "
@@ -218,6 +263,7 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
                 file=sys.stderr,
                 flush=True,
             )
+        attempt += 1
     return exit_code
 
 
@@ -272,6 +318,38 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     return exit_code
 
 
+def _fp8_speedup_for_local_devices() -> float | None:
+    """Recorded fp8 speedup for the local device kind; None when unknown or
+    when devices can't be queried (e.g. pod SSH launch — the remote kind is
+    unknown here, so the gate stays permissive).
+
+    The device kind is probed in a SUBPROCESS: importing jax here would
+    initialize libtpu in the launcher process and hold the chips, so every
+    spawned worker would then fail with 'TPU already in use'. The probe
+    process exits (releasing the devices) before any worker starts."""
+    from ..utils import fp8_telemetry
+
+    kind = _probe_device_kind()
+    if not kind:
+        return None
+    return fp8_telemetry.lookup(kind)
+
+
+def _probe_device_kind() -> str | None:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=120,
+        )
+        lines = out.stdout.strip().splitlines()
+        return lines[-1] if out.returncode == 0 and lines else None
+    except Exception:
+        return None
+
+
 def run(args: argparse.Namespace) -> int:
     cfg = _merge_config(args)
     cmd = [sys.executable, args.script, *args.script_args]
@@ -283,6 +361,16 @@ def run(args: argparse.Namespace) -> int:
             "fp8_matmul_speedup).",
             file=sys.stderr,
         )
+        speedup = _fp8_speedup_for_local_devices()
+        if speedup is not None and speedup <= 1.0 and not getattr(args, "force_fp8", False):
+            print(
+                "[accelerate-tpu launch] refusing --mixed_precision fp8: "
+                f"measured fp8 matmul speedup on this device kind is "
+                f"{speedup:.2f}x (<= 1) — you would pay fp8 quantization "
+                "error for a slowdown. Pass --force_fp8 to override.",
+                file=sys.stderr,
+            )
+            return 2
 
     if cfg.tpu_name:
         return _tpu_pod_launch(cfg, cmd, args)

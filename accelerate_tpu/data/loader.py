@@ -482,22 +482,57 @@ class DataLoader:
         ds_state = self._dataset_states.get(self._batches_yielded)
         if ds_state is not None:
             # The stream's own position (torchdata Stateful protocol,
-            # reference `data_loader.py:413-497`): base64-pickled so the
-            # checkpoint stays one JSON document.
-            import base64
-            import pickle
+            # reference `data_loader.py:413-497`). JSON when the state allows
+            # it (typically a small position dict) — restoring JSON can never
+            # execute code; pickle only for states JSON can't express, and
+            # restoring THOSE requires an explicit opt-in (below).
+            import json as _json
 
-            state["dataset"] = base64.b64encode(pickle.dumps(ds_state)).decode()
+            try:
+                encoded = _json.loads(_json.dumps(ds_state))
+                # JSON must round-trip LOSSLESSLY or the dataset gets back a
+                # different state than it saved (tuples->lists, int dict
+                # keys->strings — json coerces those without erroring).
+                if encoded != ds_state:
+                    raise TypeError("dataset state not JSON-lossless")
+                state["dataset"] = {"encoding": "json", "value": encoded}
+            except (TypeError, ValueError):
+                import base64
+                import pickle
+
+                state["dataset"] = {
+                    "encoding": "pickle",
+                    "value": base64.b64encode(pickle.dumps(ds_state)).decode(),
+                }
         return state
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
         self._epoch = int(state.get("epoch", 0))
         ds_state = state.get("dataset")
         if ds_state is not None and hasattr(self.dataset, "load_state_dict"):
-            import base64
-            import pickle
+            if isinstance(ds_state, dict) and ds_state.get("encoding") == "json":
+                restored = ds_state["value"]
+            else:
+                # Legacy raw base64 string, or the explicit pickle encoding:
+                # unpickling executes arbitrary code, so an untrusted
+                # checkpoint must not reach it by default (torch.load's
+                # historical threat model, avoided here for JSON states).
+                import os as _os
 
-            restored = pickle.loads(base64.b64decode(ds_state))
+                if not _os.environ.get("ATX_ALLOW_PICKLED_DATASET_STATE"):
+                    raise ValueError(
+                        "This checkpoint stores the dataset stream state as "
+                        "a pickle, which executes code on load. If you trust "
+                        "the checkpoint's origin, set "
+                        "ATX_ALLOW_PICKLED_DATASET_STATE=1 to restore it."
+                    )
+                import base64
+                import pickle
+
+                payload = (
+                    ds_state["value"] if isinstance(ds_state, dict) else ds_state
+                )
+                restored = pickle.loads(base64.b64decode(payload))
             self.dataset.load_state_dict(restored)
             # Position restored NATIVELY in the stream — replay-skipping on
             # top of it would drop batches twice.

@@ -47,15 +47,28 @@ class ShardingStrategy:
     # sharding respectively.
     fsdp_axes: tuple[str, ...] = (FSDP_AXIS,)
     zero1_axes: tuple[str, ...] = BATCH_AXES
+    # Optimizer moments in pinned host RAM (parallel/host_offload.py).
+    offload_optimizer: bool = False
 
     @classmethod
     def resolve(cls, strategy: Any, rules: Rules = ()) -> "ShardingStrategy":
+        from .host_offload import offload_requested_from_env
+
         if isinstance(strategy, ShardingStrategy):
             return strategy
         if strategy is None:
-            return cls(kind=ShardingStrategyType.DATA_PARALLEL, rules=rules)
+            return cls(
+                kind=ShardingStrategyType.DATA_PARALLEL,
+                rules=rules,
+                offload_optimizer=offload_requested_from_env(),
+            )
         if isinstance(strategy, FsdpPlugin):
-            return cls(kind=ShardingStrategyType.FSDP, rules=rules, fsdp=strategy)
+            return cls(
+                kind=ShardingStrategyType.FSDP,
+                rules=rules,
+                fsdp=strategy,
+                offload_optimizer=strategy.offload_optimizer,
+            )
         if isinstance(strategy, TensorParallelPlugin):
             if strategy.plan is not None:
                 from .tp import get_tp_plan
@@ -73,8 +86,16 @@ class ShardingStrategy:
                     "TensorParallelPlugin(plan='<family>') (registered plans: "
                     "parallel.tp.list_tp_plans()) or pass sharding_rules."
                 )
-            return cls(kind=ShardingStrategyType.TENSOR_PARALLEL, rules=rules)
-        return cls(kind=ShardingStrategyType(str(strategy).upper()), rules=rules)
+            return cls(
+                kind=ShardingStrategyType.TENSOR_PARALLEL,
+                rules=rules,
+                offload_optimizer=offload_requested_from_env(),
+            )
+        return cls(
+            kind=ShardingStrategyType(str(strategy).upper()),
+            rules=rules,
+            offload_optimizer=offload_requested_from_env(),
+        )
 
 
 def _path_str(path: tuple) -> str:

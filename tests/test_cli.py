@@ -204,3 +204,38 @@ def test_estimate_accepts_local_hf_repo(tmp_path, capsys):
     assert cli_main(["estimate", str(tmp_path), "--batch_size", "2", "--seq_len", "32"]) == 0
     out = capsys.readouterr().out
     assert "106,816 params" in out and "training total/chip" in out
+
+
+def test_fp8_lose_lose_gate(tmp_path, monkeypatch, capsys):
+    """VERDICT r3 #10: fp8 on a device kind with recorded speedup <= 1 must
+    refuse unless --force_fp8 (no silent lose-lose configuration)."""
+    from accelerate_tpu.commands.launch import _probe_device_kind
+    from accelerate_tpu.utils import fp8_telemetry
+
+    monkeypatch.setenv("ATX_CACHE_DIR", str(tmp_path))
+    # Record under the kind the launcher's own probe will see (the probe
+    # subprocess may resolve a real accelerator even when tests run on the
+    # CPU-simulated mesh).
+    kind = _probe_device_kind()
+    assert kind, "device-kind probe failed"
+    fp8_telemetry.record(kind, 0.51)
+    assert fp8_telemetry.lookup(kind) == 0.51
+
+    script = tmp_path / "noop.py"
+    script.write_text("print('hi')\n")
+    rc = cli_main(
+        ["launch", "--dry_run", "--mixed_precision", "fp8", str(script)]
+    )
+    assert rc == 2
+    # --force_fp8 overrides the gate; dry_run then succeeds.
+    rc = cli_main(
+        ["launch", "--dry_run", "--mixed_precision", "fp8", "--force_fp8",
+         str(script)]
+    )
+    assert rc == 0
+    # A kind measured fast keeps fp8 available without the flag.
+    fp8_telemetry.record(kind, 1.8)
+    rc = cli_main(
+        ["launch", "--dry_run", "--mixed_precision", "fp8", str(script)]
+    )
+    assert rc == 0

@@ -203,3 +203,40 @@ def test_save_on_each_node_writes_shared_artifacts_per_process(
     assert os.path.isfile(os.path.join(out, "metadata.json"))
     assert os.path.isfile(os.path.join(out, "rng_state_1.json"))
     assert os.path.isfile(os.path.join(out, "dataloaders.json"))
+
+
+def test_param_and_output_dtype_consumed():
+    """MixedPrecisionPolicy.param_dtype / output_dtype: None leaves dtypes
+    alone (the bf16-weights recipe depends on that); set explicitly, they
+    drive master-param and reported-metric dtypes."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+    AcceleratorState._reset_state()
+    acc = Accelerator(seed=0)
+    # None default: params keep their init dtype.
+    state = acc.create_train_state(regression_init, optax.sgd(0.1))
+    init_dtypes = {str(l.dtype) for l in jax.tree.leaves(state.params)}
+
+    AcceleratorState._reset_state()
+    acc2 = Accelerator(seed=0)
+    acc2.policy = MixedPrecisionPolicy(
+        param_dtype=jnp.bfloat16, output_dtype=jnp.bfloat16
+    )
+    state2 = acc2.create_train_state(regression_init, optax.sgd(0.1))
+    assert all(
+        l.dtype == jnp.bfloat16
+        for l in jax.tree.leaves(state2.params)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    )
+    assert init_dtypes != {"bfloat16"}  # the cast actually changed something
+
+    from accelerate_tpu.test_utils.training import regression_loss
+
+    step = acc2.make_train_step(regression_loss)
+    batch = {"x": jnp.ones((4,)), "y": jnp.zeros((4,))}
+    _, metrics = step(state2, batch)
+    assert metrics["loss"].dtype == jnp.bfloat16
+    AcceleratorState._reset_state()

@@ -246,3 +246,80 @@ class TestStatefulResume:
         it2.close()
         assert sd2["batches_yielded"] == 5
         assert "dataset" in sd2
+
+
+class _Unjsonable:
+    """Pickleable (module-level) but not JSON-serializable stream state."""
+
+    def __init__(self, pos=0):
+        self.pos = pos
+
+
+class TestStatefulStateEncoding:
+    """ADVICE r3: dataset stream state is JSON (code-execution-free) whenever
+    possible; pickled states only restore behind an explicit opt-in."""
+
+    def test_json_states_round_trip_without_pickle(self):
+        acc = atx.Accelerator(seed=0)
+
+        class S(torch.utils.data.IterableDataset):
+            def __init__(self):
+                self.pos = 0
+
+            def __iter__(self):
+                while self.pos < 16:
+                    self.pos += 1
+                    yield {"x": np.float32([self.pos])}
+
+            def state_dict(self):
+                return {"pos": self.pos}
+
+            def load_state_dict(self, sd):
+                self.pos = sd["pos"]
+
+        loader = acc.prepare_data_loader(S(), batch_size=1)
+        it = iter(loader)
+        next(it)
+        sd = loader.state_dict()
+        it.close()
+        assert sd["dataset"]["encoding"] == "json"
+        ds2 = S()
+        loader2 = acc.prepare_data_loader(ds2, batch_size=1)
+        loader2.load_state_dict(sd)  # no env var needed
+        assert ds2.pos >= 1
+
+    def test_pickled_state_needs_opt_in(self, monkeypatch):
+        acc = atx.Accelerator(seed=0)
+        Unjsonable = _Unjsonable
+
+        class S(torch.utils.data.IterableDataset):
+            def __init__(self):
+                self.state = Unjsonable()
+
+            def __iter__(self):
+                while self.state.pos < 16:
+                    self.state.pos += 1
+                    yield {"x": np.float32([self.state.pos])}
+
+            def state_dict(self):
+                return {"obj": Unjsonable(self.state.pos)}
+
+            def load_state_dict(self, sd):
+                self.state = Unjsonable(sd["obj"].pos)
+
+        loader = acc.prepare_data_loader(S(), batch_size=1)
+        it = iter(loader)
+        next(it)
+        sd = loader.state_dict()
+        it.close()
+        assert sd["dataset"]["encoding"] == "pickle"
+
+        loader2 = acc.prepare_data_loader(S(), batch_size=1)
+        monkeypatch.delenv("ATX_ALLOW_PICKLED_DATASET_STATE", raising=False)
+        with pytest.raises(ValueError, match="ATX_ALLOW_PICKLED_DATASET_STATE"):
+            loader2.load_state_dict(sd)
+        monkeypatch.setenv("ATX_ALLOW_PICKLED_DATASET_STATE", "1")
+        ds3 = S()
+        loader3 = acc.prepare_data_loader(ds3, batch_size=1)
+        loader3.load_state_dict(sd)
+        assert ds3.state.pos >= 1
