@@ -809,8 +809,23 @@ class Accelerator:
 
         donate_args = (0,) if donate else ()
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
-        self._train_steps[id(jitted)] = jitted
-        return jitted
+
+        def run_step(state: TrainState, batch: Any):
+            # Trace (and run) under the ambient mesh so the model's
+            # activation constraints (parallel.mesh.constrain_batch) bind to
+            # this Accelerator's axes.
+            with jax.sharding.set_mesh(self.mesh):
+                return jitted(state, batch)
+
+        def lower(*args: Any, **kwargs: Any):
+            with jax.sharding.set_mesh(self.mesh):
+                return jitted.lower(*args, **kwargs)
+
+        # Keep the jit surface the HLO-verification tooling relies on.
+        run_step.lower = lower
+        run_step._cache_size = jitted._cache_size
+        self._train_steps[id(run_step)] = jitted
+        return run_step
 
     def make_eval_step(
         self, fn: Callable[[Any, Any], Any]

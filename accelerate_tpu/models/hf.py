@@ -253,11 +253,17 @@ def _llama_specs(config) -> dict[str, _Src]:
         for leaf in ("blocks.mlp.w_gate", "blocks.mlp.w_up", "blocks.mlp.w_down"):
             del m[leaf]
         m["blocks.moe.router"] = _Src(
-            L + "block_sparse_moe.gate.weight", _t2, True
+            L + "block_sparse_moe.gate.weight", _t2, True, invert=_inv_t2
         )
-        m["blocks.moe.w_gate"] = _Src(E + "w1.weight", _t2, True, per_expert=True)
-        m["blocks.moe.w_up"] = _Src(E + "w3.weight", _t2, True, per_expert=True)
-        m["blocks.moe.w_down"] = _Src(E + "w2.weight", _t2, True, per_expert=True)
+        m["blocks.moe.w_gate"] = _Src(
+            E + "w1.weight", _t2, True, invert=_inv_t2, per_expert=True
+        )
+        m["blocks.moe.w_up"] = _Src(
+            E + "w3.weight", _t2, True, invert=_inv_t2, per_expert=True
+        )
+        m["blocks.moe.w_down"] = _Src(
+            E + "w2.weight", _t2, True, invert=_inv_t2, per_expert=True
+        )
     if not config.tie_embeddings:
         m["lm_head"] = _Src("lm_head.weight", _t2, invert=_inv_t2)
     return m
@@ -725,6 +731,7 @@ def load_pretrained(
     min_weight_size: int = 2**11,
     no_offload_patterns=(),
     quantize_bits: int | None = None,
+    offload_dir: str | None = None,
 ) -> PretrainedModel:
     """One-call HF repo ingestion: ``config.json`` -> family config, plan
     shardings, stream weights (reference `load_checkpoint_and_dispatch`
@@ -773,7 +780,7 @@ def load_pretrained(
     )
     params = load_hf_checkpoint(
         shapes, path, plan, family=family, config=config, dtype=dtype,
-        quantize_bits=quantize_bits,
+        quantize_bits=quantize_bits, offload_dir=offload_dir,
     )
     return PretrainedModel(family, config, params, plan)
 
@@ -827,13 +834,21 @@ def _make_quantize_override(plan, bits):
         eligible, stack = leaf_quant_plan(plan_key, tuple(leaf.shape), leaf.dtype)
         if not eligible:
             return None
-        packed = quantize_streaming(leaf, fetch, stack)
-        spec = spec_for(plan_key)
-        placed = {}
-        for name, arr in packed.items():
-            s = _sanitize_spec(spec, arr.shape, plan.mesh)
-            placed[name] = jax.device_put(arr, NamedSharding(plan.mesh, s))
-        return placed
+
+        # (host_fn, place_fn) pair: dispatch_leaves runs the read+pack on
+        # its IO worker, overlapped with the previous leaf's device_put.
+        def host_fn():
+            return quantize_streaming(leaf, fetch, stack)
+
+        def place_fn(packed):
+            spec = spec_for(plan_key)
+            placed = {}
+            for name, arr in packed.items():
+                s = _sanitize_spec(spec, arr.shape, plan.mesh)
+                placed[name] = jax.device_put(arr, NamedSharding(plan.mesh, s))
+            return placed
+
+        return host_fn, place_fn
 
     return override
 
@@ -847,6 +862,7 @@ def load_hf_checkpoint(
     config: Any,
     dtype: Any | None = None,
     quantize_bits: int | None = None,
+    offload_dir: str | None = None,
 ) -> Params:
     """Stream an HF-named checkpoint into sharded device buffers per
     ``plan`` using the built-in family map (the key-mapped sibling of
@@ -947,6 +963,12 @@ def load_hf_checkpoint(
                 if quantize_bits
                 else None
             ),
+            offload_dir=offload_dir,
+            source_id=(
+                __import__("accelerate_tpu.big_modeling", fromlist=["source_fingerprint"]).source_fingerprint(path)
+                if offload_dir
+                else ""
+            ),
         )
     finally:
         source.close()
@@ -959,7 +981,10 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
     if family == "llama":
         qwen = getattr(config, "attn_bias", False)
         sliding = getattr(config, "sliding_window", None)
-        if qwen:
+        moe = getattr(config, "n_experts", 0)
+        if moe:
+            mt, arch = "mixtral", "MixtralForCausalLM"
+        elif qwen:
             mt, arch = "qwen2", "Qwen2ForCausalLM"
         elif sliding is not None:
             # LlamaConfig (HF) has no sliding_window field; exporting a
@@ -985,6 +1010,9 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
             "hidden_act": "silu",
             "torch_dtype": torch_dtype,
         }
+        if moe:
+            out["num_local_experts"] = config.n_experts
+            out["num_experts_per_tok"] = config.moe_top_k
         rs = getattr(config, "rope_scaling", None)
         if rs is not None:
             payload = {"rope_type": rs.rope_type, "factor": rs.factor}
@@ -1101,8 +1129,7 @@ def save_pretrained(
         if missing:
             raise NotImplementedError(
                 f"Export has no inverse transform for leaves {missing[:4]} "
-                f"(family {family!r}); MoE/mixtral params are load-only for "
-                "now."
+                f"(family {family!r})."
             )
 
     def leaf_for(dotted: str) -> Any:
@@ -1132,6 +1159,12 @@ def save_pretrained(
                 # per-slice gather keeps the spike to one layer's worth.
                 for i in range(leaf.shape[0]):
                     arr = np.asarray(jax.device_get(leaf[i]))
+                    if src.per_expert:
+                        # (E, ...) expert stack un-fuses back into Mixtral's
+                        # block_sparse_moe.experts.{e} tensors.
+                        for e in range(arr.shape[0]):
+                            yield src.key.format(i=i, e=e), src.invert(arr[e])
+                        continue
                     yield src.key.format(i=i), src.invert(arr)
             else:
                 yield src.key, src.invert(np.asarray(jax.device_get(leaf)))

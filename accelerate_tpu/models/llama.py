@@ -279,6 +279,11 @@ def block_forward(
 ) -> jax.Array:
     from jax.ad_checkpoint import checkpoint_name
 
+    from ..parallel.mesh import constrain_batch
+
+    # Re-pin the residual stream's batch sharding every layer: inside the
+    # scan the partitioner otherwise drifts (mesh.constrain_batch docstring).
+    x = constrain_batch(x)
     h = rms_norm(x, block["attn_norm"], config.norm_eps)
     q, k, v = attention_qkv(block["attn"], h)
     q = checkpoint_name(apply_rope(q, cos, sin, positions), "q_rope")
@@ -564,6 +569,137 @@ def forward_offloaded(
     x = rms_norm(x, jnp.asarray(params["final_norm"]), config.norm_eps)
     head = embed.T if config.tie_embeddings else jnp.asarray(params["lm_head"]).astype(compute_dtype)
     return jnp.einsum("bsd,dv->bsv", x, head)
+
+
+@functools.lru_cache(maxsize=16)
+def _offloaded_cache_step(config: LlamaConfig):
+    """Jitted per-layer cache step for offloaded decode: one block's weights
+    (staged from host/disk), that layer's KV cache slices, and the running
+    hidden state."""
+
+    def step(block, k_cache, v_cache, x, cos, sin, positions, mask, start):
+        block = _maybe_dequantize(block, x.dtype)
+        h = rms_norm(x, block["attn_norm"], config.norm_eps)
+        q, k, v = attention_qkv(block["attn"], h)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+        )
+        attn = dot_product_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
+        )
+        x = x + attention_out(block["attn"], attn)
+        h = rms_norm(x, block["mlp_norm"], config.norm_eps)
+        ffn_out, _ = _ffn(block, h, config)
+        return x + ffn_out, k_cache, v_cache
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+def forward_with_cache_offloaded(
+    params: Params,
+    tokens: jax.Array,
+    cache: dict[str, jax.Array],
+    config: LlamaConfig,
+    *,
+    compute_dtype: Any = jnp.bfloat16,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """`forward_with_cache` for over-HBM (and over-host-RAM) models:
+    ``params['blocks']`` leaves are host numpy arrays or disk memmaps
+    (`big_modeling.offload_blocks` / disk offload via
+    ``load_pretrained(offload_dir=...)``); each layer's weights stream to
+    the device one step ahead of compute while the KV cache stays resident.
+    The per-layer reads are what make a model larger than host RAM + HBM
+    decodable — only one layer's weights are ever in flight (reference
+    `disk_offload` + `OffloadedWeightsLoader`, `big_modeling.py:260`,
+    `utils/offload.py:127`)."""
+    from ..big_modeling import streamed_scan
+
+    B, T_new = tokens.shape
+    start = cache["length"]
+    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (B, T_new))
+    cos, sin = _rope_tables(config)
+    max_len = cache["k"].shape[2]
+    cache_pos = jnp.arange(max_len, dtype=jnp.int32)
+    mask = cache_pos[None, None, :] <= positions[:, :, None]
+    if config.sliding_window is not None:
+        mask = mask & (
+            cache_pos[None, None, :] > positions[:, :, None] - config.sliding_window
+        )
+
+    embed = jnp.asarray(params["embed"]).astype(compute_dtype)
+    x = embed[tokens]
+    step = _offloaded_cache_step(config)
+
+    # Stream blocks while carrying per-layer cache slices alongside.
+    n_layers = config.n_layers
+    k_layers, v_layers = [], []
+
+    def body(carry, block, _i=[0]):
+        x = carry
+        i = _i[0]
+        _i[0] += 1
+        x, k_i, v_i = step(
+            block, cache["k"][i], cache["v"][i], x, cos, sin, positions, mask, start
+        )
+        k_layers.append(k_i)
+        v_layers.append(v_i)
+        return x
+
+    x = streamed_scan(body, x, params["blocks"], dtype=compute_dtype)
+    x = rms_norm(x, jnp.asarray(params["final_norm"]), config.norm_eps)
+    head = (
+        embed.T
+        if config.tie_embeddings
+        else jnp.asarray(params["lm_head"]).astype(compute_dtype)
+    )
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    new_cache = {
+        "k": jnp.stack(k_layers),
+        "v": jnp.stack(v_layers),
+        "length": start + T_new,
+    }
+    return logits, new_cache
+
+
+def generate_offloaded(
+    params: Params,
+    prompt: jax.Array,
+    config: LlamaConfig,
+    *,
+    max_new_tokens: int = 16,
+    compute_dtype: Any = jnp.bfloat16,
+) -> jax.Array:
+    """Greedy decoding over host/disk-offloaded blocks. Every generated
+    token streams the full stack once — throughput is storage-bandwidth /
+    model-size, the same roofline as the reference's disk-offloaded
+    OPT-30B `generate` (BASELINE's over-RAM configuration)."""
+    B, S = prompt.shape
+    total = S + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds max_seq_len={config.max_seq_len}"
+        )
+    cache = init_cache(config, B, total, dtype=compute_dtype)
+    logits, cache = forward_with_cache_offloaded(
+        params, prompt, cache, config, compute_dtype=compute_dtype
+    )
+    out = [prompt]
+    last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+    for _ in range(max_new_tokens - 1):
+        out.append(last)
+        logits, cache = forward_with_cache_offloaded(
+            params, last, cache, config, compute_dtype=compute_dtype
+        )
+        last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+    out.append(last)
+    return jnp.concatenate(out, axis=1)
 
 
 def loss_fn(

@@ -573,13 +573,98 @@ def fold_gqa_groups(dk_h, dv_h, K, k_dtype, v_dtype):
     return dk_h.astype(k_dtype), dv_h.astype(v_dtype)
 
 
-def _bwd(scale, block, causal, interpret, valid, residuals, g):
-    q, k, v, o, lse = residuals
-    B, H, S, h = q.shape
-    if _use_resident(S, h, k.dtype):
-        return _bwd_resident(scale, block, causal, interpret, valid, residuals, g)
+# ------------------------------------------------ SPMD partitioning (GSPMD)
+# pallas_call lowers to an opaque custom-call; without a partitioning rule
+# GSPMD replicates the kernel with UNSHARDED operands on every chip — at
+# pod scale that is a full-global-batch 30+ GiB allocation per device
+# (caught by tests/test_pod_aot.py on a deviceless v5e-256 compile). The
+# kernels are embarrassingly parallel over batch and heads, so declare
+# exactly that via `custom_partitioning`: batch/head partitioning passes
+# through (the head factor must divide BOTH H and the GQA K), sequence and
+# head_dim replicate within each shard.
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def _axis_group(mesh, entry) -> int:
+    axes = (entry,) if isinstance(entry, str) else tuple(entry)
+    return int(math.prod(mesh.shape[a] for a in axes))
+
+
+def _bh_sharding(mesh, sharding, H: int, K: int, ndim: int = 4) -> NamedSharding:
+    """Sanitize to batch/head-only partitioning ((B, H|K, S, h) layout)."""
+    spec = list(sharding.spec) + [None] * (ndim - len(tuple(sharding.spec)))
+    b_ax, h_ax = spec[0], spec[1]
+    if h_ax is not None and (H % _axis_group(mesh, h_ax) or K % _axis_group(mesh, h_ax)):
+        h_ax = None
+    return NamedSharding(mesh, PartitionSpec(b_ax, h_ax, *([None] * (ndim - 2))))
+
+
+def _make_bh_partitioned(inner, n_out: int, sharding_rule: str):
+    """Wrap `inner(*tensors, *statics)` (all tensors (B, H|K, S, *)) so the
+    partitioner shards it over batch/heads and runs the kernel per shard.
+    ``sharding_rule`` is the Shardy propagation rule (einsum-like); the
+    partition callback owns the per-shard lowering and re-sanitizes the
+    shardings (head factor must divide both H and the GQA K) either way."""
+
+    def _hk(arg_shapes):
+        return arg_shapes[0].shape[1], arg_shapes[1].shape[1]
+
+    def infer(*cb_args):
+        *_statics, mesh, arg_shapes, result_shape = cb_args
+        H, K = _hk(arg_shapes)
+        sh = _bh_sharding(mesh, arg_shapes[0].sharding, H, K)
+        if n_out == 1:
+            return sh
+        outs = jax.tree.leaves(result_shape)
+        return tuple(
+            NamedSharding(mesh, sh.spec) for _ in range(len(outs))
+        )
+
+    def partition(*cb_args):
+        *statics, mesh, arg_shapes, result_shape = cb_args
+        H, K = _hk(arg_shapes)
+        base = _bh_sharding(mesh, arg_shapes[0].sharding, H, K)
+        arg_sh = tuple(
+            _bh_sharding(mesh, base, H, K, ndim=len(a.shape)) for a in arg_shapes
+        )
+        outs = jax.tree.leaves(result_shape)
+        out_sh = tuple(
+            _bh_sharding(mesh, base, H, K, ndim=len(o.shape)) for o in outs
+        )
+        if n_out == 1:
+            out_sh = out_sh[0]
+
+        def lower(*tensors):
+            return inner(*tensors, *statics)
+
+        return mesh, lower, out_sh, arg_sh
+
+    wrapped = custom_partitioning(inner, static_argnums=tuple(range(
+        _N_TENSORS[inner], _N_TENSORS[inner] + 5
+    )))
+    wrapped.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=sharding_rule,
+    )
+    return wrapped
+
+
+def _fwd_tensors(q, k, v, scale, block, causal, interpret, valid):
+    return _fwd(q, k, v, scale=scale, block=block, causal=causal,
+                interpret=interpret, valid=valid)
+
+
+def _bwd_tensors(q, k, v, o, lse, g, scale, block, causal, interpret, valid):
     do = g
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+    if _use_resident(q.shape[2], q.shape[3], k.dtype):
+        return _bwd_resident(
+            scale, block, causal, interpret, valid, (q, k, v, o, lse), g
+        )
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
     kwargs = dict(scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
     dq = dq_call(q, k, v, do, lse, delta, **kwargs)
     dk_h, dv_h = dkv_call(q, k, v, do, lse, delta, **kwargs)
@@ -587,19 +672,39 @@ def _bwd(scale, block, causal, interpret, valid, residuals, g):
     return dq, dk, dv
 
 
+_N_TENSORS = {_fwd_tensors: 3, _bwd_tensors: 6}
+# i=batch, j=q-heads, g=kv-heads, s=seq, d=head_dim, e=lse trailing unit.
+_fwd_p = _make_bh_partitioned(
+    _fwd_tensors, n_out=2,
+    sharding_rule="i j s d, i g s d, i g s d -> i j s d, i j s e",
+)
+_bwd_p = _make_bh_partitioned(
+    _bwd_tensors, n_out=3,
+    sharding_rule=(
+        "i j s d, i g s d, i g s d, i j s d, i j s e, i j s d "
+        "-> i j s d, i g s d, i g s d"
+    ),
+)
+
+
 # --------------------------------------------------------------- entry point
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, block, causal, interpret, valid):
-    o, _ = _fwd(q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    o, _ = _fwd_p(q, k, v, scale, block, causal, interpret, valid)
     return o
 
 
 def _flash_fwd(q, k, v, scale, block, causal, interpret, valid):
-    o, lse = _fwd(q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    o, lse = _fwd_p(q, k, v, scale, block, causal, interpret, valid)
     return o, (q, k, v, o, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_bwd(scale, block, causal, interpret, valid, residuals, g):
+    q, k, v, o, lse = residuals
+    return _bwd_p(q, k, v, o, lse, g, scale, block, causal, interpret, valid)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(

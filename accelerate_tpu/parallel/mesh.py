@@ -155,6 +155,31 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def constrain_batch(x: jax.Array) -> jax.Array:
+    """Pin dim 0 of an activation to the global batch axes when an ambient
+    mesh is active (``jax.sharding.set_mesh`` — `Accelerator.make_train_step`
+    traces under it); identity otherwise.
+
+    Without this, the partitioner is free to drop the fsdp component of the
+    batch sharding mid-model — at 256 chips that turned the remat-saved
+    attention activations into 34 GiB-per-chip buffers (caught by
+    tests/test_pod_aot.py). Explicit activation annotation is the standard
+    TPU recipe: pick a mesh, annotate, let XLA insert the collectives."""
+    am = jax.sharding.get_abstract_mesh()
+    if am is None or not am.axis_names:
+        return x
+    axes = tuple(a for a in BATCH_AXES if a in am.axis_names and am.shape[a] > 1)
+    if not axes:
+        return x
+    # Non-batch dims stay UNCONSTRAINED (not None): pinning them replicated
+    # would force-gather sequence-sharded activations (ring/ulysses) at the
+    # top of every layer.
+    return jax.lax.with_sharding_constraint(
+        x,
+        PartitionSpec(axes, *([PartitionSpec.UNCONSTRAINED] * (x.ndim - 1))),
+    )
+
+
 def local_batch_count(mesh: Mesh) -> int:
     """How many batch shards live on this process (for host-sharded loading)."""
     return data_parallel_size(mesh) // jax.process_count()

@@ -18,6 +18,7 @@ import sys
 import time
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 # Partial results accumulate here; a timeout kill (SIGTERM) still emits one
@@ -150,6 +151,7 @@ def main() -> None:
             ("hostoffload", lambda: _bench_hostoffload_adamw(fetch_latency)),
             ("vit", lambda: _bench_vit(fetch_latency)),
             ("bigmodel", _bench_bigmodel),
+            ("overram", _bench_overram),
         ]
         for name, fn in extra_benches:
             try:
@@ -600,6 +602,19 @@ def _bench_bigmodel() -> dict:
         with open(os.path.join(repo, "config.json"), "w") as f:
             json.dump(_LLAMA3_8B_HF_CONFIG, f)
 
+    # Raw-read roofline: sequential read of one weight shard, so the load
+    # time has an IO baseline to be judged against (VERDICT r3 #5).
+    shard_file = next(
+        os.path.join(repo, n) for n in sorted(os.listdir(repo))
+        if n.endswith(".safetensors")
+    )
+    t0 = time.perf_counter()
+    read_bytes = 0
+    with open(shard_file, "rb", buffering=0) as f:
+        while chunk := f.read(1 << 24):
+            read_bytes += len(chunk)
+    io_mib_s = read_bytes / (time.perf_counter() - t0) / 2**20
+
     AcceleratorState._reset_state()
     t0 = time.perf_counter()
     loaded = atx.load_pretrained(
@@ -640,8 +655,92 @@ def _bench_bigmodel() -> dict:
         "bigmodel_8b_bits": 8,
         "bigmodel_8b_load_s": round(load_s, 1),
         "bigmodel_8b_synth_s": round(synth_s, 1),
+        "io_read_mib_s": round(io_mib_s, 1),
         "bigmodel_8b_decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
         "bigmodel_8b_decode_ms_per_token": round(1000 * decode_dt / n_tokens, 2),
+    }
+
+
+def _bench_overram() -> dict:
+    """Disk-offloaded decode (VERDICT r3 #4): block weights live on DISK as
+    memmaps (never resident in host RAM), streamed layer-by-layer per
+    generated token — the reference's disk_offload / OPT-30B configuration
+    (`big_modeling.py:260`). Decode rate = link-bandwidth / streamed-bytes;
+    through the axon tunnel H2D is ~20 MiB/s (measured; a real PCIe host
+    does 10+ GiB/s), so the phase streams a layer-sliced view of the 8B
+    repo (same tensors, same loader path, ATX_BENCH_OVERRAM_LAYERS of the
+    32 layers) to keep the phase inside the driver budget, and reports the
+    measured stream bandwidth so the number scales to real hosts."""
+    import dataclasses
+    import os
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.state import AcceleratorState
+
+    cache = os.environ.get("ATX_BENCH_CACHE", "/tmp/atx_bench_cache")
+    repo = os.path.join(cache, "llama3_8b_synth")
+    if not os.path.exists(os.path.join(repo, ".complete")):
+        return {"overram_error": "synth repo missing (bigmodel phase runs first)"}
+    n_layers = int(os.environ.get("ATX_BENCH_OVERRAM_LAYERS", "3"))
+    # A view repo: the 8B safetensors linked in place, config clamped to the
+    # first n_layers (the loader reads only the tensors the shapes need).
+    view = os.path.join(cache, f"overram_view_l{n_layers}")
+    os.makedirs(view, exist_ok=True)
+    cfg = dict(_LLAMA3_8B_HF_CONFIG)
+    cfg["num_hidden_layers"] = n_layers
+    with open(os.path.join(view, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    for name in os.listdir(repo):
+        if name.endswith(".safetensors") or name.endswith(".index.json"):
+            dst = os.path.join(view, name)
+            if not os.path.exists(dst):
+                os.symlink(os.path.join(repo, name), dst)
+
+    AcceleratorState._reset_state()
+    t0 = time.perf_counter()
+    loaded = atx.load_pretrained(
+        view,
+        mesh=atx.build_mesh(atx.MeshConfig()),
+        dtype=jnp.bfloat16,
+        # Budget just above the resident set (embed+lm_head bf16 = 2.1 GiB)
+        # so every block is forced onto disk.
+        hbm_budget=int(2.4 * 2**30),
+        no_offload_patterns=("embed", "lm_head", "final_norm"),
+        offload_dir=os.path.join(view, "offload"),
+    )
+    load_s = time.perf_counter() - t0
+    n_memmap = sum(
+        isinstance(l, np.memmap) for l in jax.tree.leaves(loaded.params)
+    )
+    if n_memmap == 0:
+        return {"overram_error": "plan offloaded nothing to disk"}
+    streamed_bytes = sum(
+        l.nbytes for l in jax.tree.leaves(loaded.params) if isinstance(l, np.memmap)
+    )
+
+    gen_config = dataclasses.replace(
+        loaded.config, remat=False, attention_impl="dot", max_seq_len=64
+    )
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (1, 16), 0, gen_config.vocab_size, jnp.int32
+    )
+    n_new = int(os.environ.get("ATX_BENCH_OVERRAM_TOKENS", "2"))
+    t0 = time.perf_counter()
+    out = llama.generate_offloaded(
+        loaded.params, prompt, gen_config, max_new_tokens=n_new
+    )
+    int(out[0, -1])
+    dt = time.perf_counter() - t0
+    # generate_offloaded runs 1 prefill + (n_new - 1) decode forwards.
+    per_pass = dt / n_new
+    return {
+        "bigmodel_overram_disk_leaves": n_memmap,
+        "bigmodel_overram_layers": n_layers,
+        "bigmodel_overram_streamed_gib_per_token": round(streamed_bytes / 2**30, 2),
+        "bigmodel_overram_stream_mib_s": round(streamed_bytes / per_pass / 2**20, 1),
+        "bigmodel_overram_load_s": round(load_s, 1),
+        "bigmodel_overram_decode_tokens_per_sec": round(n_new / dt, 4),
     }
 
 

@@ -1,0 +1,107 @@
+"""Feature example: gradient accumulation for autoregressive models.
+
+Reference analog:
+`examples/by_feature/gradient_accumulation_for_autoregressive_models.py` —
+the `num_items_in_batch` fix. With PADDED variable-length sequences, naive
+accumulation averages each microbatch's per-token-mean loss equally, which
+over-weights tokens in short-sequence microbatches; the correct objective
+divides every microbatch's token-SUM by the GLOBAL token count. The recipe
+here: ship the global unpadded token count inside the batch (replicated
+per microbatch by the accumulation reshape) and normalize by it in the
+loss — the accumulated gradients then equal the whole-batch gradients
+exactly, which this example verifies.
+
+Run: python examples/by_feature/gradient_accumulation_for_autoregressive_models.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.models import llama
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+CONFIG = llama.LlamaConfig.tiny()
+
+
+def _token_sum_loss(params, batch, rng):
+    """Cross entropy summed over real (unmasked) next-token positions,
+    normalized by the GLOBAL token count the batch carries — the
+    num_items_in_batch recipe. The scan's mean over microbatch losses then
+    telescopes to sum/global for the whole batch."""
+    logits = llama.forward(
+        params, batch["input_ids"], CONFIG, mask=batch["attention_mask"]
+    )
+    labels = batch["input_ids"][:, 1:]
+    mask = batch["attention_mask"][:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # global_tokens is replicated per sample; accumulation splits the batch
+    # but every microbatch still sees the full-batch count.
+    global_tokens = batch["global_tokens"][0].astype(jnp.float32)
+    # x n_microbatches because the accumulation scan MEANS microbatch
+    # losses/grads; the product telescopes back to sum/global_tokens.
+    return jnp.sum(nll * mask) * (batch["n_microbatches"][0] / global_tokens)
+
+
+def _make_batch(rng: np.random.RandomState, batch: int, max_len: int, accum: int):
+    lengths = rng.randint(max_len // 4, max_len + 1, size=batch)
+    ids = rng.randint(0, CONFIG.vocab_size, size=(batch, max_len)).astype(np.int32)
+    mask = (np.arange(max_len)[None, :] < lengths[:, None]).astype(np.int32)
+    ids = ids * mask  # padded positions -> token 0 (masked out of the loss)
+    global_tokens = int(mask[:, 1:].sum())
+    return {
+        "input_ids": jnp.asarray(ids),
+        "attention_mask": jnp.asarray(mask),
+        "global_tokens": jnp.full((batch,), global_tokens, jnp.int32),
+        "n_microbatches": jnp.full((batch,), accum, jnp.float32),
+    }
+
+
+def _train(accum: int, steps: int):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = atx.Accelerator(gradient_accumulation_steps=accum, seed=0)
+    state = acc.create_train_state(
+        lambda r: llama.init(r, CONFIG), optax.sgd(0.1)
+    )
+    step = acc.make_train_step(_token_sum_loss)
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        state, metrics = step(state, _make_batch(rng, 8, 32, accum))
+    return state
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--steps", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    whole = _train(1, args.steps)
+    split = _train(4, args.steps)
+    deltas = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+        whole.params,
+        split.params,
+    )
+    max_delta = max(jax.tree.leaves(deltas))
+    print(
+        f"max |param delta| between whole-batch and 4-way accumulated "
+        f"training on padded variable-length batches: {max_delta:.2e}"
+    )
+    return max_delta
+
+
+if __name__ == "__main__":
+    main()

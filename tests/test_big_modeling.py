@@ -1,6 +1,7 @@
 """Big-model inference tests (reference `tests/test_big_modeling.py`,
 `test_modeling_utils.py` — device maps, offload, dispatch)."""
 
+import json
 import os
 
 import jax
@@ -283,3 +284,106 @@ class TestShardedGenerate:
         sharded = shard_pytree(params, param_specs, acc.mesh)
         got = np.asarray(llama.generate(sharded, prompt, config, generation_config=gen_cfg))
         np.testing.assert_array_equal(got, want)
+
+
+class TestDiskOffload:
+    """Disk-offloaded inference (VERDICT r3 #4): offloaded leaves live on
+    disk as memmaps (reference disk_offload / OffloadedWeightsLoader,
+    `big_modeling.py:260`, `utils/offload.py:127`), streamed per layer —
+    host RAM never holds the model."""
+
+    def _loaded(self, tmp_path, **kw):
+        import torch
+        import transformers
+
+        from accelerate_tpu.models import hf
+
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(3)
+        model = transformers.LlamaForCausalLM(cfg).eval()
+        repo = str(tmp_path / "repo")
+        model.save_pretrained(repo, safe_serialization=True)
+        mesh = build_mesh(MeshConfig())
+        loaded = hf.load_pretrained(repo, mesh=mesh, **kw)
+        return model, loaded
+
+    def test_offloaded_leaves_are_memmaps(self, tmp_path):
+        import torch
+
+        from accelerate_tpu.models import llama
+
+        model, loaded = self._loaded(
+            tmp_path,
+            hbm_budget=2_000,  # force almost everything off-device
+            offload_dir=str(tmp_path / "offload"),
+        )
+        assert loaded.plan.offload
+        mm = [
+            l for l in jax.tree.leaves(loaded.params)
+            if isinstance(l, np.memmap)
+        ]
+        assert mm, "no leaf came back as a disk memmap"
+        # index.json mirrors the reference offload_dir layout.
+        index = json.load(open(tmp_path / "offload" / "index.json"))
+        assert len(index) == len(mm)
+        # Offloaded forward matches transformers exactly.
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 128
+        ours = np.asarray(
+            llama.forward_offloaded(
+                loaded.params, jnp.asarray(tokens), loaded.config,
+                compute_dtype=jnp.float32,
+            )
+        )
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_offload_dir_reused_across_loads(self, tmp_path):
+        from accelerate_tpu.models import hf
+
+        _, loaded = self._loaded(
+            tmp_path, hbm_budget=2_000, offload_dir=str(tmp_path / "offload")
+        )
+        index_path = tmp_path / "offload" / "index.json"
+        first_mtime = index_path.stat().st_mtime_ns
+        # Same (unchanged) repo -> cache hit, no re-dump.
+        hf.load_pretrained(
+            str(tmp_path / "repo"), mesh=build_mesh(MeshConfig()),
+            hbm_budget=2_000, offload_dir=str(tmp_path / "offload"),
+        )
+        assert index_path.stat().st_mtime_ns == first_mtime
+        # A DIFFERENT checkpoint into the same offload_dir must re-dump —
+        # shape/dtype alone must never serve another model's weights.
+        _, _loaded2 = self._loaded(
+            tmp_path, hbm_budget=2_000, offload_dir=str(tmp_path / "offload")
+        )
+        assert index_path.stat().st_mtime_ns != first_mtime
+
+    def test_offloaded_decode_matches_cache_forward(self, tmp_path):
+        from accelerate_tpu.models import llama
+
+        _, loaded = self._loaded(
+            tmp_path, hbm_budget=2_000, offload_dir=str(tmp_path / "offload")
+        )
+        tokens = jnp.arange(8, dtype=jnp.int32)[None, :] % 128
+        out = llama.generate_offloaded(
+            loaded.params, tokens, loaded.config,
+            max_new_tokens=4, compute_dtype=jnp.float32,
+        )
+        assert out.shape == (1, 12)
+        # Parity against the fully-resident greedy path.
+        resident = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x)), loaded.params
+        )
+        full = llama.generate(
+            resident, tokens, loaded.config,
+            generation_config=__import__(
+                "accelerate_tpu"
+            ).GenerationConfig(max_new_tokens=4, temperature=0.0),
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(full))

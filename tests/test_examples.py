@@ -119,6 +119,13 @@ def test_lm_example_learns_and_resumes(tmp_path):
         ("local_sgd", [], lambda r: r < 0.1),
         ("multi_process_metrics", [], lambda r: r == 77),
         ("automatic_gradient_accumulation", ["--fail_below", "16"], lambda r: r == 16),
+        ("cross_validation", ["--epochs", "40"], lambda r: r < 0.2),
+        ("schedule_free", ["--steps", "200", "--lr", "0.1"], lambda r: r < 0.2),
+        # peak bytes: 0 on the CPU simulator (no allocator stats), real on TPU
+        ("fsdp_with_peak_mem_tracking", ["--epochs", "1"], lambda r: r >= 0),
+        # whole-batch == accumulated on padded variable-length batches
+        ("gradient_accumulation_for_autoregressive_models", ["--steps", "2"],
+         lambda r: r < 1e-4),
     ],
 )
 def test_by_feature_examples(name, args, check):

@@ -305,6 +305,34 @@ class TestMixtralParity:
         np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
 
 
+class TestMixtralExport:
+    def test_export_round_trip(self, tmp_path):
+        """VERDICT r3 #8: close the migration loop for the sparse family —
+        per-expert inverse transforms re-fuse block_sparse_moe and
+        transformers reproduces the original logits."""
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+        )
+        torch.manual_seed(14)
+        model = transformers.MixtralForCausalLM(cfg).eval()
+        repo = _save_hf(model, tmp_path, "mixtralsrc")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out_dir = str(tmp_path / "mixtralexp")
+        hf.save_pretrained(out_dir, loaded.family, loaded.config, loaded.params)
+        exported = json.load(open(f"{out_dir}/config.json"))
+        assert exported["model_type"] == "mixtral"
+        assert exported["num_local_experts"] == 4
+        reloaded = transformers.MixtralForCausalLM.from_pretrained(out_dir).eval()
+        tokens = np.arange(24, dtype=np.int32).reshape(2, 12) % 128
+        with torch.no_grad():
+            orig = model(torch.from_numpy(tokens).long()).logits.numpy()
+            ours = reloaded(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, orig, atol=2e-5, rtol=1e-4)
+
+
 class TestQwen2Parity:
     def test_forward_matches_transformers(self, tmp_path):
         cfg = transformers.Qwen2Config(

@@ -1,0 +1,144 @@
+"""AOT validation of the pod-scale story (VERDICT r3 #6 / weak #5).
+
+The 45%-MFU north star is defined on a v5e-256; no 256-chip hardware is
+reachable from CI, but XLA's TPU compiler is — `jax.experimental.topologies`
+builds a deviceless v5e 16x16 topology and `jit(...).lower(...).compile()`
+produces the real SPMD executable plus its memory analysis. These tests pin
+down the two things a pod run would discover on day one:
+
+- the per-chip HBM footprint of the 8B train step fits 16 GiB, and
+- the collective set is the expected one (all-gather + reduce-scatter for
+  FSDP; additional all-reduces once a tensor axis is in play).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.heavy  # multi-minute XLA compiles
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.parallel.sharding import (
+    ShardingStrategy,
+    infer_opt_specs,
+    infer_param_specs,
+    to_named_shardings,
+)
+from accelerate_tpu.utils.dataclasses import FsdpPlugin
+
+V5E_HBM = 16 * 1024**3
+
+
+def _topology_mesh(shape_by_axis: dict[str, int]) -> Mesh:
+    from jax.experimental import topologies
+
+    try:
+        topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:16x16")
+    except Exception as e:  # no libtpu compiler in this environment
+        pytest.skip(f"deviceless TPU topology unavailable: {e}")
+    devices = np.array(topo.devices).reshape(tuple(shape_by_axis.values()))
+    return Mesh(devices, tuple(shape_by_axis))
+
+
+def _aot_train_step(mesh: Mesh, rules=()):
+    """Lower + AOT-compile one full 8B train step (bf16 compute, fp32
+    master params, sharded adamw) against the topology mesh; returns the
+    compiled executable."""
+    # dot (not flash) attention: the deviceless AOT compiler cannot emit
+    # custom_partitioning callbacks ("Custom emitter for
+    # CustomSPMDPartitioning not found"), and the unfused path upper-bounds
+    # the fused kernel's memory anyway. The flash partitioning itself is
+    # runtime-verified on the simulated mesh (test_flash_partitions_under_jit).
+    config = llama.LlamaConfig.llama3_8b(
+        remat=True,
+        remat_policy="attn_and_outputs",
+        attention_impl="dot",
+        loss_chunk_size=512,
+    )
+    strategy = ShardingStrategy.resolve(FsdpPlugin(), rules=tuple(rules))
+    shapes = jax.eval_shape(lambda: llama.init(jax.random.PRNGKey(0), config))
+    tx = optax.adamw(1e-4)
+    param_specs = infer_param_specs(shapes, mesh, strategy)
+    opt_shapes = jax.eval_shape(tx.init, shapes)
+    opt_specs = infer_opt_specs(opt_shapes, shapes, param_specs, mesh, strategy)
+    param_sh = to_named_shardings(param_specs, mesh)
+    opt_sh = to_named_shardings(opt_specs, mesh)
+    batch_sh = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            cp = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                p,
+            )
+            return llama.loss_fn(cp, {"input_ids": tokens}, config).astype(jnp.float32)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Global batch = one sample per (data, fsdp) slot: batch replicates
+    # over tensor, so sizing by total devices would 8x the activations.
+    n = mesh.shape["data"] * mesh.shape["fsdp"]
+    arg_shapes = (
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+                     shapes, param_sh),
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+                     opt_shapes, opt_sh),
+        jax.ShapeDtypeStruct((n, 4096), jnp.int32, sharding=batch_sh),
+    )
+    with jax.sharding.set_mesh(mesh):
+        lowered = jax.jit(
+            step,
+            out_shardings=(param_sh, opt_sh, NamedSharding(mesh, PartitionSpec())),
+            donate_argnums=(0, 1),
+        ).lower(*arg_shapes)
+        return lowered.compile()
+
+
+def _assert_fits(compiled) -> int:
+    mem = compiled.memory_analysis()
+    per_chip = (
+        mem.argument_size_in_bytes
+        + mem.output_size_in_bytes
+        + mem.temp_size_in_bytes
+        - mem.alias_size_in_bytes
+    )
+    assert per_chip < V5E_HBM * 0.9, (
+        f"8B step needs {per_chip / 2**30:.2f} GiB/chip against 16 GiB"
+    )
+    return per_chip
+
+
+def test_8b_fsdp_step_fits_v5e_256():
+    mesh = _topology_mesh({"data": 8, "fsdp": 32})
+    compiled = _aot_train_step(mesh)
+    per_chip = _assert_fits(compiled)
+    hlo = compiled.as_text()
+    # GSPMD must have materialized the FSDP schedule: gather-on-use and
+    # scatter-on-grad collectives.
+    assert "all-gather" in hlo
+    assert "reduce-scatter" in hlo
+    print(f"fsdp 8x32: {per_chip / 2**30:.2f} GiB/chip")
+
+
+def test_8b_fsdp_tensor_step_fits_v5e_256():
+    from accelerate_tpu.parallel.tp import get_tp_plan
+
+    mesh = _topology_mesh({"data": 4, "fsdp": 8, "tensor": 8})
+    compiled = _aot_train_step(mesh, rules=get_tp_plan("llama"))
+    per_chip = _assert_fits(compiled)
+    hlo = compiled.as_text()
+    assert "all-gather" in hlo
+    assert "reduce-scatter" in hlo
+    # Tensor-parallel activations reduce with all-reduce (psum).
+    assert "all-reduce" in hlo
+    print(f"fsdp 4x8x8: {per_chip / 2**30:.2f} GiB/chip")
