@@ -96,14 +96,19 @@ def _resolve_model(model: str) -> tuple[str, Any]:
             if k.lower() == f"{family}config" and isinstance(v, type)
         )
         return family, getattr(config_cls, preset)()
-    if os.path.exists(model):
-        from ..models.hf import from_hf_config
+    from ..models.hf import from_hf_config
 
+    try:
+        # Local repo dir / config.json, or a Hub id resolved cache-first
+        # (models.hf.resolve_repo) — the reference estimate's Hub-name
+        # ergonomics (`commands/estimate.py:64`).
         return from_hf_config(model)
-    raise SystemExit(
-        f"Unknown model {model!r}: not a preset "
-        f"({', '.join(sorted(_MODEL_PRESETS))}) and no such path exists."
-    )
+    except ValueError as e:
+        raise SystemExit(
+            f"Unknown model {model!r}: not a preset "
+            f"({', '.join(sorted(_MODEL_PRESETS))}) and not resolvable as a "
+            f"repo path or Hub id ({e})."
+        ) from e
 
 
 def estimate(model: str, batch_size: int, seq_len: int, precision: str,

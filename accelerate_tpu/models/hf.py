@@ -483,6 +483,50 @@ def _num_labels(config: dict, default: int = 2) -> int:
     return default
 
 
+def resolve_repo(path_or_id: str) -> str:
+    """Local directory/file passthrough, or Hugging Face Hub id resolution
+    (reference `create_empty_model` accepts Hub names, `commands/estimate.py:64`).
+
+    Hub ids resolve cache-first (`snapshot_download(local_files_only=True)`
+    — works fully offline against a pre-populated HF_HUB_CACHE), then via
+    the network; both failing raises with the pre-download remedy."""
+    path = os.fspath(path_or_id)
+    if os.path.exists(path):
+        return path
+    # Hub ids look like "org/name" (or bare "name"): no absolute/relative
+    # filesystem syntax.
+    if path.startswith((".", "/", "~")) or path.count("/") > 1:
+        raise ValueError(f"checkpoint path {path!r} does not exist")
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:
+        raise ValueError(
+            f"{path!r} is not a local directory and huggingface_hub is not "
+            "installed to resolve it as a Hub id."
+        ) from e
+    patterns = ["*.safetensors", "*.safetensors.index.json", "config.json"]
+    # huggingface_hub latches HF_HUB_CACHE at import; read the env at call
+    # time so per-process/per-test cache dirs work.
+    cache_dir = os.environ.get("HF_HUB_CACHE") or None
+    try:
+        return snapshot_download(
+            path, allow_patterns=patterns, local_files_only=True,
+            cache_dir=cache_dir,
+        )
+    except Exception:
+        pass
+    try:
+        return snapshot_download(path, allow_patterns=patterns, cache_dir=cache_dir)
+    except Exception as e:
+        raise ValueError(
+            f"{path!r} is not a local directory, is not in the local Hub "
+            f"cache, and could not be downloaded ({type(e).__name__}: {e}). "
+            "In an air-gapped environment, pre-download with "
+            f"`huggingface-cli download {path}` on a connected machine and "
+            "point HF_HUB_CACHE at the result, or pass a local repo path."
+        ) from e
+
+
 def _parse_rope_scaling(rs: dict | None, RopeScaling: Any) -> Any:
     """HF ``rope_scaling`` dict -> layers.RopeScaling (or None).
 
@@ -520,6 +564,8 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     ``(family, FamilyConfig)`` for this framework's model zoo."""
     if isinstance(config, (str, os.PathLike)):
         path = os.fspath(config)
+        if not path.endswith(".json"):
+            path = resolve_repo(path)
         if os.path.isdir(path):
             path = os.path.join(path, "config.json")
         with open(path) as f:
@@ -762,6 +808,7 @@ def load_pretrained(
 
         mesh = AcceleratorState().mesh
 
+    path = resolve_repo(path)
     family, config = from_hf_config(path)
     if rules is None:
         from ..parallel.tp import get_tp_plan
