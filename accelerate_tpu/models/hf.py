@@ -564,7 +564,10 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
     ``(family, FamilyConfig)`` for this framework's model zoo."""
     if isinstance(config, (str, os.PathLike)):
         path = os.fspath(config)
-        if not path.endswith(".json"):
+        if path.endswith(".json"):
+            if not os.path.exists(path):
+                raise ValueError(f"checkpoint config {path!r} does not exist")
+        else:
             path = resolve_repo(path)
         if os.path.isdir(path):
             path = os.path.join(path, "config.json")

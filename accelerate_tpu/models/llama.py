@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import warnings
 from functools import partial
 from typing import Any
 
@@ -220,22 +219,16 @@ def _attention(config: LlamaConfig, q, k, v, mask):
             "is not implemented (the band mask needs per-chunk plumbing); "
             "use 'dot'."
         )
-    if config.sliding_window is not None and config.attention_impl == "flash":
-        # flash_attention falls back to the unfused O(S^2) oracle whenever a
-        # full (B, S, T) mask is passed — at the long contexts windows exist
-        # to serve, that materializes the full logit matrix. Don't let that
-        # happen silently.
-        warnings.warn(
-            "sliding_window with attention_impl='flash' currently runs the "
-            "unfused O(S^2) attention path (the fused kernel has no band "
-            "support yet); expect oracle-level memory/speed at long sequence "
-            "lengths.",
-            stacklevel=3,
-        )
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, segment_mask=mask)
+        # window only when no mask arrived: a non-None mask means the band
+        # (if any) is already folded in by the caller (see forward) — the
+        # kernel's row-index band must not be applied on top.
+        return flash_attention(
+            q, k, v, causal=True, segment_mask=mask,
+            window=config.sliding_window if mask is None else None,
+        )
     if config.attention_impl in ("ring", "ulysses"):
         if mask is not None and mask.ndim != 2:
             raise NotImplementedError(
@@ -344,10 +337,20 @@ def forward(
         # RoPE table gathers clamp out-of-range positions under jit, which
         # would silently degrade instead of failing.
         raise ValueError(f"sequence length {S} exceeds max_seq_len={config.max_seq_len}")
+    default_positions = positions is None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos, sin = _rope_tables(config)
-    if config.sliding_window is not None:
+    if config.sliding_window is not None and not (
+        config.attention_impl == "flash" and default_positions and mask is None
+    ):
+        # flash applies the band in-kernel (tile skipping) — but only for
+        # the unmasked default-positions case; explicit positions (packed/
+        # shifted sequences) band by POSITION, which the kernel's row-index
+        # band cannot express, and user masks force the oracle anyway, so
+        # every other combination folds into ONE materialized mask
+        # (_attention then passes no window — the band must not be applied
+        # twice with different anchors).
         mask = _window_mask(mask, positions, S, config.sliding_window)
 
     x = params["embed"][tokens]

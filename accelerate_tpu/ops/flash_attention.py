@@ -79,26 +79,37 @@ def _call_kwargs(interpret):
 
 
 
-def _block_live(q_start, block_q, k_start, *, causal, valid):
+def _block_live(q_start, block_q, k_start, *, causal, valid, window=None, block_k=None):
     """Should this (Q-block, KV-block) tile be computed at all?"""
-    return (q_start + block_q - 1 >= k_start) if causal else (k_start < valid)
+    live = (q_start + block_q - 1 >= k_start) if causal else (k_start < valid)
+    if window is not None:
+        # Sliding window: key c visible from row r iff r - c < window. The
+        # tile is dead when even its newest key is out of every row's band.
+        bk = block_k if block_k is not None else block_q
+        live = jnp.logical_and(live, q_start - (k_start + bk - 1) < window)
+    return live
 
 
-def _mask_scores(s, q_start, k_start, *, causal, valid):
-    """Apply causal / padded-column masking to a (bq, bk) score tile."""
-    if causal:
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        return jnp.where(rows >= cols, s, _NEG_INF)
+def _mask_scores(s, q_start, k_start, *, causal, valid, window=None):
+    """Apply causal / window / padded-column masking to a (bq, bk) tile."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(cols < valid, s, _NEG_INF)
+    if causal:
+        keep = rows >= cols
+        if window is not None:
+            keep = jnp.logical_and(keep, rows - cols < window)
+        return jnp.where(keep, s, _NEG_INF)
+    keep = cols < valid
+    if window is not None:
+        keep = jnp.logical_and(keep, rows - cols < window)
+    return jnp.where(keep, s, _NEG_INF)
 
 
 # ---------------------------------------------------- resident-KV kernels
 # Original single-pass kernels: K/V for the whole sequence stay staged in
 # VMEM while one Q block loops over them — fastest when they fit (short/
 # medium S), used below _RESIDENT_KV_BUDGET bytes of staged KV.
-def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid):
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, causal, seq_len, valid, window=None):
     qi = pl.program_id(2)
     # Keep matmul operands in their native (bf16) dtype: the MXU runs bf16 x
     # bf16 -> f32 at full rate, while f32 x f32 passes take a multiple of the
@@ -110,6 +121,9 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, c
     n_blocks = seq_len // block
     # Causal: KV blocks strictly above the diagonal contribute nothing.
     hi = jnp.minimum((q_start + bq + block - 1) // block, n_blocks) if causal else n_blocks
+    # Sliding window: KV blocks entirely below the band contribute nothing
+    # either — the loop starts at the window's oldest live block.
+    lo = jnp.maximum((q_start - (window - 1)) // block, 0) if window is not None else 0
 
     def body(j, carry):
         m, l, acc = carry
@@ -121,10 +135,17 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, c
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        elif valid < seq_len:
+            keep = rows >= cols
+            if window is not None:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG_INF)
+        elif valid < seq_len or window is not None:
             cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols < valid, s, _NEG_INF)
+            keep = cols < valid
+            if window is not None:
+                rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -139,20 +160,21 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, c
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, head_dim), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)  # (bq, 1)
 
 
 
-def _fwd_resident(q, k, v, *, scale, block, causal, interpret, valid):
+def _fwd_resident(q, k, v, *, scale, block, causal, interpret, valid, window=None):
     B, H, S, h = q.shape
     K = k.shape[1]
     group = H // K
     grid = (B, H, S // block)
     kernel = functools.partial(
-        _fwd_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid
+        _fwd_kernel_resident, scale=scale, block=block, causal=causal,
+        seq_len=S, valid=valid, window=window,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -324,12 +346,19 @@ def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
 # ------------------------------------------------------------------- forward
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, scale, block_q, block_k, causal, valid,
+    *, scale, block_q, block_k, causal, valid, window=None, window_grid=False,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     q_start = qi * block_q
-    k_start = ki * block_k
+    if window_grid:
+        # Banded grid: the KV-block axis only spans the window's live
+        # diagonal band — ki indexes positions [qi - (nk-1), qi]. k_start
+        # may be negative at the left edge; those tiles mask to nothing
+        # (their fetch is clamped to block 0 by the index map).
+        k_start = (qi - (nk - 1) + ki) * block_k
+    else:
+        k_start = ki * block_k
 
     @pl.when(ki == 0)
     def _init():
@@ -337,8 +366,17 @@ def _fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # Causal: blocks entirely above the diagonal contribute nothing.
-    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+    # Causal: blocks entirely above the diagonal contribute nothing;
+    # a sliding window additionally kills blocks below the band.
+    run = _block_live(
+        q_start, block_q, k_start,
+        causal=causal, valid=valid, window=window, block_k=block_k,
+    )
+    if window_grid:
+        # Left-edge band positions before the sequence start do not exist;
+        # without this the clamped fetch would re-read block 0 under a
+        # shifted (wrong) mask and double-count its keys.
+        run = jnp.logical_and(run, k_start >= 0)
 
     @pl.when(run)
     def _block():
@@ -351,7 +389,9 @@ def _fwd_kernel(
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk) f32
-        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        s = _mask_scores(
+            s, q_start, k_start, causal=causal, valid=valid, window=window
+        )
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -372,25 +412,42 @@ def _fwd_kernel(
         lse_ref[0, 0] = (m_ref[...] + jnp.log(l_safe)).astype(jnp.float32)
 
 
-def _fwd(q, k, v, *, scale, block, causal, interpret, valid):
+def _fwd(q, k, v, *, scale, block, causal, interpret, valid, window=None):
     B, H, S, h = q.shape
     if _use_resident(S, h, k.dtype):
         return _fwd_resident(
-            q, k, v, scale=scale, block=block, causal=causal, interpret=interpret, valid=valid
+            q, k, v, scale=scale, block=block, causal=causal,
+            interpret=interpret, valid=valid, window=window,
         )
     K = k.shape[1]
     group = H // K
-    grid = (B, H, S // block, S // block)
+    nq = S // block
+    # With a sliding window, the KV-grid axis spans only the live band —
+    # dead tiles are never fetched or visited, so work (and DMA) scales
+    # with O(S * window) instead of O(S^2).
+    if window is not None and causal:
+        n_eff = min(nq, (window + block - 1) // block + 1)
+        window_grid = n_eff < nq
+    else:
+        n_eff, window_grid = nq, False
+    grid = (B, H, nq, n_eff)
+
+    def kv_index(b, hh, qi, ki):
+        if window_grid:
+            return (b, hh // group, jnp.maximum(qi - (n_eff - 1) + ki, 0), 0)
+        return (b, hh // group, ki, 0)
+
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+        _fwd_kernel, scale=scale, block_q=block, block_k=block, causal=causal,
+        valid=valid, window=window, window_grid=window_grid,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), kv_index),
+            pl.BlockSpec((1, 1, block, h), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
@@ -641,7 +698,7 @@ def _make_bh_partitioned(inner, n_out: int, sharding_rule: str):
         return mesh, lower, out_sh, arg_sh
 
     wrapped = custom_partitioning(inner, static_argnums=tuple(range(
-        _N_TENSORS[inner], _N_TENSORS[inner] + 5
+        _N_TENSORS[inner], _N_TENSORS[inner] + 6
     )))
     wrapped.def_partition(
         partition=partition,
@@ -651,12 +708,18 @@ def _make_bh_partitioned(inner, n_out: int, sharding_rule: str):
     return wrapped
 
 
-def _fwd_tensors(q, k, v, scale, block, causal, interpret, valid):
+def _fwd_tensors(q, k, v, scale, block, causal, interpret, valid, window):
     return _fwd(q, k, v, scale=scale, block=block, causal=causal,
-                interpret=interpret, valid=valid)
+                interpret=interpret, valid=valid, window=window)
 
 
-def _bwd_tensors(q, k, v, o, lse, g, scale, block, causal, interpret, valid):
+def _bwd_tensors(q, k, v, o, lse, g, scale, block, causal, interpret, valid, window):
+    if window is not None:
+        raise NotImplementedError(
+            "flash attention backward with a sliding window is not "
+            "implemented; train windowed models with attention_impl='dot' "
+            "(the fused window kernel serves inference)."
+        )
     do = g
     if _use_resident(q.shape[2], q.shape[3], k.dtype):
         return _bwd_resident(
@@ -688,20 +751,20 @@ _bwd_p = _make_bh_partitioned(
 
 
 # --------------------------------------------------------------- entry point
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, block, causal, interpret, valid):
-    o, _ = _fwd_p(q, k, v, scale, block, causal, interpret, valid)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, block, causal, interpret, valid, window):
+    o, _ = _fwd_p(q, k, v, scale, block, causal, interpret, valid, window)
     return o
 
 
-def _flash_fwd(q, k, v, scale, block, causal, interpret, valid):
-    o, lse = _fwd_p(q, k, v, scale, block, causal, interpret, valid)
+def _flash_fwd(q, k, v, scale, block, causal, interpret, valid, window):
+    o, lse = _fwd_p(q, k, v, scale, block, causal, interpret, valid, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, block, causal, interpret, valid, residuals, g):
+def _flash_bwd(scale, block, causal, interpret, valid, window, residuals, g):
     q, k, v, o, lse = residuals
-    return _bwd_p(q, k, v, o, lse, g, scale, block, causal, interpret, valid)
+    return _bwd_p(q, k, v, o, lse, g, scale, block, causal, interpret, valid, window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -717,8 +780,15 @@ def flash_attention(
     block_size: int | None = None,
     scale: float | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Fused attention over (B, S, H, h) queries and (B, T, K, h) kv (GQA).
+
+    ``window`` enables Mistral-style sliding-window attention IN the kernel:
+    key c is visible from row r iff ``r - c < window``; tiles entirely
+    outside the band are skipped, so long-window-bounded contexts run at
+    O(S * window) instead of O(S^2). Forward-only — the windowed backward
+    raises (train windowed models with the unfused path).
 
     Falls back to the XLA reference path when the shape is out of kernel
     territory (S not a multiple of the block, or an explicit padding mask —
@@ -732,6 +802,19 @@ def flash_attention(
     if segment_mask is not None or S != T or S < 16:
         from ..models.layers import dot_product_attention
 
+        if window is not None:
+            # Queries are the last S of T absolute positions (the KV-cache
+            # decode convention); anchoring at row index 0 would make the
+            # band a no-op for single-token decode.
+            rows = (T - S) + jnp.arange(S)[:, None]
+            cols = jnp.arange(T)[None, :]
+            band = jnp.broadcast_to((rows - cols < window), (B, S, T))
+            segment_mask = (
+                band
+                if segment_mask is None
+                else band
+                & (segment_mask[:, None, :] if segment_mask.ndim == 2 else segment_mask).astype(bool)
+            )
         return dot_product_attention(q, k, v, mask=segment_mask, causal=causal, scale=scale)
     interpret = _interpret_default() if interpret is None else interpret
     if block_size is None:
@@ -761,7 +844,7 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    o = _flash(qt, kt, vt, scale, block, causal, interpret, S)
+    o = _flash(qt, kt, vt, scale, block, causal, interpret, S, window)
     o = o.transpose(0, 2, 1, 3)
     return o[:, :S] if padded != S else o
 

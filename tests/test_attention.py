@@ -357,3 +357,140 @@ def test_flash_partitions_under_jit():
         g = jax.jit(jax.grad(loss))(qd, kd, vd)
     g_ref = jax.grad(lambda a, b, c: jnp.sum(dot_product_attention(a, b, c, causal=True) ** 2))(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=5e-3, rtol=5e-2)
+
+
+class TestSlidingWindowKernel:
+    """In-kernel sliding-window attention (band tile skipping): numerics
+    must match the oracle with the band mask, on both kernel paths."""
+
+    def _ref(self, q, k, v, window):
+        from accelerate_tpu.models.layers import dot_product_attention
+
+        S = q.shape[1]
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        mask = jnp.broadcast_to(band, (q.shape[0], S, S))
+        return dot_product_attention(q, k, v, mask=mask, causal=True)
+
+    @pytest.mark.parametrize("S,window", [(128, 32), (256, 64), (256, 200)])
+    def test_matches_banded_oracle(self, S, window):
+        from accelerate_tpu.ops.flash_attention import flash_attention
+
+        B, H, K, h = 2, 4, 2, 32
+        k0 = jax.random.PRNGKey(3)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, window=window)
+        ref = self._ref(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-2)
+        # And the window actually changes the result vs full causal.
+        if window < S:
+            full = flash_attention(q, k, v, causal=True)
+            assert np.abs(np.asarray(out) - np.asarray(full)).max() > 1e-3
+
+    @pytest.mark.parametrize("block", [64, 128, 256])
+    def test_blocked_path_matches_banded_oracle(self, monkeypatch, block):
+        """Small blocks force window_grid=True (the banded KV grid): the
+        left-edge tiles with clamped fetches must be fully masked — the
+        review repro that double-counted block-0 keys."""
+        from accelerate_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_use_resident", lambda *a: False)
+        B, S, H, K, h, window = 1, 256, 2, 2, 32, 96
+        k0 = jax.random.PRNGKey(4)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        out = fa.flash_attention(
+            q, k, v, causal=True, window=window, block_size=block
+        )
+        ref = self._ref(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-2)
+
+    def test_decode_fallback_bands_by_absolute_position(self):
+        """S != T (KV-cache decode) fallback: the window anchors at the
+        LAST T positions, not at row index 0 — otherwise single-token
+        decode silently attends the whole cache."""
+        from accelerate_tpu.models.layers import dot_product_attention
+        from accelerate_tpu.ops.flash_attention import flash_attention
+
+        B, T, H, K, h, window = 1, 128, 2, 2, 32, 32
+        k0 = jax.random.PRNGKey(6)
+        q = jax.random.normal(k0, (B, 1, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, T, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, T, K, h), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, window=window)
+        band = ((T - 1) - jnp.arange(T)[None, :] < window)[None]
+        ref = dot_product_attention(
+            q, k, v, mask=jnp.broadcast_to(band, (B, 1, T)), causal=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-2)
+        full = dot_product_attention(q, k, v, causal=True)
+        assert np.abs(np.asarray(out) - np.asarray(full)).max() > 1e-3
+
+    def test_noncausal_resident_window(self):
+        from accelerate_tpu.models.layers import dot_product_attention
+        from accelerate_tpu.ops.flash_attention import flash_attention
+
+        B, S, H, K, h, window = 1, 128, 2, 2, 32, 32
+        k0 = jax.random.PRNGKey(7)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        out = flash_attention(q, k, v, causal=False, window=window)
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        ref = dot_product_attention(
+            q, k, v, mask=jnp.broadcast_to(band, (B, S, S)), causal=False
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-2)
+
+    def test_llama_flash_window_with_positions_matches_dot(self):
+        """Non-default positions band by POSITION: flash and dot must agree
+        (flash folds to the mask path rather than the row-index kernel)."""
+        import dataclasses as dc
+
+        from accelerate_tpu.models import llama
+
+        config = llama.LlamaConfig.tiny(
+            max_seq_len=256, sliding_window=24, attention_impl="flash"
+        )
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size)
+        positions = 100 + jnp.broadcast_to(jnp.arange(64), (2, 64))
+        got = llama.forward(params, tokens, config, positions=positions)
+        want = llama.forward(
+            params, tokens, dc.replace(config, attention_impl="dot"),
+            positions=positions,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-3, rtol=2e-2
+        )
+
+    def test_windowed_backward_refuses(self):
+        from accelerate_tpu.ops.flash_attention import flash_attention
+
+        B, S, H, h = 1, 64, 2, 32
+        q = jax.random.normal(jax.random.PRNGKey(5), (B, S, H, h))
+        with pytest.raises(NotImplementedError, match="sliding window"):
+            jax.grad(
+                lambda a: jnp.sum(flash_attention(a, a, a, causal=True, window=16))
+            )(q)
+
+    def test_llama_flash_window_matches_dot(self):
+        """The model-level wiring: flash in-kernel band == dot + mask."""
+        import dataclasses as dc
+
+        from accelerate_tpu.models import llama
+
+        config = llama.LlamaConfig.tiny(
+            max_seq_len=128, sliding_window=24, attention_impl="flash"
+        )
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size)
+        got = llama.forward(params, tokens, config)
+        want = llama.forward(
+            params, tokens, dc.replace(config, attention_impl="dot")
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-3, rtol=2e-2
+        )
