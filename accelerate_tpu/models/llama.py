@@ -217,7 +217,7 @@ def _attention(config: LlamaConfig, q, k, v, mask):
         raise NotImplementedError(
             f"sliding_window with attention_impl={config.attention_impl!r} "
             "is not implemented (the band mask needs per-chunk plumbing); "
-            "use 'dot'."
+            "use 'flash' (in-kernel band) or 'dot'."
         )
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
@@ -225,6 +225,19 @@ def _attention(config: LlamaConfig, q, k, v, mask):
         # window only when no mask arrived: a non-None mask means the band
         # (if any) is already folded in by the caller (see forward) — the
         # kernel's row-index band must not be applied on top.
+        if config.sliding_window is not None and mask is not None:
+            # Folded-band cases (explicit positions / user masks) run the
+            # unfused oracle — at windowed long contexts that is exactly
+            # the O(S^2) blowup the kernel exists to avoid; say so.
+            import warnings
+
+            warnings.warn(
+                "sliding_window with an explicit mask or non-default "
+                "positions runs the unfused O(S^2) attention path (the "
+                "fused band kernel needs default contiguous positions and "
+                "no extra mask).",
+                stacklevel=3,
+            )
         return flash_attention(
             q, k, v, causal=True, segment_mask=mask,
             window=config.sliding_window if mask is None else None,

@@ -198,7 +198,7 @@ def _fwd_resident(q, k, v, *, scale, block, causal, interpret, valid, window=Non
 
 
 
-def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid):
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block, causal, seq_len, valid, window=None):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
@@ -208,6 +208,7 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q_start = qi * bq
     n_blocks = seq_len // block
     hi = jnp.minimum((q_start + bq + block - 1) // block, n_blocks) if causal else n_blocks
+    lo = jnp.maximum((q_start - (window - 1)) // block, 0) if window is not None else 0
 
     def body(j, dq):
         k = k_ref[0, 0, pl.ds(j * block, block), :]
@@ -215,13 +216,13 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        if causal:
+        if causal or valid < seq_len or window is not None:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        elif valid < seq_len:
-            cols = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols < valid, s, _NEG_INF)
+            keep = (rows >= cols) if causal else (cols < valid)
+            if window is not None:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -231,12 +232,12 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, head_dim), jnp.float32))
+    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((bq, head_dim), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 
-def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid):
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block, causal, seq_len, valid, window=None):
     j = pl.program_id(2)
     k = k_ref[0, 0]  # (bk, h)
     v = v_ref[0, 0]
@@ -244,6 +245,12 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
     k_start = j * bk
     n_blocks = seq_len // block
     lo = (k_start // block) if causal else 0
+    # Window: q rows past k_start+bk-1+window-1 see none of this k block.
+    hi = (
+        jnp.minimum((k_start + bk - 1 + window) // block + 1, n_blocks)
+        if window is not None
+        else n_blocks
+    )
 
     def body(i, carry):
         dk, dv = carry
@@ -254,13 +261,13 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        if causal:
+        if causal or valid < seq_len or window is not None:
             rows = i * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        elif valid < seq_len:
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols < valid, s, _NEG_INF)
+            keep = (rows >= cols) if causal else (cols < valid)
+            if window is not None:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse)  # (bq, bk) f32
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -278,13 +285,13 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
         jnp.zeros((bk, head_dim), jnp.float32),
         jnp.zeros((bk, head_dim), jnp.float32),
     )
-    dk, dv = jax.lax.fori_loop(lo, n_blocks, body, init)
+    dk, dv = jax.lax.fori_loop(lo, hi, body, init)
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 
-def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
+def _bwd_resident(scale, block, causal, interpret, valid, residuals, g, window=None):
     q, k, v, o, lse = residuals
     B, H, S, h = q.shape
     K = k.shape[1]
@@ -294,7 +301,7 @@ def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
 
     grid = (B, H, S // block)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        functools.partial(_dq_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi: (b, hh, qi, 0)),
@@ -311,7 +318,7 @@ def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
 
     grid_kv = (B, H, S // block)
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid),
+        functools.partial(_dkv_kernel_resident, scale=scale, block=block, causal=causal, seq_len=S, valid=valid, window=window),
         grid=grid_kv,
         in_specs=[
             pl.BlockSpec((1, 1, S, h), lambda b, hh, j: (b, hh, 0, 0)),
@@ -344,6 +351,33 @@ def _bwd_resident(scale, block, causal, interpret, valid, residuals, g):
 
 
 # ------------------------------------------------------------------- forward
+def _banded_grid(nq: int, block: int, causal: bool, window, group: int, clamp_hi: int | None = None):
+    """Shared banded-KV/Q-grid setup for the windowed kernels: (n_eff,
+    window_grid, index_map). `clamp_hi` picks the clamp edge — None for the
+    fwd/dq KV axis (clamped at 0, offset qi - (n_eff-1) + i), or nq-1 for
+    the dkv Q axis (offset ki + i). All three kernels reconstruct
+    k_start/q_start from the SAME n_eff, so this must stay the single
+    source of the band width."""
+    if window is not None and causal:
+        n_eff = min(nq, (window + block - 1) // block + 1)
+        window_grid = n_eff < nq
+    else:
+        n_eff, window_grid = nq, False
+
+    if clamp_hi is None:
+        def index_map(b, hh, qi, ki):
+            if window_grid:
+                return (b, hh // group, jnp.maximum(qi - (n_eff - 1) + ki, 0), 0)
+            return (b, hh // group, ki, 0)
+    else:
+        def index_map(b, hh, ki, qi):
+            if window_grid:
+                return (b, hh // group, jnp.minimum(ki + qi, clamp_hi), 0)
+            return (b, hh // group, qi, 0)
+
+    return n_eff, window_grid, index_map
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, scale, block_q, block_k, causal, valid, window=None, window_grid=False,
@@ -425,18 +459,8 @@ def _fwd(q, k, v, *, scale, block, causal, interpret, valid, window=None):
     # With a sliding window, the KV-grid axis spans only the live band —
     # dead tiles are never fetched or visited, so work (and DMA) scales
     # with O(S * window) instead of O(S^2).
-    if window is not None and causal:
-        n_eff = min(nq, (window + block - 1) // block + 1)
-        window_grid = n_eff < nq
-    else:
-        n_eff, window_grid = nq, False
+    n_eff, window_grid, kv_index = _banded_grid(nq, block, causal, window, group)
     grid = (B, H, nq, n_eff)
-
-    def kv_index(b, hh, qi, ki):
-        if window_grid:
-            return (b, hh // group, jnp.maximum(qi - (n_eff - 1) + ki, 0), 0)
-        return (b, hh // group, ki, 0)
-
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block, block_k=block, causal=causal,
         valid=valid, window=window, window_grid=window_grid,
@@ -470,18 +494,26 @@ def _fwd(q, k, v, *, scale, block, causal, interpret, valid, window=None):
 # ------------------------------------------------------------------ backward
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
-    *, scale, block_q, block_k, causal, valid,
+    *, scale, block_q, block_k, causal, valid, window=None, window_grid=False,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     q_start = qi * block_q
-    k_start = ki * block_k
+    if window_grid:
+        k_start = (qi - (nk - 1) + ki) * block_k
+    else:
+        k_start = ki * block_k
 
     @pl.when(ki == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+    run = _block_live(
+        q_start, block_q, k_start,
+        causal=causal, valid=valid, window=window, block_k=block_k,
+    )
+    if window_grid:
+        run = jnp.logical_and(run, k_start >= 0)
 
     @pl.when(run)
     def _block():
@@ -494,7 +526,9 @@ def _dq_kernel(
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        s = _mask_scores(
+            s, q_start, k_start, causal=causal, valid=valid, window=window
+        )
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -512,20 +546,32 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, block_q, block_k, causal, valid,
+    *, scale, block_q, block_k, causal, valid, window=None, window_grid=False,
+    n_q_blocks=None,
 ):
     # Grid: (B, H, KV-blocks, Q-blocks) — Q is the innermost carried axis.
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
     k_start = ki * block_k
-    q_start = qi * block_q
+    if window_grid:
+        # Banded: causal+window means only q blocks [ki, ki + nq) touch
+        # this k block; right-edge tiles past the sequence are dead (their
+        # fetch is clamped to the last block by the index map).
+        q_start = (ki + qi) * block_q
+    else:
+        q_start = qi * block_q
 
     @pl.when(qi == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    run = _block_live(q_start, block_q, k_start, causal=causal, valid=valid)
+    run = _block_live(
+        q_start, block_q, k_start,
+        causal=causal, valid=valid, window=window, block_k=block_k,
+    )
+    if window_grid:
+        run = jnp.logical_and(run, ki + qi < n_q_blocks)
 
     @pl.when(run)
     def _block():
@@ -538,7 +584,9 @@ def _dkv_kernel(
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = _mask_scores(s, q_start, k_start, causal=causal, valid=valid)
+        s = _mask_scores(
+            s, q_start, k_start, causal=causal, valid=valid, window=window
+        )
         p = jnp.exp(s - lse)  # (bq, bk) f32
         dv_acc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -558,21 +606,24 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def dq_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid):
+def dq_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid, window=None):
     """dq for one (q, kv) pair via the blocked kernel. Shapes (B, H, S, h);
     exposed for ring attention's per-chunk backward."""
     B, H, S, h = q.shape
     group = H // k.shape[1]
-    grid = (B, H, S // block, S // block)
+    nq = S // block
+    n_eff, window_grid, kv_index = _banded_grid(nq, block, causal, window, group)
+    grid = (B, H, nq, n_eff)
     return pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+            _dq_kernel, scale=scale, block_q=block, block_k=block, causal=causal,
+            valid=valid, window=window, window_grid=window_grid,
         ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh // group, ki, 0)),
+            pl.BlockSpec((1, 1, block, h), kv_index),
+            pl.BlockSpec((1, 1, block, h), kv_index),
             pl.BlockSpec((1, 1, block, h), lambda b, hh, qi, ki: (b, hh, qi, 0)),
             pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi, ki: (b, hh, qi, 0)),
             pl.BlockSpec((1, 1, block, 1), lambda b, hh, qi, ki: (b, hh, qi, 0)),
@@ -584,24 +635,30 @@ def dq_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid):
     )(q, k, v, do, lse, delta)
 
 
-def dkv_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid):
+def dkv_call(q, k, v, do, lse, delta, *, scale, block, causal, interpret, valid, window=None):
     """(dk, dv) for one (q, kv) pair via the blocked kernel — per expanded
     query head (no GQA fold; the caller folds groups). Shapes (B, H, S, h)."""
     B, H, S, h = q.shape
     group = H // k.shape[1]
-    grid_kv = (B, H, S // block, S // block)
+    nq = S // block
+    n_eff, window_grid, _q_index = _banded_grid(
+        nq, block, causal, window, group=1, clamp_hi=nq - 1
+    )
+    q_index = _q_index
+    grid_kv = (B, H, nq, n_eff)
     return pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, block_q=block, block_k=block, causal=causal, valid=valid
+            _dkv_kernel, scale=scale, block_q=block, block_k=block, causal=causal,
+            valid=valid, window=window, window_grid=window_grid, n_q_blocks=nq,
         ),
         grid=grid_kv,
         in_specs=[
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, h), q_index),
             pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh // group, ki, 0)),
             pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh // group, ki, 0)),
-            pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, qi, 0)),
-            pl.BlockSpec((1, 1, block, 1), lambda b, hh, ki, qi: (b, hh, qi, 0)),
-            pl.BlockSpec((1, 1, block, 1), lambda b, hh, ki, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, block, h), q_index),
+            pl.BlockSpec((1, 1, block, 1), q_index),
+            pl.BlockSpec((1, 1, block, 1), q_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block, h), lambda b, hh, ki, qi: (b, hh, ki, 0)),
@@ -714,21 +771,17 @@ def _fwd_tensors(q, k, v, scale, block, causal, interpret, valid, window):
 
 
 def _bwd_tensors(q, k, v, o, lse, g, scale, block, causal, interpret, valid, window):
-    if window is not None:
-        raise NotImplementedError(
-            "flash attention backward with a sliding window is not "
-            "implemented; train windowed models with attention_impl='dot' "
-            "(the fused window kernel serves inference)."
-        )
     do = g
     if _use_resident(q.shape[2], q.shape[3], k.dtype):
         return _bwd_resident(
-            scale, block, causal, interpret, valid, (q, k, v, o, lse), g
+            scale, block, causal, interpret, valid, (q, k, v, o, lse), g,
+            window=window,
         )
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
-    kwargs = dict(scale=scale, block=block, causal=causal, interpret=interpret, valid=valid)
+    kwargs = dict(scale=scale, block=block, causal=causal, interpret=interpret,
+                  valid=valid, window=window)
     dq = dq_call(q, k, v, do, lse, delta, **kwargs)
     dk_h, dv_h = dkv_call(q, k, v, do, lse, delta, **kwargs)
     dk, dv = fold_gqa_groups(dk_h, dv_h, k.shape[1], k.dtype, v.dtype)
@@ -784,11 +837,11 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention over (B, S, H, h) queries and (B, T, K, h) kv (GQA).
 
-    ``window`` enables Mistral-style sliding-window attention IN the kernel:
-    key c is visible from row r iff ``r - c < window``; tiles entirely
-    outside the band are skipped, so long-window-bounded contexts run at
-    O(S * window) instead of O(S^2). Forward-only — the windowed backward
-    raises (train windowed models with the unfused path).
+    ``window`` enables Mistral-style sliding-window attention IN the
+    kernels (forward and backward): key c is visible from row r iff
+    ``r - c < window``; band-dead tiles are neither fetched nor computed —
+    the KV/Q grid axes span only the live diagonal band, so window-bounded
+    contexts run at O(S * window) instead of O(S^2).
 
     Falls back to the XLA reference path when the shape is out of kernel
     territory (S not a multiple of the block, or an explicit padding mask —
