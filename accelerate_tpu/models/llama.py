@@ -213,11 +213,12 @@ def _window_mask(
 
 
 def _attention(config: LlamaConfig, q, k, v, mask):
-    if config.sliding_window is not None and config.attention_impl in ("ring", "ulysses"):
+    if config.sliding_window is not None and config.attention_impl == "ring":
         raise NotImplementedError(
-            f"sliding_window with attention_impl={config.attention_impl!r} "
-            "is not implemented (the band mask needs per-chunk plumbing); "
-            "use 'flash' (in-kernel band) or 'dot'."
+            "sliding_window with attention_impl='ring' is not implemented "
+            "(the band needs per-ring-step chunk-offset plumbing); use "
+            "'flash' or 'ulysses' (both apply the band in the fused kernel) "
+            "or 'dot'."
         )
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
@@ -264,7 +265,7 @@ def _attention(config: LlamaConfig, q, k, v, mask):
             )
         from ..ops.ulysses import ulysses_attention
 
-        return ulysses_attention(q, k, v, causal=True)
+        return ulysses_attention(q, k, v, causal=True, window=config.sliding_window)
     if config.attention_impl != "dot":
         raise ValueError(
             f"Unknown attention_impl {config.attention_impl!r}; expected "
@@ -355,15 +356,17 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos, sin = _rope_tables(config)
     if config.sliding_window is not None and not (
-        config.attention_impl == "flash" and default_positions and mask is None
+        config.attention_impl in ("flash", "ulysses")
+        and default_positions
+        and mask is None
     ):
-        # flash applies the band in-kernel (tile skipping) — but only for
-        # the unmasked default-positions case; explicit positions (packed/
-        # shifted sequences) band by POSITION, which the kernel's row-index
-        # band cannot express, and user masks force the oracle anyway, so
-        # every other combination folds into ONE materialized mask
-        # (_attention then passes no window — the band must not be applied
-        # twice with different anchors).
+        # flash/ulysses apply the band in-kernel (tile skipping) — but only
+        # for the unmasked default-positions case; explicit positions
+        # (packed/shifted sequences) band by POSITION, which the kernel's
+        # row-index band cannot express, and user masks force the oracle
+        # anyway, so every other combination folds into ONE materialized
+        # mask (_attention then passes no window — the band must not be
+        # applied twice with different anchors).
         mask = _window_mask(mask, positions, S, config.sliding_window)
 
     x = params["embed"][tokens]

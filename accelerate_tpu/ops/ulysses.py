@@ -37,7 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import BATCH_AXES, SEQUENCE_AXIS
 
 
-def _ulysses_local(q, k, v, mask, *, axis_name, causal, scale):
+def _ulysses_local(q, k, v, mask, *, axis_name, causal, scale, window=None):
     """Per-device body under shard_map. q/k/v: (B, S/n, H, h) local."""
     from .flash_attention import flash_attention
 
@@ -48,7 +48,9 @@ def _ulysses_local(q, k, v, mask, *, axis_name, causal, scale):
     if mask is not None:
         # (B, S/n) -> (B, S): every device needs the full key mask.
         mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
-    out = flash_attention(qh, kh, vh, causal=causal, segment_mask=mask, scale=scale)
+    out = flash_attention(
+        qh, kh, vh, causal=causal, segment_mask=mask, scale=scale, window=window
+    )
     # (B, S, H/n, h) -> (B, S/n, H, h)
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -64,8 +66,13 @@ def ulysses_attention(
     mesh: Mesh | None = None,
     axis_name: str = SEQUENCE_AXIS,
     batch_axes: Sequence[str] = BATCH_AXES,
+    window: int | None = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention over (B, S, H, h) global arrays.
+
+    ``window`` = Mistral-style sliding window, applied by the fused kernel
+    after the head exchange (each device then holds the full sequence for
+    its head subset, so the band anchors are exact).
 
     Same call contract as `ring_attention` (S sharded over ``axis_name``,
     B over ``batch_axes``; callable inside or outside jit; degrades to
@@ -102,7 +109,8 @@ def ulysses_attention(
     spec, mask_spec = sequence_parallel_specs(mesh, B, batch_axes, axis_name)
 
     body = functools.partial(
-        _ulysses_local, axis_name=axis_name, causal=causal, scale=scale
+        _ulysses_local, axis_name=axis_name, causal=causal, scale=scale,
+        window=window,
     )
     if kv_mask is not None:
         kv_mask = kv_mask.astype(bool)

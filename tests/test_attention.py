@@ -563,3 +563,46 @@ class TestSlidingWindowBackward:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-2
             )
+
+
+class TestUlyssesSlidingWindow:
+    def test_matches_banded_oracle(self):
+        from accelerate_tpu.ops.ulysses import ulysses_attention
+
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        B, S, H, K, h, window = 2, 128, 4, 4, 16, 32
+        k0 = jax.random.PRNGKey(30)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        out = ulysses_attention(q, k, v, causal=True, mesh=mesh, window=window)
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        ref = dot_product_attention(
+            q, k, v, mask=jnp.broadcast_to(band, (B, S, S)), causal=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-2)
+
+    def test_llama_ulysses_window_matches_dot(self):
+        import dataclasses as dc
+
+        from accelerate_tpu.models import llama
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        import accelerate_tpu as atx
+
+        atx.Accelerator(seed=0, mesh_config=MeshConfig(data=2, sequence=4))
+        config = llama.LlamaConfig.tiny(
+            max_seq_len=128, sliding_window=24, attention_impl="ulysses",
+            num_heads=4, num_kv_heads=4,
+        )
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size)
+        got = llama.forward(params, tokens, config)
+        want = llama.forward(
+            params, tokens, dc.replace(config, attention_impl="dot")
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-3, rtol=2e-2
+        )
+        AcceleratorState._reset_state()
