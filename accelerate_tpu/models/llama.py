@@ -213,13 +213,6 @@ def _window_mask(
 
 
 def _attention(config: LlamaConfig, q, k, v, mask):
-    if config.sliding_window is not None and config.attention_impl == "ring":
-        raise NotImplementedError(
-            "sliding_window with attention_impl='ring' is not implemented "
-            "(the band needs per-ring-step chunk-offset plumbing); use "
-            "'flash' or 'ulysses' (both apply the band in the fused kernel) "
-            "or 'dot'."
-        )
     if config.attention_impl == "flash":
         from ..ops.flash_attention import flash_attention
 
@@ -245,15 +238,28 @@ def _attention(config: LlamaConfig, q, k, v, mask):
         )
     if config.attention_impl in ("ring", "ulysses"):
         if mask is not None and mask.ndim != 2:
+            hint = (
+                " (with sliding_window, a folded 3-D band mask reaches here "
+                "whenever positions are non-default — packed/shifted "
+                "sequences band by position, which the ring/ulysses chunk "
+                "plumbing cannot express)"
+                if config.sliding_window is not None
+                else ""
+            )
             raise NotImplementedError(
                 f"attention_impl={config.attention_impl!r} supports (B, S) "
                 "key-padding masks only; full (B, S, T) masks need 'flash' "
-                "or 'dot'."
+                f"or 'dot'.{hint}"
             )
         if config.attention_impl == "ring":
             from ..ops.ring_attention import ring_attention
 
-            return ring_attention(q, k, v, causal=True, kv_mask=mask)
+            # Window rides the per-step chunk masks (einsum path; band-dead
+            # ring steps skip their FLOPs).
+            return ring_attention(
+                q, k, v, causal=True, kv_mask=mask,
+                window=config.sliding_window,
+            )
         if mask is not None:
             # Masked ulysses falls back to the O(S^2)-per-device oracle over
             # the gathered sequence — exactly what long context cannot
@@ -355,11 +361,12 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos, sin = _rope_tables(config)
-    if config.sliding_window is not None and not (
-        config.attention_impl in ("flash", "ulysses")
-        and default_positions
-        and mask is None
-    ):
+    _kernel_band = default_positions and (
+        (config.attention_impl in ("flash", "ulysses") and mask is None)
+        # ring combines its per-step band with (B, S) padding masks natively.
+        or config.attention_impl == "ring"
+    )
+    if config.sliding_window is not None and not _kernel_band:
         # flash/ulysses apply the band in-kernel (tile skipping) — but only
         # for the unmasked default-positions case; explicit positions
         # (packed/shifted sequences) band by POSITION, which the kernel's

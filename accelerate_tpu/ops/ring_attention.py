@@ -81,6 +81,7 @@ def _ring_attention_local(
     axis_name: str,
     causal: bool,
     scale: float,
+    window: int | None = None,
 ) -> jax.Array:
     """Body run per-device under shard_map: local q against the rotating kv.
 
@@ -101,13 +102,17 @@ def _ring_attention_local(
     def step(t, carry):
         acc, kk, vv, mm = carry
         src = (my - t) % n  # which chunk is visiting this step
+        offset = (my - src) * S  # global row - col offset between chunks
         if causal:
             # chunk-level causality: future chunk -> all masked; own chunk ->
             # triangular; past chunk -> full. Build the (S, S) mask by cases.
-            offset = (my - src) * S  # global row - col offset between chunks
             mask = (rows + offset) >= cols
         else:
             mask = None
+        if window is not None:
+            # Sliding band in GLOBAL coordinates: (row + offset) - col < window.
+            band = (rows + offset) - cols < window
+            mask = band if mask is None else jnp.logical_and(mask, band)
         if mm is not None:
             pad = mm[:, None, :]  # (B, 1, C) keys of the visiting chunk
             mask = pad if mask is None else jnp.logical_and(mask[None], pad)
@@ -115,10 +120,16 @@ def _ring_attention_local(
         def attend(acc):
             return _merge(acc, _chunk_attention(q, kk, vv, scale=scale, mask=mask))
 
-        if causal:
-            # Entirely-future chunks (src > my) contribute nothing; skip the
-            # FLOPs, not just the values.
-            acc = jax.lax.cond(src <= my, attend, lambda a: a, acc)
+        if causal or window is not None:
+            live = jnp.asarray(True)
+            if causal:
+                # Entirely-future chunks (src > my) contribute nothing.
+                live = src <= my
+            if window is not None:
+                # Chunks entirely below the band contribute nothing either:
+                # min(row - col) + offset = offset - (S - 1) must be < window.
+                live = jnp.logical_and(live, offset - (S - 1) < window)
+            acc = jax.lax.cond(live, attend, lambda a: a, acc)
         else:
             acc = attend(acc)
         kk = jax.lax.ppermute(kk, axis_name, perm)
@@ -295,8 +306,14 @@ def ring_attention(
     axis_name: str = SEQUENCE_AXIS,
     batch_axes: Sequence[str] = BATCH_AXES,
     impl: str = "auto",
+    window: int | None = None,
 ) -> jax.Array:
     """Sequence-parallel attention over (B, S, H, h) global arrays.
+
+    ``window`` = Mistral-style sliding window in global coordinates; ring
+    steps whose visiting chunk is entirely outside the band skip their
+    FLOPs (einsum path only — the fused kernels need static per-chunk
+    bands, which per-device ring offsets cannot provide).
 
     Shards S over ``axis_name`` and B over ``batch_axes`` with shard_map;
     call inside or outside jit. With an unsharded/absent sequence axis this
@@ -324,10 +341,18 @@ def ring_attention(
     n_shards = mesh.shape[axis_name]
     s_local = q.shape[1] // n_shards if q.shape[1] % n_shards == 0 else 0
     block = _fused_block(s_local, q.shape[-1], k.dtype) if s_local else None
-    use_fused = impl == "fused" or (impl == "auto" and kv_mask is None and block is not None)
+    use_fused = impl == "fused" or (
+        impl == "auto" and kv_mask is None and window is None and block is not None
+    )
     if use_fused:
         if kv_mask is not None:
             raise NotImplementedError("impl='fused' does not take kv_mask; use 'einsum'")
+        if window is not None:
+            raise NotImplementedError(
+                "impl='fused' cannot apply a sliding window (per-chunk band "
+                "offsets are device-dependent but the kernel band is "
+                "static); use impl='einsum' (the 'auto' default does)."
+            )
         if not s_local:
             raise ValueError(
                 f"impl='fused' needs sequence length {q.shape[1]} divisible "
@@ -351,7 +376,8 @@ def ring_attention(
         return shard_fused(q, k, v)
 
     fn = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale,
+        window=window,
     )
     if kv_mask is not None:
         kv_mask = kv_mask.astype(bool)

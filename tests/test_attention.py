@@ -606,3 +606,86 @@ class TestUlyssesSlidingWindow:
             np.asarray(got), np.asarray(want), atol=2e-3, rtol=2e-2
         )
         AcceleratorState._reset_state()
+
+
+class TestRingSlidingWindow:
+    @pytest.mark.parametrize("seq_shards", [2, 4])
+    def test_matches_banded_oracle(self, seq_shards):
+        mesh = build_mesh(MeshConfig(data=-1, sequence=seq_shards))
+        B, S, H, K, h, window = 2, 64, 4, 2, 16, 24
+        k0 = jax.random.PRNGKey(31)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        out = ring_attention(q, k, v, causal=True, mesh=mesh, window=window)
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        ref = dot_product_attention(
+            q, k, v, mask=jnp.broadcast_to(band, (B, S, S)), causal=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_window_with_padding_mask(self):
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        # window 24 (not 16): with keys >= 48 padded, every row keeps at
+        # least one visible key — rows whose band and padding intersect to
+        # the empty set have UNDEFINED attention in any implementation.
+        B, S, H, K, h, window = 2, 64, 4, 2, 16, 24
+        k0 = jax.random.PRNGKey(32)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        pad = jnp.ones((B, S), jnp.int32).at[:, 48:].set(0)
+        out = ring_attention(
+            q, k, v, causal=True, mesh=mesh, window=window, kv_mask=pad
+        )
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        full_mask = jnp.broadcast_to(band, (B, S, S)) & pad[:, None, :].astype(bool)
+        ref = dot_product_attention(q, k, v, mask=full_mask, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_fused_with_window_refuses(self):
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        q, k, v = _qkv(jax.random.PRNGKey(33), B=1, S=512, H=4, K=2, h=32)
+        with pytest.raises(NotImplementedError, match="einsum"):
+            ring_attention(q, k, v, causal=True, mesh=mesh, window=64, impl="fused")
+
+    def test_grads_flow(self):
+        mesh = build_mesh(MeshConfig(data=2, sequence=4))
+        B, S, H, K, h, window = 1, 64, 4, 2, 16, 24
+        k0 = jax.random.PRNGKey(34)
+        q = jax.random.normal(k0, (B, S, H, h), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(k0, 1), (B, S, K, h), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(k0, 2), (B, S, K, h), jnp.float32)
+        band = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]) < window
+        mask = jnp.broadcast_to(band, (B, S, S))
+        g_ring = jax.grad(
+            lambda a: jnp.sum(ring_attention(a, k, v, causal=True, mesh=mesh, window=window) ** 2)
+        )(q)
+        g_ref = jax.grad(
+            lambda a: jnp.sum(dot_product_attention(a, k, v, mask=mask, causal=True) ** 2)
+        )(q)
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=5e-4, rtol=5e-4)
+
+    def test_llama_ring_window_matches_dot(self):
+        import dataclasses as dc
+
+        from accelerate_tpu.models import llama
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        import accelerate_tpu as atx
+
+        atx.Accelerator(seed=0, mesh_config=MeshConfig(data=2, sequence=4))
+        config = llama.LlamaConfig.tiny(
+            max_seq_len=128, sliding_window=24, attention_impl="ring"
+        )
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size)
+        got = llama.forward(params, tokens, config)
+        want = llama.forward(
+            params, tokens, dc.replace(config, attention_impl="dot")
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-3, rtol=2e-2
+        )
+        AcceleratorState._reset_state()
