@@ -35,11 +35,11 @@ from accelerate_tpu.utils.dataclasses import FsdpPlugin
 V5E_HBM = 16 * 1024**3
 
 
-def _topology_mesh(shape_by_axis: dict[str, int]) -> Mesh:
+def _topology_mesh(shape_by_axis: dict[str, int], topology: str = "v5e:16x16") -> Mesh:
     from jax.experimental import topologies
 
     try:
-        topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:16x16")
+        topo = topologies.get_topology_desc(platform="tpu", topology_name=topology)
     except Exception as e:  # no libtpu compiler in this environment
         pytest.skip(f"deviceless TPU topology unavailable: {e}")
     devices = np.array(topo.devices).reshape(tuple(shape_by_axis.values()))
@@ -142,3 +142,51 @@ def test_8b_fsdp_tensor_step_fits_v5e_256():
     # Tensor-parallel activations reduce with all-reduce (psum).
     assert "all-reduce" in hlo
     print(f"fsdp 4x8x8: {per_chip / 2**30:.2f} GiB/chip")
+
+
+def test_70b_generate_decode_step_fits_v5e_32():
+    """BASELINE tracks 70B generate; no hardware here can run it, but the
+    decode step AOT-compiles against a deviceless v5e 4x8 slice (32 chips —
+    the realistic v5e serving size for a 140 GiB bf16 model): sharded
+    weights + a 1k KV cache must fit 16 GiB per chip with the expected
+    collective schedule."""
+    from accelerate_tpu.parallel.tp import get_tp_plan
+
+    mesh = _topology_mesh({"data": 1, "fsdp": 8, "tensor": 4}, topology="v5e:4x8")
+    config = llama.LlamaConfig.llama3_70b(max_seq_len=1024)
+    strategy = ShardingStrategy.resolve(FsdpPlugin(), rules=tuple(get_tp_plan("llama")))
+    shapes = jax.eval_shape(
+        lambda: llama.init(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    )
+    param_specs = infer_param_specs(shapes, mesh, strategy)
+    param_sh = to_named_shardings(param_specs, mesh)
+    B, max_len = 1, 1024
+
+    def decode_step(params, tokens, cache):
+        return llama.forward_with_cache(params, tokens, cache, config)
+
+    cache_shapes = jax.eval_shape(
+        lambda: llama.init_cache(config, B, max_len, dtype=jnp.bfloat16)
+    )
+    repl = NamedSharding(mesh, PartitionSpec())
+    cache_sh = jax.tree.map(lambda _: repl, cache_shapes)
+    arg_shapes = (
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+                     shapes, param_sh),
+        jax.ShapeDtypeStruct((B, 1), jnp.int32, sharding=repl),
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+                     cache_shapes, cache_sh),
+    )
+    with jax.sharding.set_mesh(mesh):
+        compiled = jax.jit(decode_step, donate_argnums=(2,)).lower(*arg_shapes).compile()
+    mem = compiled.memory_analysis()
+    per_chip = (
+        mem.argument_size_in_bytes
+        + mem.output_size_in_bytes
+        + mem.temp_size_in_bytes
+        - mem.alias_size_in_bytes
+    )
+    assert per_chip < V5E_HBM * 0.95, f"70B decode: {per_chip / 2**30:.2f} GiB/chip"
+    hlo = compiled.as_text()
+    assert "all-gather" in hlo or "all-reduce" in hlo  # sharded weights engaged
+    print(f"70B decode 1x8x4: {per_chip / 2**30:.2f} GiB/chip")
