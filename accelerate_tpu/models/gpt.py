@@ -1,22 +1,28 @@
-"""GPT-2-style decoder family.
+"""GPT-style decoder family: GPT-2, GPT-NeoX, GPT-J, and OPT.
 
 Widens the model zoo to the reference's breadth: the reference trains
 GPT-class models through Megatron's `GPTTrainStep` (reference
-`utils/megatron_lm.py:588`) and serves GPT-J/GPT-NeoX through big-model
-inference (reference `benchmarks/big_model_inference/README.md`). Same
-TPU-native skeleton as `models/llama.py` (scan-over-layers, optional remat,
-pluggable attention) with the GPT architectural choices:
+`utils/megatron_lm.py:588`) and its published big-model-inference table is
+GPT-J-6B / GPT-NeoX-20B / OPT-30B (reference
+`benchmarks/big_model_inference/README.md:27-37`). Same TPU-native skeleton
+as `models/llama.py` (scan-over-layers, optional remat, pluggable
+attention), with the architecture selected by config knobs instead of four
+near-identical modules — every variant therefore inherits the family's TP
+plan (`parallel/tp.py` ``"gpt"``), quantize-on-load, offload, and
+generation paths for free:
 
-- learned absolute position embeddings (``wpe``) instead of RoPE;
-- pre-LN `layer_norm` (scale+bias) instead of RMSNorm;
-- full multi-head attention (no GQA) + gelu MLP with biases;
-- LM head tied to the token embedding (GPT-2 ties by default).
+- ``positional``: learned absolute embeddings (``wpe``; GPT-2/OPT) or
+  rotary (``rotary_dim`` for partial application, ``rotary_interleaved``
+  for GPT-J's rotate-every-two pairing vs NeoX's rotate-half);
+- ``parallel_residual``: NeoX computes attn and MLP from the SAME block
+  input (two norms); ``shared_parallel_norm`` is GPT-J's single-norm
+  version;
+- ``activation``: gelu_new (GPT-2/GPT-J), gelu (NeoX), relu (OPT);
+- bias layout: ``attn_bias`` (GPT-J is bias-free in attention),
+  ``head_bias`` (GPT-J's untied lm_head carries one).
 
-Attention projections are bias-free: the q/k/v/o biases in the original
-GPT-2 contribute nothing measurable and dropping them keeps the projections
-on the shared `layers.matmul_einsum` path (bf16/fp8 policy for free).
-
-The TP/FSDP plan is registered in `parallel/tp.py` as ``"gpt"``.
+Pre-LN `layer_norm` (scale+bias), full multi-head attention (no GQA), and
+biased MLPs are common to all four.
 """
 
 from __future__ import annotations
@@ -32,6 +38,9 @@ import numpy as np
 
 from .layers import (
     AttentionSpec,
+    activation_fn,
+    apply_rope,
+    apply_rope_interleaved,
     attention_out,
     attention_qkv,
     cross_entropy_loss,
@@ -41,6 +50,7 @@ from .layers import (
     layer_norm,
     mlp_gelu,
     remat_policy,
+    rope_frequencies,
     truncated_normal_init,
 )
 
@@ -64,10 +74,32 @@ class GPTConfig:
     # Chunked LM loss (layers.chunked_lm_loss): compute the loss in sequence
     # chunks without materializing the (B, S, V) fp32 logits. None = off.
     loss_chunk_size: int | None = None
+    # ------------------------------------------- variant knobs (GPT-2 dflt)
+    # Which HF tensor layout this config ingests/exports as
+    # (models/hf.py): "gpt2" | "gpt_neox" | "gptj" | "opt".
+    hf_layout: str = "gpt2"
+    positional: str = "learned"  # "learned" (wpe) | "rotary"
+    # Partial rotary: rope applied to the first `rotary_dim` dims of each
+    # head (GPT-NeoX rotary_pct, GPT-J rotary_dim); None = full head_dim.
+    rotary_dim: int | None = None
+    rotary_interleaved: bool = False  # GPT-J pairing; False = rotate-half
+    rope_theta: float = 10000.0
+    # NeoX: x + attn(ln1(x)) + mlp(ln2(x)) in one residual hop; GPT-J is the
+    # same with the MLP reusing ln1's output (shared_parallel_norm — the
+    # block then has no ln2 params at all).
+    parallel_residual: bool = False
+    shared_parallel_norm: bool = False
+    activation: str = "gelu_new"  # "gelu_new" | "gelu" | "relu"
+    attn_bias: bool = True  # GPT-J attention projections are bias-free
+    head_bias: bool = False  # GPT-J's untied lm_head has a bias
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
+
+    @property
+    def resolved_rotary_dim(self) -> int:
+        return self.rotary_dim if self.rotary_dim is not None else self.head_dim
 
     @property
     def attention_spec(self) -> AttentionSpec:
@@ -89,13 +121,52 @@ class GPTConfig:
     def gpt2_xl(cls, **overrides: Any) -> "GPTConfig":
         return cls(**{**dict(d_model=1600, n_layers=48, num_heads=25, d_ff=6400), **overrides})
 
+    @classmethod
+    def gptj_6b(cls, **overrides: Any) -> "GPTConfig":
+        defaults = dict(
+            vocab_size=50400, d_model=4096, n_layers=28, num_heads=16,
+            d_ff=16384, max_seq_len=2048, hf_layout="gptj",
+            positional="rotary", rotary_dim=64, rotary_interleaved=True,
+            parallel_residual=True, shared_parallel_norm=True,
+            attn_bias=False, tie_embeddings=False, head_bias=True,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def gpt_neox_20b(cls, **overrides: Any) -> "GPTConfig":
+        defaults = dict(
+            vocab_size=50432, d_model=6144, n_layers=44, num_heads=64,
+            d_ff=24576, max_seq_len=2048, hf_layout="gpt_neox",
+            positional="rotary", rotary_dim=24, parallel_residual=True,
+            activation="gelu", tie_embeddings=False,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def opt_30b(cls, **overrides: Any) -> "GPTConfig":
+        defaults = dict(
+            vocab_size=50272, d_model=7168, n_layers=48, num_heads=56,
+            d_ff=28672, max_seq_len=2048, hf_layout="opt",
+            activation="relu", tie_embeddings=True,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
     def param_count(self) -> int:
-        attn = 4 * self.d_model * self.d_model + 4 * self.d_model  # + q/k/v/o biases
+        attn = 4 * self.d_model * self.d_model
+        if self.attn_bias:
+            attn += 4 * self.d_model  # q/k/v/o biases
         ffn = 2 * self.d_model * self.d_ff + self.d_ff + self.d_model
-        norms = 2 * 2 * self.d_model
-        block = attn + ffn + norms
-        embed = self.vocab_size * self.d_model + self.max_seq_len * self.d_model
+        n_norms = 1 if self.shared_parallel_norm else 2
+        block = attn + ffn + n_norms * 2 * self.d_model
+        embed = self.vocab_size * self.d_model
+        if self.positional == "learned":
+            embed += self.max_seq_len * self.d_model
         head = 0 if self.tie_embeddings else self.d_model * self.vocab_size
+        if self.head_bias and not self.tie_embeddings:
+            head += self.vocab_size
         return self.n_layers * block + embed + 2 * self.d_model + head
 
     def flops_per_token(self) -> float:
@@ -104,14 +175,16 @@ class GPTConfig:
 
 def init_block(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
     ka, km = jax.random.split(rng)
-    return {
+    block = {
         "ln1_scale": jnp.ones((config.d_model,), dtype),
         "ln1_bias": jnp.zeros((config.d_model,), dtype),
-        "attn": init_attention(ka, config.attention_spec, dtype, bias=True),
-        "ln2_scale": jnp.ones((config.d_model,), dtype),
-        "ln2_bias": jnp.zeros((config.d_model,), dtype),
+        "attn": init_attention(ka, config.attention_spec, dtype, bias=config.attn_bias),
         "mlp": init_mlp_gelu(km, config.d_model, config.d_ff, dtype),
     }
+    if not config.shared_parallel_norm:
+        block["ln2_scale"] = jnp.ones((config.d_model,), dtype)
+        block["ln2_bias"] = jnp.zeros((config.d_model,), dtype)
+    return block
 
 
 def init(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
@@ -121,16 +194,41 @@ def init(rng: jax.Array, config: GPTConfig, dtype=jnp.float32) -> Params:
     blocks = jax.vmap(lambda k: init_block(k, config, dtype))(block_keys)
     params = {
         "wte": truncated_normal_init(k_tok, (config.vocab_size, config.d_model), 0.02, dtype),
-        "wpe": truncated_normal_init(k_pos, (config.max_seq_len, config.d_model), 0.01, dtype),
         "blocks": blocks,
         "lnf_scale": jnp.ones((config.d_model,), dtype),
         "lnf_bias": jnp.zeros((config.d_model,), dtype),
     }
+    if config.positional == "learned":
+        params["wpe"] = truncated_normal_init(
+            k_pos, (config.max_seq_len, config.d_model), 0.01, dtype
+        )
     if not config.tie_embeddings:
         params["lm_head"] = truncated_normal_init(
             k_head, (config.d_model, config.vocab_size), 1.0 / np.sqrt(config.d_model), dtype
         )
+        if config.head_bias:
+            params["lm_head_bias"] = jnp.zeros((config.vocab_size,), dtype)
     return params
+
+
+def _rope_tables(config: GPTConfig):
+    """cos/sin tables over the ROTARY dims only (partial rotary leaves the
+    tail of each head untouched). Rebuilt per call, NOT cached: under jit
+    the `jnp.asarray` result is a trace-local constant, and caching it
+    would leak the tracer into later traces (llama._rope_tables ditto)."""
+    cos, sin = rope_frequencies(
+        config.resolved_rotary_dim, config.max_seq_len, config.rope_theta
+    )
+    return jnp.asarray(cos), jnp.asarray(sin)
+
+
+def _apply_rotary(x, cos, sin, positions, config: GPTConfig):
+    rd = config.resolved_rotary_dim
+    rope = apply_rope_interleaved if config.rotary_interleaved else apply_rope
+    if rd == config.head_dim:
+        return rope(x, cos, sin, positions)
+    rot = rope(x[..., :rd], cos, sin, positions)
+    return jnp.concatenate([rot, x[..., rd:]], axis=-1)
 
 
 def _attention(config: GPTConfig, q, k, v, mask):
@@ -145,22 +243,39 @@ def _attention(config: GPTConfig, q, k, v, mask):
     return dot_product_attention(q, k, v, mask=mask, causal=True)
 
 
+def _mlp(config: GPTConfig, mlp_params: Params, h: jax.Array) -> jax.Array:
+    return mlp_gelu(mlp_params, h, act=activation_fn(config.activation))
+
+
 def block_forward(
     block: Params,
     x: jax.Array,
     *,
     config: GPTConfig,
     mask: jax.Array | None,
+    cos: jax.Array | None = None,
+    sin: jax.Array | None = None,
+    positions: jax.Array | None = None,
 ) -> jax.Array:
     from jax.ad_checkpoint import checkpoint_name
 
-    h = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
-    q, k, v = attention_qkv(block["attn"], h)
+    h1 = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+    q, k, v = attention_qkv(block["attn"], h1)
+    if config.positional == "rotary":
+        q = checkpoint_name(_apply_rotary(q, cos, sin, positions, config), "q_rope")
+        k = checkpoint_name(_apply_rotary(k, cos, sin, positions, config), "k_rope")
     attn = _attention(config, q, k, v, mask)
-    x = x + checkpoint_name(attention_out(block["attn"], attn), "attn_out")
-    h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
-    x = x + checkpoint_name(mlp_gelu(block["mlp"], h), "ffn_out")
-    return x
+    attn_out = checkpoint_name(attention_out(block["attn"], attn), "attn_out")
+    if config.parallel_residual:
+        h2 = (
+            h1
+            if config.shared_parallel_norm
+            else layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+        )
+        return x + attn_out + checkpoint_name(_mlp(config, block["mlp"], h2), "ffn_out")
+    x = x + attn_out
+    h2 = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+    return x + checkpoint_name(_mlp(config, block["mlp"], h2), "ffn_out")
 
 
 def _lm_head(params: Params, config: GPTConfig) -> jax.Array:
@@ -169,7 +284,10 @@ def _lm_head(params: Params, config: GPTConfig) -> jax.Array:
 
 def _logits(params: Params, x: jax.Array, config: GPTConfig) -> jax.Array:
     head = _lm_head(params, config)
-    return jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(logits.dtype)
+    return logits
 
 
 def forward(
@@ -190,9 +308,16 @@ def forward(
         raise ValueError(f"sequence length {S} exceeds max_seq_len={config.max_seq_len}")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    x = params["wte"][tokens] + params["wpe"][positions]
+    x = params["wte"][tokens]
+    if config.positional == "learned":
+        x = x + params["wpe"][positions]
+        cos = sin = None
+    else:
+        cos, sin = _rope_tables(config)
 
-    body = partial(block_forward, config=config, mask=mask)
+    body = partial(
+        block_forward, config=config, mask=mask, cos=cos, sin=sin, positions=positions
+    )
     if config.remat:
         body = jax.checkpoint(body, policy=remat_policy(config.remat_policy))
 
@@ -233,21 +358,38 @@ def forward_with_cache(
     cache_pos = jnp.arange(max_len, dtype=jnp.int32)
     mask = cache_pos[None, None, :] <= positions[:, :, None]
 
-    x = params["wte"][tokens] + params["wpe"][positions]
+    x = params["wte"][tokens]
+    if config.positional == "learned":
+        x = x + params["wpe"][positions]
+        cos = sin = None
+    else:
+        cos, sin = _rope_tables(config)
 
     def scan_body(carry, xs):
         x = carry
         block, k_cache, v_cache = xs
-        h = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
-        q, k, v = attention_qkv(block["attn"], h)
+        h1 = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+        q, k, v = attention_qkv(block["attn"], h1)
+        if config.positional == "rotary":
+            q = _apply_rotary(q, cos, sin, positions, config)
+            k = _apply_rotary(k, cos, sin, positions, config)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
         attn = dot_product_attention(
             q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
         )
-        x = x + attention_out(block["attn"], attn)
-        h = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
-        x = x + mlp_gelu(block["mlp"], h)
+        attn_out = attention_out(block["attn"], attn)
+        if config.parallel_residual:
+            h2 = (
+                h1
+                if config.shared_parallel_norm
+                else layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+            )
+            x = x + attn_out + _mlp(config, block["mlp"], h2)
+        else:
+            x = x + attn_out
+            h2 = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+            x = x + _mlp(config, block["mlp"], h2)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -24,11 +24,15 @@ repo never materializes a full tensor on any host (the streaming contract of
 
 Supported ``model_type``s: llama, mistral, mixtral, qwen2 (the llama
 family — mixtral routes through the MoE blocks, qwen2 adds q/k/v biases),
-gpt2, bert, vit, t5 (v1.1 gated layout). Norm weights are rebased for this framework's ``(1 + scale)``
-RMSNorm parameterization where applicable. `save_pretrained` writes the
-repo back out in HF layout (llama/qwen2/gpt2/bert/vit/t5) so
-`transformers` loads the export unchanged — round-trip logit parity is
-tested for every family.
+gpt2, gpt_neox, gptj, opt (the gpt family — variant knobs select rotary
+style, parallel residual, activation, and bias layout; these are the
+reference's published big-model-inference models,
+`benchmarks/big_model_inference/README.md:27-37`), bert, vit, t5 (v1.1
+gated layout). Norm weights are rebased for this framework's
+``(1 + scale)`` RMSNorm parameterization where applicable.
+`save_pretrained` writes the repo back out in HF layout (every family and
+layout above) so `transformers` loads the export unchanged — round-trip
+logit parity is tested for every family.
 """
 
 from __future__ import annotations
@@ -136,6 +140,39 @@ def _conv1d_qkv_bias(d_model: int, head_dim: int, part: int) -> Fetcher:
         h = head_dim
         rows = slice(part * d_model + hs.start * h, part * d_model + hs.stop * h)
         return read((rows,)).reshape(hs.stop - hs.start, h)
+
+    return fetch
+
+
+def _neox_qkv(head_dim: int, part: int) -> Fetcher:
+    """GPT-NeoX fused ``query_key_value.weight`` (3d, d): rows for head i
+    are ``[i*3h, (i+1)*3h)`` laid out ``[q|k|v]`` PER HEAD (transformers
+    views to ``(..., num_heads, 3*head_size)`` then chunks) — unlike
+    GPT-2's ``[all-q|all-k|all-v]`` Conv1D blocks. -> (d, n_heads, h)."""
+
+    def fetch(read: Callable, idx: tuple, shape: tuple) -> np.ndarray:
+        ds, hs, hd = idx
+        if not _full(hd, shape[2]):
+            raise NotImplementedError("head_dim axis must not be sharded")
+        h = head_dim
+        rows = slice(hs.start * 3 * h, hs.stop * 3 * h)
+        arr = read((rows, ds))  # (3h * heads, d_sub)
+        arr = arr.reshape(hs.stop - hs.start, 3, h, ds.stop - ds.start)
+        return np.ascontiguousarray(arr[:, part].transpose(2, 0, 1))
+
+    return fetch
+
+
+def _neox_qkv_bias(head_dim: int, part: int) -> Fetcher:
+    """GPT-NeoX fused ``query_key_value.bias`` (3d,) -> (n_heads, h)."""
+
+    def fetch(read: Callable, idx: tuple, shape: tuple) -> np.ndarray:
+        hs, hd = idx
+        if not _full(hd, shape[1]):
+            raise NotImplementedError("head_dim axis must not be sharded")
+        h = head_dim
+        arr = read((slice(hs.start * 3 * h, hs.stop * 3 * h),))
+        return np.ascontiguousarray(arr.reshape(-1, 3, h)[:, part])
 
     return fetch
 
@@ -319,6 +356,132 @@ def _gpt2_oproj(head_dim: int) -> Fetcher:
     return fetch
 
 
+def _neox_specs(config) -> dict[str, _Src]:
+    """GPT-NeoX layout (``gpt_neox.layers.{i}.*`` + ``embed_in``/
+    ``embed_out``); canonical names are unprefixed, the loader's suffix
+    match absorbs the ``gpt_neox.`` root."""
+    h = config.head_dim
+    L = "layers.{i}."
+    m = {
+        "wte": _Src("embed_in.weight", invert=_inv_ident),
+        "lnf_scale": _Src("final_layer_norm.weight", invert=_inv_ident),
+        "lnf_bias": _Src("final_layer_norm.bias", invert=_inv_ident),
+        "blocks.ln1_scale": _Src(L + "input_layernorm.weight", _ident, True, _inv_ident),
+        "blocks.ln1_bias": _Src(L + "input_layernorm.bias", _ident, True, _inv_ident),
+        "blocks.ln2_scale": _Src(L + "post_attention_layernorm.weight", _ident, True, _inv_ident),
+        "blocks.ln2_bias": _Src(L + "post_attention_layernorm.bias", _ident, True, _inv_ident),
+        "blocks.attn.wq": _Src(L + "attention.query_key_value.weight", _neox_qkv(h, 0), True),
+        "blocks.attn.wk": _Src(L + "attention.query_key_value.weight", _neox_qkv(h, 1), True),
+        "blocks.attn.wv": _Src(L + "attention.query_key_value.weight", _neox_qkv(h, 2), True),
+        "blocks.attn.wo": _Src(L + "attention.dense.weight", _oproj(h), True, _inv_oproj),
+        "blocks.mlp.w_in": _Src(L + "mlp.dense_h_to_4h.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_in": _Src(L + "mlp.dense_h_to_4h.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_out": _Src(L + "mlp.dense_4h_to_h.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_out": _Src(L + "mlp.dense_4h_to_h.bias", _ident, True, _inv_ident),
+    }
+    if config.attn_bias:
+        m["blocks.attn.bq"] = _Src(L + "attention.query_key_value.bias", _neox_qkv_bias(h, 0), True)
+        m["blocks.attn.bk"] = _Src(L + "attention.query_key_value.bias", _neox_qkv_bias(h, 1), True)
+        m["blocks.attn.bv"] = _Src(L + "attention.query_key_value.bias", _neox_qkv_bias(h, 2), True)
+        m["blocks.attn.bo"] = _Src(L + "attention.dense.bias", _ident, True, _inv_ident)
+    if not config.tie_embeddings:
+        m["lm_head"] = _Src("embed_out.weight", _t2, invert=_inv_t2)
+    return m
+
+
+def _gptj_specs(config) -> dict[str, _Src]:
+    """GPT-J layout (``transformer.h.{i}.*``): separate bias-free q/k/v/out
+    projections, biased MLP, single shared ``ln_1``, untied biased head."""
+    h = config.head_dim
+    L = "h.{i}."
+    m = {
+        "wte": _Src("wte.weight", invert=_inv_ident),
+        "lnf_scale": _Src("ln_f.weight", invert=_inv_ident),
+        "lnf_bias": _Src("ln_f.bias", invert=_inv_ident),
+        "blocks.ln1_scale": _Src(L + "ln_1.weight", _ident, True, _inv_ident),
+        "blocks.ln1_bias": _Src(L + "ln_1.bias", _ident, True, _inv_ident),
+        "blocks.attn.wq": _Src(L + "attn.q_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wk": _Src(L + "attn.k_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wv": _Src(L + "attn.v_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wo": _Src(L + "attn.out_proj.weight", _oproj(h), True, _inv_oproj),
+        "blocks.mlp.w_in": _Src(L + "mlp.fc_in.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_in": _Src(L + "mlp.fc_in.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_out": _Src(L + "mlp.fc_out.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_out": _Src(L + "mlp.fc_out.bias", _ident, True, _inv_ident),
+    }
+    if not config.tie_embeddings:
+        m["lm_head"] = _Src("lm_head.weight", _t2, invert=_inv_t2)
+        if config.head_bias:
+            m["lm_head_bias"] = _Src("lm_head.bias", invert=_inv_ident)
+    return m
+
+
+def _inv_opt_pos(arr: np.ndarray) -> np.ndarray:
+    # Re-prepend OPTLearnedPositionalEmbedding's 2 offset rows (never read
+    # at inference — position lookups add offset 2).
+    return np.concatenate([np.zeros((2, arr.shape[1]), arr.dtype), arr])
+
+
+def _opt_specs(config) -> dict[str, _Src]:
+    """OPT layout (``model.decoder.layers.{i}.*``). ``embed_positions`` has
+    a 2-row lookup offset (transformers ``OPTLearnedPositionalEmbedding``);
+    the fetch slices it off so forward uses plain 0-based positions. The
+    per-layer ``final_layer_norm`` is the MLP's pre-norm (ln2) — only the
+    top-level ``decoder.final_layer_norm`` is the real final norm, and the
+    canonical names keep the ``decoder.`` segment so the suffix match can't
+    confuse the two."""
+    h = config.head_dim
+
+    def pos_fetch(read: Callable, idx: tuple, shape: tuple) -> np.ndarray:
+        i0, i1 = _norm_idx(idx, shape)
+        return read((slice(i0.start + 2, i0.stop + 2), i1))
+
+    L = "decoder.layers.{i}."
+    m = {
+        "wte": _Src("decoder.embed_tokens.weight", invert=_inv_ident),
+        "wpe": _Src("decoder.embed_positions.weight", pos_fetch, invert=_inv_opt_pos),
+        "lnf_scale": _Src("decoder.final_layer_norm.weight", invert=_inv_ident),
+        "lnf_bias": _Src("decoder.final_layer_norm.bias", invert=_inv_ident),
+        "blocks.ln1_scale": _Src(L + "self_attn_layer_norm.weight", _ident, True, _inv_ident),
+        "blocks.ln1_bias": _Src(L + "self_attn_layer_norm.bias", _ident, True, _inv_ident),
+        "blocks.ln2_scale": _Src(L + "final_layer_norm.weight", _ident, True, _inv_ident),
+        "blocks.ln2_bias": _Src(L + "final_layer_norm.bias", _ident, True, _inv_ident),
+        "blocks.attn.wq": _Src(L + "self_attn.q_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wk": _Src(L + "self_attn.k_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.wv": _Src(L + "self_attn.v_proj.weight", _qkv(h), True, _inv_qkv),
+        "blocks.attn.bq": _Src(L + "self_attn.q_proj.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bk": _Src(L + "self_attn.k_proj.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.bv": _Src(L + "self_attn.v_proj.bias", _vec_heads(h), True, _inv_vec_heads),
+        "blocks.attn.wo": _Src(L + "self_attn.out_proj.weight", _oproj(h), True, _inv_oproj),
+        "blocks.attn.bo": _Src(L + "self_attn.out_proj.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_in": _Src(L + "fc1.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_in": _Src(L + "fc1.bias", _ident, True, _inv_ident),
+        "blocks.mlp.w_out": _Src(L + "fc2.weight", _t2, True, _inv_t2),
+        "blocks.mlp.b_out": _Src(L + "fc2.bias", _ident, True, _inv_ident),
+    }
+    if not config.tie_embeddings:
+        # Every released OPT ties, but an untied config must still map its
+        # head — otherwise export silently drops the trained weight.
+        m["lm_head"] = _Src("lm_head.weight", _t2, invert=_inv_t2)
+    return m
+
+
+def _gpt_specs(config) -> dict[str, _Src]:
+    layout = getattr(config, "hf_layout", "gpt2")
+    builder = {
+        "gpt2": _gpt2_specs,
+        "gpt_neox": _neox_specs,
+        "gptj": _gptj_specs,
+        "opt": _opt_specs,
+    }.get(layout)
+    if builder is None:
+        raise ValueError(
+            f"GPTConfig.hf_layout={layout!r} has no HF map; known: gpt2, "
+            "gpt_neox, gptj, opt."
+        )
+    return builder(config)
+
+
 def _bert_specs(config) -> dict[str, _Src]:
     h = config.attention_spec.head_dim
     E = "embeddings."
@@ -454,7 +617,7 @@ def _t5_specs(config) -> dict[str, _Src]:
 
 _SPEC_BUILDERS: dict[str, Callable[[Any], dict[str, _Src]]] = {
     "llama": _llama_specs,
-    "gpt": _gpt2_specs,
+    "gpt": _gpt_specs,
     "bert": _bert_specs,
     "vit": _vit_specs,
     "t5": _t5_specs,
@@ -691,6 +854,140 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
             norm_eps=config.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=config.get("tie_word_embeddings", True),
         )
+    if mt == "gpt_neox":
+        from .gpt import GPTConfig
+
+        act = {"gelu": "gelu", "gelu_new": "gelu_new", "gelu_fast": "gelu_new"}.get(
+            config.get("hidden_act", "gelu")
+        )
+        if act is None:
+            raise ValueError(
+                f"This GPT-NeoX checkpoint uses hidden_act="
+                f"{config.get('hidden_act')!r}; implemented: gelu, gelu_new, "
+                "gelu_fast — logits would silently diverge otherwise."
+            )
+        rs = config.get("rope_scaling")
+        if rs and (rs.get("rope_type") or rs.get("type") or "default") != "default":
+            raise ValueError(
+                "rope_scaling on a GPT-NeoX checkpoint is not implemented "
+                "for this family (no released NeoX-lineage checkpoint ships "
+                "one); loading with unscaled rotary would silently diverge."
+            )
+        d = config["hidden_size"]
+        head_dim = d // config["num_attention_heads"]
+        return "gpt", GPTConfig(
+            vocab_size=config["vocab_size"],
+            d_model=d,
+            n_layers=config["num_hidden_layers"],
+            num_heads=config["num_attention_heads"],
+            d_ff=config["intermediate_size"],
+            max_seq_len=config.get("max_position_embeddings", 2048),
+            norm_eps=config.get("layer_norm_eps", 1e-5),
+            tie_embeddings=config.get("tie_word_embeddings", False),
+            hf_layout="gpt_neox",
+            positional="rotary",
+            # 0.25 is GPTNeoXConfig's default — an omitted rotary_pct means
+            # quarter-head rotary, not full-head.
+            rotary_dim=int(head_dim * config.get("rotary_pct", 0.25)),
+            rope_theta=float(
+                config.get("rotary_emb_base", config.get("rope_theta", 10000.0))
+            ),
+            parallel_residual=config.get("use_parallel_residual", True),
+            activation=act,
+            attn_bias=config.get("attention_bias", True),
+        )
+    if mt == "gptj":
+        from .gpt import GPTConfig
+
+        act = config.get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_fast"):
+            raise ValueError(
+                f"This GPT-J checkpoint uses activation_function={act!r}; "
+                "the family hardwires gelu_new — logits would silently "
+                "diverge otherwise."
+            )
+        d = config["n_embd"]
+        tie = config.get("tie_word_embeddings", False)
+        # 64 is GPTJConfig's default when the key is omitted; an EXPLICIT
+        # null selects a transformers code path whose table sizing is tied
+        # to embed_dim (broken for multi-head) — refuse rather than guess.
+        rotary_dim = config.get("rotary_dim", 64)
+        if rotary_dim is None:
+            raise ValueError(
+                "This GPT-J checkpoint sets rotary_dim=null; the "
+                "full-embedding rotary path is not implemented — set the "
+                "trained rotary_dim explicitly."
+            )
+        return "gpt", GPTConfig(
+            vocab_size=config["vocab_size"],
+            d_model=d,
+            n_layers=config["n_layer"],
+            num_heads=config["n_head"],
+            d_ff=config.get("n_inner") or 4 * d,
+            max_seq_len=config.get("n_positions", 2048),
+            norm_eps=config.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=tie,
+            hf_layout="gptj",
+            positional="rotary",
+            rotary_dim=rotary_dim,
+            rotary_interleaved=True,
+            parallel_residual=True,
+            shared_parallel_norm=True,
+            attn_bias=False,
+            head_bias=not tie,
+        )
+    if mt == "opt":
+        from .gpt import GPTConfig
+
+        # The 350m checkpoint (post-LN + a d_model!=word_embed_proj_dim
+        # projection) and the bias-free research variants change the block
+        # structure itself; loading them into this layout would silently
+        # diverge, so they fail loudly.
+        if not config.get("do_layer_norm_before", True):
+            raise ValueError(
+                "This OPT checkpoint uses post-layernorm blocks "
+                "(do_layer_norm_before=false, the 350m layout); only the "
+                "pre-LN layout is implemented."
+            )
+        if config.get("word_embed_proj_dim", config["hidden_size"]) != config["hidden_size"]:
+            raise ValueError(
+                "This OPT checkpoint projects embeddings "
+                f"(word_embed_proj_dim={config['word_embed_proj_dim']} != "
+                f"hidden_size={config['hidden_size']}); the projection "
+                "layers are not implemented."
+            )
+        if not config.get("enable_bias", True) or not config.get(
+            "layer_norm_elementwise_affine", True
+        ):
+            raise ValueError(
+                "This OPT checkpoint disables projection biases or affine "
+                "layernorms; only the standard released layout is implemented."
+            )
+        if config.get("_remove_final_layer_norm"):
+            raise ValueError(
+                "This OPT checkpoint sets _remove_final_layer_norm (a "
+                "pre-release conversion quirk); re-convert with a current "
+                "transformers before loading."
+            )
+        act = config.get("activation_function", "relu")
+        if act not in ("relu", "gelu", "gelu_new"):
+            raise ValueError(
+                f"This OPT checkpoint uses activation_function={act!r}; "
+                "implemented: relu, gelu, gelu_new."
+            )
+        return "gpt", GPTConfig(
+            vocab_size=config["vocab_size"],
+            d_model=config["hidden_size"],
+            n_layers=config["num_hidden_layers"],
+            num_heads=config["num_attention_heads"],
+            d_ff=config["ffn_dim"],
+            max_seq_len=config.get("max_position_embeddings", 2048),
+            # torch nn.LayerNorm default — OPT has no eps config field.
+            norm_eps=1e-5,
+            tie_embeddings=config.get("tie_word_embeddings", True),
+            hf_layout="opt",
+            activation=act,
+        )
     if mt == "bert":
         from .bert import BertConfig
 
@@ -758,7 +1055,8 @@ def from_hf_config(config: Any) -> tuple[str, Any]:
         )
     raise ValueError(
         f"Unsupported HF model_type {mt!r}; supported: llama, mistral, "
-        "mixtral, qwen2, gpt2, bert, vit, t5 (v1.1 gated layout)."
+        "mixtral, qwen2, gpt2, gpt_neox, gptj, opt, bert, vit, t5 (v1.1 "
+        "gated layout)."
     )
 
 
@@ -1133,20 +1431,78 @@ def config_to_hf(family: str, config: Any, *, torch_dtype: str = "float32") -> d
             "torch_dtype": torch_dtype,
         }
     if family == "gpt":
-        return {
-            "model_type": "gpt2",
-            "architectures": ["GPT2LMHeadModel"],
-            "vocab_size": config.vocab_size,
-            "n_embd": config.d_model,
-            "n_layer": config.n_layers,
-            "n_head": config.num_heads,
-            "n_inner": config.d_ff,
-            "n_positions": config.max_seq_len,
-            "n_ctx": config.max_seq_len,
-            "layer_norm_epsilon": config.norm_eps,
-            "tie_word_embeddings": config.tie_embeddings,
-            "torch_dtype": torch_dtype,
-        }
+        layout = getattr(config, "hf_layout", "gpt2")
+        if layout == "gpt2":
+            return {
+                "model_type": "gpt2",
+                "architectures": ["GPT2LMHeadModel"],
+                "vocab_size": config.vocab_size,
+                "n_embd": config.d_model,
+                "n_layer": config.n_layers,
+                "n_head": config.num_heads,
+                "n_inner": config.d_ff,
+                "n_positions": config.max_seq_len,
+                "n_ctx": config.max_seq_len,
+                # The true trained activation, not a hardwired default — a
+                # mislabeled config.json reloads with the wrong ACT2FN and
+                # silently diverges.
+                "activation_function": config.activation,
+                "layer_norm_epsilon": config.norm_eps,
+                "tie_word_embeddings": config.tie_embeddings,
+                "torch_dtype": torch_dtype,
+            }
+        if layout == "gpt_neox":
+            return {
+                "model_type": "gpt_neox",
+                "architectures": ["GPTNeoXForCausalLM"],
+                "vocab_size": config.vocab_size,
+                "hidden_size": config.d_model,
+                "num_hidden_layers": config.n_layers,
+                "num_attention_heads": config.num_heads,
+                "intermediate_size": config.d_ff,
+                "max_position_embeddings": config.max_seq_len,
+                "rotary_pct": config.resolved_rotary_dim / config.head_dim,
+                "rotary_emb_base": config.rope_theta,
+                "hidden_act": config.activation,
+                "use_parallel_residual": config.parallel_residual,
+                "attention_bias": config.attn_bias,
+                "layer_norm_eps": config.norm_eps,
+                "tie_word_embeddings": config.tie_embeddings,
+                "torch_dtype": torch_dtype,
+            }
+        if layout == "gptj":
+            return {
+                "model_type": "gptj",
+                "architectures": ["GPTJForCausalLM"],
+                "vocab_size": config.vocab_size,
+                "n_embd": config.d_model,
+                "n_layer": config.n_layers,
+                "n_head": config.num_heads,
+                "n_inner": config.d_ff,
+                "n_positions": config.max_seq_len,
+                "rotary_dim": config.resolved_rotary_dim,
+                "activation_function": config.activation,
+                "layer_norm_epsilon": config.norm_eps,
+                "tie_word_embeddings": config.tie_embeddings,
+                "torch_dtype": torch_dtype,
+            }
+        if layout == "opt":
+            return {
+                "model_type": "opt",
+                "architectures": ["OPTForCausalLM"],
+                "vocab_size": config.vocab_size,
+                "hidden_size": config.d_model,
+                "num_hidden_layers": config.n_layers,
+                "num_attention_heads": config.num_heads,
+                "ffn_dim": config.d_ff,
+                "max_position_embeddings": config.max_seq_len,
+                "word_embed_proj_dim": config.d_model,
+                "do_layer_norm_before": True,
+                "activation_function": config.activation,
+                "tie_word_embeddings": config.tie_embeddings,
+                "torch_dtype": torch_dtype,
+            }
+        raise ValueError(f"config_to_hf has no branch for gpt layout {layout!r}.")
     raise ValueError(f"config_to_hf has no branch for family {family!r}.")
 
 
@@ -1174,7 +1530,11 @@ def save_pretrained(
             "utils.quantization.dequantize_pytree first."
         )
     specs_map = hf_key_specs(family, config)
-    if family != "gpt":
+    # GPT-2 and GPT-NeoX re-FUSE q/k/v into one checkpoint tensor on the way
+    # out — a dedicated generator, not per-leaf inverts.
+    gpt_layout = getattr(config, "hf_layout", "gpt2") if family == "gpt" else None
+    fused_qkv_export = gpt_layout in ("gpt2", "gpt_neox")
+    if not fused_qkv_export:
         missing = [k for k, s in specs_map.items() if s.invert is None]
         if missing:
             raise NotImplementedError(
@@ -1198,8 +1558,9 @@ def save_pretrained(
         json.dump(config_to_hf(family, config, torch_dtype=dtype_name), f, indent=2)
 
     def tensors() -> Any:
-        if family == "gpt":
-            yield from _gpt2_export_tensors(config, params, leaf_for)
+        if fused_qkv_export:
+            gen = _gpt2_export_tensors if gpt_layout == "gpt2" else _neox_export_tensors
+            yield from gen(config, params, leaf_for)
             return
         for key, src in specs_map.items():
             leaf = leaf_for(key)
@@ -1225,11 +1586,18 @@ def save_pretrained(
     # "vit.encoder...") while head weights stay bare; transformers refuses
     # the load otherwise. The maps here are canonical/unprefixed, so the
     # prefix is applied on the way out.
-    prefix, exempt = {
-        "bert": ("bert.", ("classifier.",)),
-        "vit": ("vit.", ("classifier.",)),
-        "gpt": ("transformer.", ("lm_head.",)),
-    }.get(family, ("", ()))
+    if family == "gpt":
+        prefix, exempt = {
+            "gpt2": ("transformer.", ("lm_head.",)),
+            "gptj": ("transformer.", ("lm_head.",)),
+            "gpt_neox": ("gpt_neox.", ("embed_out.",)),
+            "opt": ("model.", ("lm_head.",)),
+        }[gpt_layout]
+    else:
+        prefix, exempt = {
+            "bert": ("bert.", ("classifier.",)),
+            "vit": ("vit.", ("classifier.",)),
+        }.get(family, ("", ()))
 
     def exported_name(name: str) -> str:
         if prefix and not name.startswith(exempt):
@@ -1268,6 +1636,60 @@ def save_pretrained(
             {"metadata": {"total_size": total}, "weight_map": weight_map}, f
         )
     return path
+
+
+def _neox_export_tensors(config, params, leaf_for):
+    """GPT-NeoX export: q/k/v re-fuse into ``query_key_value`` with the
+    PER-HEAD ``[q|k|v]`` row layout (see `_neox_qkv`)."""
+
+    def get(dotted):
+        return np.asarray(jax.device_get(leaf_for(dotted)))
+
+    yield "embed_in.weight", get("wte")
+    yield "final_layer_norm.weight", get("lnf_scale")
+    yield "final_layer_norm.bias", get("lnf_bias")
+    if not config.tie_embeddings:
+        yield "embed_out.weight", np.ascontiguousarray(get("lm_head").T)
+    d = config.d_model
+    for i in range(config.n_layers):
+        L = f"layers.{i}."
+        for ours, theirs in (
+            ("ln1_scale", "input_layernorm.weight"),
+            ("ln1_bias", "input_layernorm.bias"),
+            ("ln2_scale", "post_attention_layernorm.weight"),
+            ("ln2_bias", "post_attention_layernorm.bias"),
+        ):
+            yield L + theirs, np.asarray(jax.device_get(leaf_for(f"blocks.{ours}")[i]))
+        attn = params["blocks"]["attn"]
+        # (d, nh, h) x3 -> (nh, 3, h, d) -> (3d, d)
+        qkv = np.stack(
+            [np.asarray(jax.device_get(attn[k][i])).transpose(1, 2, 0) for k in ("wq", "wk", "wv")],
+            axis=1,
+        )
+        yield L + "attention.query_key_value.weight", np.ascontiguousarray(
+            qkv.reshape(-1, d)
+        )
+        if config.attn_bias:
+            bias = np.stack(
+                [np.asarray(jax.device_get(attn[k][i])) for k in ("bq", "bk", "bv")],
+                axis=1,
+            )  # (nh, 3, h)
+            yield L + "attention.query_key_value.bias", np.ascontiguousarray(
+                bias.reshape(-1)
+            )
+            yield L + "attention.dense.bias", np.asarray(jax.device_get(attn["bo"][i]))
+        yield L + "attention.dense.weight", np.ascontiguousarray(
+            np.asarray(jax.device_get(attn["wo"][i])).reshape(-1, d).T
+        )
+        mlp = params["blocks"]["mlp"]
+        yield L + "mlp.dense_h_to_4h.weight", np.ascontiguousarray(
+            np.asarray(jax.device_get(mlp["w_in"][i])).T
+        )
+        yield L + "mlp.dense_h_to_4h.bias", np.asarray(jax.device_get(mlp["b_in"][i]))
+        yield L + "mlp.dense_4h_to_h.weight", np.ascontiguousarray(
+            np.asarray(jax.device_get(mlp["w_out"][i])).T
+        )
+        yield L + "mlp.dense_4h_to_h.bias", np.asarray(jax.device_get(mlp["b_out"][i]))
 
 
 def _gpt2_export_tensors(config, params, leaf_for):

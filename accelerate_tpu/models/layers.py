@@ -18,6 +18,7 @@ Conventions: ``B`` batch, ``S`` sequence, ``D`` model dim, ``H`` heads,
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any
 
 import jax
@@ -140,13 +141,30 @@ def rope_frequencies(
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
-    """Rotary position embedding. x: (B, S, H, h); positions: (B, S)."""
+    """Rotary position embedding, rotate-half pairing (llama/GPT-NeoX:
+    dimension i pairs with i + h/2). x: (B, S, H, h); positions: (B, S)."""
     dtype = x.dtype
     cos = cos[positions][:, :, None, :]  # (B, S, 1, h/2)
     sin = sin[positions][:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(dtype)
+
+
+def apply_rope_interleaved(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Rotary position embedding, interleaved pairing (GPT-J
+    ``rotate_every_two``: dimension 2i pairs with 2i+1). Same cos/sin tables
+    as `apply_rope` — only the pairing differs, so checkpoints trained with
+    one convention silently produce wrong logits under the other."""
+    dtype = x.dtype
+    cos = cos[positions][:, :, None, :]  # (B, S, 1, h/2)
+    sin = sin[positions][:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(xf.shape).astype(dtype)
 
 
 # ----------------------------------------------------------------- attention
@@ -293,13 +311,35 @@ def init_mlp_gelu(rng: jax.Array, d_model: int, d_ff: int, dtype=jnp.float32) ->
     }
 
 
-def mlp_gelu(params: Params, x: jax.Array, *, approximate: bool = True) -> jax.Array:
+def activation_fn(name: str):
+    """HF ``ACT2FN`` names -> jax callables for the variants the zoo's
+    checkpoints actually ship. ``gelu_fast`` is ``gelu_new`` with the tanh
+    argument factored differently — algebraically identical."""
+    try:
+        return {
+            "gelu_new": partial(jax.nn.gelu, approximate=True),
+            "gelu_fast": partial(jax.nn.gelu, approximate=True),
+            "gelu": partial(jax.nn.gelu, approximate=False),
+            "relu": jax.nn.relu,
+            "silu": jax.nn.silu,
+        }[name]
+    except KeyError:
+        raise ValueError(
+            f"Unimplemented activation {name!r}; implemented: gelu_new, "
+            "gelu_fast, gelu, relu, silu."
+        ) from None
+
+
+def mlp_gelu(
+    params: Params, x: jax.Array, *, approximate: bool = True, act=None
+) -> jax.Array:
     """``approximate=True`` is GPT-2's tanh "gelu_new"; BERT/ViT use the
     exact erf gelu (transformers ``ACT2FN["gelu"]``) — the two differ by up
     to ~3e-3 at real activation scales, so the variant must match the
-    checkpoint's or logit parity quietly breaks."""
+    checkpoint's or logit parity quietly breaks. ``act`` (a callable)
+    overrides entirely (OPT's relu MLP rides the same param layout)."""
     h = matmul_einsum("bsd,df->bsf", x, params["w_in"]) + params["b_in"].astype(x.dtype)
-    h = jax.nn.gelu(h, approximate=approximate)
+    h = act(h) if act is not None else jax.nn.gelu(h, approximate=approximate)
     return matmul_einsum("bsf,fd->bsd", h, params["w_out"]) + params["b_out"].astype(x.dtype)
 
 

@@ -813,3 +813,220 @@ class TestHubIdResolution:
     def test_filesystem_paths_never_hit_the_hub(self, tmp_path):
         with pytest.raises(ValueError, match="does not exist"):
             hf.from_hf_config(str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# The gpt family's variant layouts: GPT-NeoX / GPT-J / OPT — the reference's
+# published big-model-inference table (reference
+# benchmarks/big_model_inference/README.md:27-37).
+class TestGPTNeoXParity:
+    def _tiny(self, **over):
+        kw = dict(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, rotary_pct=0.5,
+            use_parallel_residual=True, tie_word_embeddings=False,
+        )
+        kw.update(over)
+        return transformers.GPTNeoXConfig(**kw)
+
+    def test_config_translation(self, tmp_path):
+        torch.manual_seed(30)
+        model = transformers.GPTNeoXForCausalLM(self._tiny()).eval()
+        repo = _save_hf(model, tmp_path, "neox")
+        family, config = hf.from_hf_config(repo)
+        assert family == "gpt"
+        assert config.hf_layout == "gpt_neox"
+        assert config.positional == "rotary"
+        assert config.rotary_dim == 4  # head_dim 8 * rotary_pct 0.5
+        assert not config.rotary_interleaved
+        assert config.parallel_residual and not config.shared_parallel_norm
+        assert config.activation == "gelu"
+
+    @pytest.mark.parametrize("parallel", [True, False])
+    def test_forward_matches_transformers(self, tmp_path, parallel):
+        torch.manual_seed(31)
+        model = transformers.GPTNeoXForCausalLM(
+            self._tiny(use_parallel_residual=parallel)
+        ).eval()
+        repo = _save_hf(model, tmp_path, "neox")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()), min_weight_size=1)
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config))
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_forward_matches_on_tp_mesh(self, tmp_path):
+        """The fused-qkv per-head fetcher must slice correctly when heads
+        are sharded over a tensor axis."""
+        torch.manual_seed(32)
+        model = transformers.GPTNeoXForCausalLM(self._tiny()).eval()
+        repo = _save_hf(model, tmp_path, "neox")
+        mesh = build_mesh(MeshConfig(data=1, fsdp=2, tensor=4))
+        loaded = hf.load_pretrained(repo, mesh=mesh, min_weight_size=1)
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config))
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_export_round_trip(self, tmp_path):
+        torch.manual_seed(33)
+        model = transformers.GPTNeoXForCausalLM(self._tiny()).eval()
+        repo = _save_hf(model, tmp_path, "neox")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out = str(tmp_path / "exp")
+        hf.save_pretrained(out, loaded.family, loaded.config, loaded.params)
+        reloaded = transformers.GPTNeoXForCausalLM.from_pretrained(out).eval()
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        with torch.no_grad():
+            np.testing.assert_allclose(
+                reloaded(tokens).logits.numpy(), model(tokens).logits.numpy(),
+                atol=5e-5, rtol=2e-4,
+            )
+
+    def test_rope_scaled_neox_rejected(self, tmp_path):
+        cfg = self._tiny()
+        d = tmp_path / "rs"
+        d.mkdir()
+        payload = cfg.to_dict()
+        payload["rope_scaling"] = {"rope_type": "linear", "factor": 2.0}
+        json.dump(payload, open(d / "config.json", "w"))
+        with pytest.raises(ValueError, match="rope_scaling"):
+            hf.from_hf_config(str(d / "config.json"))
+
+
+class TestGPTJParity:
+    def _model(self, seed=40):
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+            rotary_dim=4, tie_word_embeddings=False,
+        )
+        torch.manual_seed(seed)
+        return transformers.GPTJForCausalLM(cfg).eval()
+
+    def test_config_translation(self, tmp_path):
+        repo = _save_hf(self._model(), tmp_path, "gptj")
+        family, config = hf.from_hf_config(repo)
+        assert family == "gpt"
+        assert config.hf_layout == "gptj"
+        assert config.rotary_interleaved
+        assert config.rotary_dim == 4
+        assert config.parallel_residual and config.shared_parallel_norm
+        assert not config.attn_bias and config.head_bias
+
+    def test_forward_matches_transformers(self, tmp_path):
+        model = self._model(41)
+        repo = _save_hf(model, tmp_path, "gptj")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()), min_weight_size=1)
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config))
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_decode_matches_forward(self, tmp_path):
+        """Interleaved partial rotary must agree between the full forward
+        and the KV-cache decode path."""
+        model = self._model(42)
+        repo = _save_hf(model, tmp_path, "gptj")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        tokens = np.arange(16, dtype=np.int32).reshape(2, 8) % 128
+        full = np.asarray(gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config))
+        cache = gpt.init_cache(loaded.config, 2, 16, dtype=jnp.float32)
+        inc, _ = gpt.forward_with_cache(loaded.params, jnp.asarray(tokens), cache, loaded.config)
+        np.testing.assert_allclose(np.asarray(inc), full, atol=1e-5, rtol=1e-5)
+
+    def test_export_round_trip(self, tmp_path):
+        model = self._model(43)
+        repo = _save_hf(model, tmp_path, "gptj")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out = str(tmp_path / "exp")
+        hf.save_pretrained(out, loaded.family, loaded.config, loaded.params)
+        reloaded = transformers.GPTJForCausalLM.from_pretrained(out).eval()
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        with torch.no_grad():
+            np.testing.assert_allclose(
+                reloaded(tokens).logits.numpy(), model(tokens).logits.numpy(),
+                atol=5e-5, rtol=2e-4,
+            )
+
+
+class TestOPTParity:
+    def _cfg(self, **over):
+        kw = dict(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, ffn_dim=64, max_position_embeddings=64,
+            do_layer_norm_before=True, word_embed_proj_dim=32,
+        )
+        kw.update(over)
+        return transformers.OPTConfig(**kw)
+
+    def test_config_translation(self, tmp_path):
+        torch.manual_seed(50)
+        model = transformers.OPTForCausalLM(self._cfg()).eval()
+        repo = _save_hf(model, tmp_path, "opt")
+        family, config = hf.from_hf_config(repo)
+        assert family == "gpt"
+        assert config.hf_layout == "opt"
+        assert config.positional == "learned"
+        assert config.activation == "relu"
+        assert config.tie_embeddings
+
+    def test_forward_matches_transformers(self, tmp_path):
+        torch.manual_seed(51)
+        model = transformers.OPTForCausalLM(self._cfg()).eval()
+        repo = _save_hf(model, tmp_path, "opt")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()), min_weight_size=1)
+        tokens = np.arange(20, dtype=np.int32).reshape(2, 10) % 128
+        ours = np.asarray(gpt.forward(loaded.params, jnp.asarray(tokens), loaded.config))
+        with torch.no_grad():
+            theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=2e-3)
+
+    def test_export_round_trip(self, tmp_path):
+        torch.manual_seed(52)
+        model = transformers.OPTForCausalLM(self._cfg()).eval()
+        repo = _save_hf(model, tmp_path, "opt")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        out = str(tmp_path / "exp")
+        hf.save_pretrained(out, loaded.family, loaded.config, loaded.params)
+        reloaded = transformers.OPTForCausalLM.from_pretrained(out).eval()
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        with torch.no_grad():
+            np.testing.assert_allclose(
+                reloaded(tokens).logits.numpy(), model(tokens).logits.numpy(),
+                atol=5e-5, rtol=2e-4,
+            )
+
+    def test_postln_350m_layout_rejected(self, tmp_path):
+        d = tmp_path / "pl"
+        d.mkdir()
+        json.dump(self._cfg(do_layer_norm_before=False).to_dict(), open(d / "config.json", "w"))
+        with pytest.raises(ValueError, match="post-layernorm"):
+            hf.from_hf_config(str(d / "config.json"))
+
+    def test_projected_embeddings_rejected(self, tmp_path):
+        d = tmp_path / "pe"
+        d.mkdir()
+        json.dump(self._cfg(word_embed_proj_dim=16).to_dict(), open(d / "config.json", "w"))
+        with pytest.raises(ValueError, match="word_embed_proj_dim"):
+            hf.from_hf_config(str(d / "config.json"))
+
+    def test_untied_head_round_trips(self, tmp_path):
+        """An untied OPT head must export (not silently drop) and re-ingest."""
+        torch.manual_seed(53)
+        model = transformers.OPTForCausalLM(self._cfg(tie_word_embeddings=False)).eval()
+        repo = _save_hf(model, tmp_path, "optu")
+        loaded = hf.load_pretrained(repo, mesh=build_mesh(MeshConfig()))
+        assert "lm_head" in loaded.params
+        out = str(tmp_path / "exp")
+        hf.save_pretrained(out, loaded.family, loaded.config, loaded.params)
+        reloaded = transformers.OPTForCausalLM.from_pretrained(out).eval()
+        tokens = torch.arange(20).reshape(2, 10) % 128
+        with torch.no_grad():
+            np.testing.assert_allclose(
+                reloaded(tokens).logits.numpy(), model(tokens).logits.numpy(),
+                atol=5e-5, rtol=2e-4,
+            )
