@@ -34,7 +34,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerationConfig", "Generator", "sample_tokens", "generate"]
+__all__ = ["GenerationConfig", "Generator", "sample_tokens", "warp_logits", "generate"]
 
 
 @dataclass(frozen=True)
@@ -48,10 +48,11 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
-def sample_tokens(logits: jax.Array, rng: jax.Array, config: GenerationConfig) -> jax.Array:
-    """Draw next tokens from (B, V) logits per the sampling config."""
-    if not config.do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def warp_logits(logits: jax.Array, config: GenerationConfig) -> jax.Array:
+    """Apply the sampling config's logit warps (temperature / top-k / top-p)
+    to (..., V) logits. Shared by `sample_tokens` and speculative decoding
+    (which needs the warped DISTRIBUTIONS of draft and target, not just a
+    draw, for the accept/residual math)."""
     logits = logits.astype(jnp.float32)
     if config.temperature != 1.0:
         logits = logits / jnp.maximum(config.temperature, 1e-6)
@@ -67,7 +68,14 @@ def sample_tokens(logits: jax.Array, rng: jax.Array, config: GenerationConfig) -
         cutoff_idx = jnp.sum(cumulative < config.top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def sample_tokens(logits: jax.Array, rng: jax.Array, config: GenerationConfig) -> jax.Array:
+    """Draw next tokens from (B, V) logits per the sampling config."""
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, warp_logits(logits, config), axis=-1).astype(jnp.int32)
 
 
 class Generator:

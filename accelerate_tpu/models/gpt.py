@@ -93,6 +93,19 @@ class GPTConfig:
     attn_bias: bool = True  # GPT-J attention projections are bias-free
     head_bias: bool = False  # GPT-J's untied lm_head has a bias
 
+    def __post_init__(self) -> None:
+        if self.shared_parallel_norm and not self.parallel_residual:
+            # init_block omits ln2 under shared_parallel_norm; the
+            # sequential path reads it — fail at config time, not mid-trace.
+            raise ValueError(
+                "shared_parallel_norm=True requires parallel_residual=True "
+                "(the shared norm IS the parallel layout's single norm)."
+            )
+        if self.positional not in ("learned", "rotary"):
+            raise ValueError(
+                f"positional={self.positional!r}; expected 'learned' or 'rotary'."
+            )
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads

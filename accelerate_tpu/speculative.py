@@ -1,0 +1,351 @@
+"""Speculative decoding: a small draft model proposes K tokens, the target
+model verifies all of them in ONE forward pass.
+
+The reference has no speculative path (its `generate()` is transformers',
+reference `big_modeling.py:511`); this is a beyond-parity decode
+accelerator that falls straight out of the TPU cost model: single-token
+decode is HBM-bandwidth-bound (every step streams all weights for one
+token), so a verify pass over K+1 positions costs nearly the same wall
+time as one decode step. Each accepted draft token is therefore a decode
+step the target never pays for — throughput multiplies by the mean number
+of committed tokens per iteration (≈ K·acceptance + 1).
+
+Shape discipline (XLA): K is static; one jitted `spec_step` per iteration
+runs the draft loop as a `lax.scan` over K single-token steps plus one
+(B, K+1) target verify, with both KV caches donated. Only the per-iteration
+commit count syncs to the host — the same host-loop design as
+`generation.Generator`, amortized K+1 tokens at a time.
+
+Cache bookkeeping rides the models' shared cache contract
+(`{"k","v","length"}`, e.g. `models/llama.py:forward_with_cache`): entries
+past ``length`` are never attended (the mask is position-based), so
+rejecting draft tokens is just writing a smaller ``length`` back — no data
+movement.
+
+Batching: acceptance is per-row, but the caches share one scalar
+``length``, so an iteration commits the MINIMUM accepted count across
+rows; rows that accepted more simply re-propose those tokens next
+iteration (with fresh randomness — still a valid draw). Throughput
+degrades gracefully with batch divergence; the exactness guarantees are
+unaffected.
+
+Guarantees (both tested):
+- greedy (``do_sample=False``): output is bit-identical to target-only
+  greedy decoding, for ANY draft model;
+- sampling: tokens are distributed exactly per the target's (warped)
+  distribution — the Leviathan et al. accept/residual scheme with
+  ``min(1, p/q)`` acceptance and a ``max(0, p-q)`` residual draw, applied
+  after `generation.warp_logits` so temperature/top-k/top-p shape both
+  distributions identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .generation import GenerationConfig, warp_logits
+
+__all__ = ["SpeculativeGenerator", "generate_speculative"]
+
+ApplyFn = Callable[[Any, jax.Array, Any], tuple[jax.Array, Any]]
+
+
+def _probs(logits: jax.Array, config: GenerationConfig) -> jax.Array:
+    return jax.nn.softmax(warp_logits(logits, config), axis=-1)
+
+
+class SpeculativeGenerator:
+    """Reusable speculative-decoding harness over two cached forwards.
+
+    ``target_apply``/``draft_apply`` follow the family cache contract
+    ``(params, tokens, cache) -> (logits, cache)``;
+    ``*_init_cache(batch, max_len)`` build the empty caches. ``params`` is
+    the pair ``(target_params, draft_params)`` at call time.
+    """
+
+    def __init__(
+        self,
+        target_apply: ApplyFn,
+        target_init_cache: Callable[[int, int], Any],
+        draft_apply: ApplyFn,
+        draft_init_cache: Callable[[int, int], Any],
+        config: GenerationConfig | None = None,
+        *,
+        draft_tokens: int = 4,
+        jit_loop: bool = True,
+    ) -> None:
+        if draft_tokens < 1:
+            raise ValueError(f"draft_tokens must be >= 1, got {draft_tokens}")
+        self.config = config or GenerationConfig()
+        self.draft_tokens = K = draft_tokens
+        self.target_init_cache = target_init_cache
+        self.draft_init_cache = draft_init_cache
+        config_ = self.config
+        eos, pad = config_.eos_token_id, config_.pad_token_id
+
+        def prefill(pt, pd, prompt, t_cache, d_cache, rng):
+            """Run the prompt through both models; sample the first token
+            from the target (identical to non-speculative prefill)."""
+            t_logits, t_cache = target_apply(pt, prompt, t_cache)
+            _, d_cache = draft_apply(pd, prompt, d_cache)
+            rng, sub = jax.random.split(rng)
+            from .generation import sample_tokens
+
+            first = sample_tokens(t_logits[:, -1, :], sub, config_)
+            done = (
+                first == eos
+                if eos is not None
+                else jnp.zeros((prompt.shape[0],), bool)
+            )
+            return first, t_cache, d_cache, rng, done
+
+        def spec_step(pt, pd, last, t_cache, d_cache, rng, done):
+            """One draft-K + verify iteration.
+
+            Returns ``tokens`` (B, K+1) with the committed tokens in the
+            first ``n_commit`` columns (the host slices), updated caches
+            rolled back to the committed length, and the EOS state."""
+            B = last.shape[0]
+            rng, r_draft, r_accept, r_fix = jax.random.split(rng, 4)
+
+            # --- draft phase: K+1 single-token steps under lax.scan. Only
+            # the first K proposals are verified; the extra step exists so
+            # the draft CACHE covers position base+K (reached when all K
+            # drafts are accepted) — without it the next iteration would
+            # attend an unwritten cache row there.
+            def draft_body(carry, r):
+                tok, cache = carry
+                logits, cache = draft_apply(pd, tok[:, None], cache)
+                logits = logits[:, -1, :]
+                if config_.do_sample:
+                    nxt = jax.random.categorical(
+                        r, warp_logits(logits, config_), axis=-1
+                    ).astype(jnp.int32)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, cache), (nxt, _probs(logits, config_))
+
+            (_, d_cache), (drafted, q_probs) = jax.lax.scan(
+                draft_body, (last, d_cache), jax.random.split(r_draft, K + 1)
+            )
+            drafted = jnp.moveaxis(drafted, 0, 1)[:, :K]  # (B, K)
+            q_probs = jnp.moveaxis(q_probs, 0, 1)[:, :K]  # (B, K, V)
+
+            # --- verify phase: ONE target forward over [last, d_1..d_K].
+            verify_in = jnp.concatenate([last[:, None], drafted], axis=1)
+            t_logits, t_cache = target_apply(pt, verify_in, t_cache)
+            p_probs = _probs(t_logits, config_)  # (B, K+1, V)
+
+            # --- acceptance: per-row count of leading drafts that pass.
+            if config_.do_sample:
+                # Leviathan accept test: u < p(x)/q(x) per drafted token.
+                p_at = jnp.take_along_axis(
+                    p_probs[:, :K, :], drafted[:, :, None], axis=-1
+                )[..., 0]
+                q_at = jnp.take_along_axis(q_probs, drafted[:, :, None], axis=-1)[..., 0]
+                u = jax.random.uniform(r_accept, (B, K))
+                ok = u * q_at < p_at
+            else:
+                ok = drafted == jnp.argmax(t_logits[:, :K, :], axis=-1)
+            accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1)  # still-accepted mask
+            a_raw = accepted.sum(axis=1)  # (B,) in [0, K]
+            # Finished rows must not throttle the shared commit count.
+            a_row = jnp.where(done, K, a_raw)
+            a = jnp.min(a_row)  # scalar commit length for this iteration
+
+            # --- the (a+1)-th token: accepted rows take their next draft
+            # (greedy: equals the target argmax; sampling: it passed the
+            # accept test), rejected-at-a rows draw from the residual
+            # max(0, p - q) (sampling) / take the target's token (greedy).
+            p_a = jnp.take_along_axis(
+                p_probs, jnp.broadcast_to(a, (B,))[:, None, None], axis=1
+            )[:, 0, :]  # (B, V) target dist at the first uncommitted slot
+            if config_.do_sample:
+                # Residual only exists where a draft was rejected (a < K);
+                # at a == K this is the plain bonus draw from p_K.
+                q_a = jnp.where(
+                    (a < K),
+                    jnp.take_along_axis(
+                        q_probs,
+                        jnp.broadcast_to(jnp.minimum(a, K - 1), (B,))[:, None, None],
+                        axis=1,
+                    )[:, 0, :],
+                    jnp.zeros_like(p_a),
+                )
+                resid = jnp.maximum(p_a - q_a, 0.0)
+                resid_sum = resid.sum(axis=-1, keepdims=True)
+                # Degenerate p<=q everywhere can't happen with exact math
+                # (both sum to 1) but guard the fp32 edge: fall back to p.
+                resid = jnp.where(resid_sum > 1e-9, resid / resid_sum, p_a)
+                fix = jax.random.categorical(
+                    r_fix, jnp.log(jnp.maximum(resid, 1e-38)), axis=-1
+                ).astype(jnp.int32)
+            else:
+                fix = jnp.argmax(
+                    jnp.take_along_axis(
+                        t_logits, jnp.broadcast_to(a, (B,))[:, None, None], axis=1
+                    )[:, 0, :],
+                    axis=-1,
+                ).astype(jnp.int32)
+            row_accepted_past_a = a_row > a
+            next_tok = jnp.where(
+                row_accepted_past_a,
+                jnp.take_along_axis(
+                    drafted, jnp.minimum(a, K - 1)[None].repeat(B)[:, None], axis=1
+                )[:, 0],
+                fix,
+            )
+
+            # --- commit buffer: [d_1..d_a, next_tok] in columns 0..a.
+            cols = jnp.arange(K + 1)
+            buf = jnp.concatenate([drafted, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            buf = jnp.where(cols[None, :] == a, next_tok[:, None], buf)
+            # EOS/pad discipline over the committed prefix.
+            if eos is not None:
+                committed_mask = cols[None, :] <= a
+                is_eos = (buf == eos) & committed_mask
+                seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
+                dead = done[:, None] | (seen > 0)
+                buf = jnp.where(dead & committed_mask, pad, buf)
+                done = done | (is_eos & ~dead).any(axis=1)
+                next_tok = buf[jnp.arange(B), jnp.broadcast_to(a, (B,))]
+
+            # --- roll both caches back to the committed length. The verify
+            # wrote K+1 entries; committed are the first a+1 (last + a
+            # drafts), with `next_tok` pending for the next iteration.
+            base = t_cache["length"] - (K + 1)
+            t_cache = dict(t_cache, length=base + 1 + a)
+            d_cache = dict(d_cache, length=base + 1 + a)
+            # Observability: PER-ROW acceptance (not the min-commit count —
+            # with large divergent batches the min is pessimistic while
+            # per-row acceptance is what a draft-model choice controls).
+            live = ~done
+            accept_frac = jnp.where(
+                live.any(),
+                (jnp.where(live, a_raw, 0).sum() / jnp.maximum(live.sum(), 1)) / K,
+                jnp.asarray(1.0),
+            )
+            return buf, a + 1, next_tok, accept_frac, t_cache, d_cache, rng, done
+
+        if jit_loop:
+            prefill = jax.jit(prefill, donate_argnums=(3, 4))
+            spec_step = jax.jit(spec_step, donate_argnums=(3, 4))
+        self._prefill = prefill
+        self._spec_step = spec_step
+        self.last_accept_rate = 0.0
+
+    def __call__(
+        self,
+        target_params: Any,
+        draft_params: Any,
+        prompt: jax.Array,
+        *,
+        rng: jax.Array | None = None,
+        max_new_tokens: int | None = None,
+        cache_len: int | None = None,
+    ) -> jax.Array:
+        """(B, S) int32 -> (B, S + max_new_tokens); EOS rows padded.
+
+        ``max_new_tokens`` overrides the config's per call. The jitted
+        steps specialize on CACHE SHAPE, which defaults to
+        ``S + budget + 2*(K+1)`` — so distinct budgets retrace unless
+        ``cache_len`` pins one capacity (any value >= the default bound)
+        across calls.
+
+        Also records ``self.last_accept_rate`` (mean drafted-token
+        acceptance over the call) for observability/benching."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        budget = (
+            max_new_tokens if max_new_tokens is not None else self.config.max_new_tokens
+        )
+        if budget <= 0:
+            return prompt
+        B, S = prompt.shape
+        K = self.draft_tokens
+        # Slack: optimistic dispatch (below) can overshoot the budget by at
+        # most one iteration's K+1 commits, plus the K+1-wide verify write
+        # region past the final committed position.
+        needed = S + budget + 2 * (K + 1)
+        max_len = cache_len if cache_len is not None else needed
+        if max_len < needed:
+            raise ValueError(
+                f"cache_len={max_len} is too small for prompt {S} + "
+                f"max_new_tokens {budget} with draft_tokens {K}; need >= {needed}."
+            )
+        t_cache = self.target_init_cache(B, max_len)
+        d_cache = self.draft_init_cache(B, max_len)
+        last, t_cache, d_cache, rng, done = self._prefill(
+            target_params, draft_params, prompt, t_cache, d_cache, rng
+        )
+        # The iteration chain lives on device; the host only needs commit
+        # COUNTS to know when to stop. A sync per iteration would serialize
+        # every step on the host<->device round trip (fatal over a remote
+        # tunnel, where one RTT dwarfs the verify itself), so dispatch
+        # iterations OPTIMISTICALLY in batches of ceil(remaining / (K+1)) —
+        # enough to finish if every draft is accepted — then read the whole
+        # batch's counts in one sync. Rejections just trigger another
+        # (smaller) batch; the token stream is identical either way.
+        first_tok = last
+        bufs: list[Any] = []  # device (B, K+1) commit buffers, in order
+        counts: list[int] = []
+        accepts: list[float] = []
+        got = 1
+        while got < budget:
+            m = -(-(budget - got) // (K + 1))
+            batch = []
+            for _ in range(m):
+                buf, n, last, accept_frac, t_cache, d_cache, rng, done = (
+                    self._spec_step(
+                        target_params, draft_params, last, t_cache, d_cache, rng, done
+                    )
+                )
+                bufs.append(buf)
+                batch.append((n, accept_frac))
+            ns, afs = jax.device_get(
+                (jnp.stack([b[0] for b in batch]), jnp.stack([b[1] for b in batch]))
+            )
+            counts.extend(int(v) for v in ns)
+            accepts.extend(float(v) for v in afs)
+            got = 1 + sum(counts)
+        # Assemble on host: one pipelined fetch of every commit buffer, then
+        # slice each to its committed width (trailing over-dispatched
+        # iterations may go entirely unused).
+        pieces = [jax.device_get(first_tok)[:, None]]
+        host_bufs = jax.device_get(bufs)
+        remaining = budget - 1
+        used = 0
+        for hb, n in zip(host_bufs, counts):
+            if remaining <= 0:
+                break
+            take = min(n, remaining)
+            pieces.append(hb[:, :take])
+            remaining -= take
+            used += 1
+        self.last_accept_rate = sum(accepts[:used]) / max(used, 1)
+        return jnp.concatenate([prompt] + [jnp.asarray(t) for t in pieces], axis=1)
+
+
+def generate_speculative(
+    target_params: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    *,
+    target_apply: ApplyFn,
+    target_init_cache: Callable[[int, int], Any],
+    draft_apply: ApplyFn,
+    draft_init_cache: Callable[[int, int], Any],
+    config: GenerationConfig | None = None,
+    draft_tokens: int = 4,
+    rng: jax.Array | None = None,
+    jit_loop: bool = True,
+) -> jax.Array:
+    """One-shot convenience over `SpeculativeGenerator` (rebuilds the jitted
+    steps per call — construct the generator once for repeated use)."""
+    gen = SpeculativeGenerator(
+        target_apply, target_init_cache, draft_apply, draft_init_cache,
+        config, draft_tokens=draft_tokens, jit_loop=jit_loop,
+    )
+    return gen(target_params, draft_params, prompt, rng=rng)

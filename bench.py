@@ -146,6 +146,7 @@ def main() -> None:
         extra_benches = [
             ("longctx", _bench_long_context),
             ("generate", lambda: _bench_generate(config)),
+            ("specdecode", lambda: _bench_specdecode(config)),
             ("fp8", _bench_fp8),
             ("llama2b", lambda: _bench_llama2b(fetch_latency)),
             ("hostoffload", lambda: _bench_hostoffload_adamw(fetch_latency)),
@@ -313,6 +314,88 @@ def _bench_generate(config) -> dict:
         "decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
         "decode_ms_per_token": round(1000 * decode_dt / n_tokens, 3),
     }
+
+
+def _bench_specdecode(config) -> dict:
+    """Speculative decoding at B=1 (the latency regime the reference's
+    big-model tables report, `benchmarks/big_model_inference/README.md`):
+    target = the headline decode model, draft = its first-2-layers prefix
+    (sharing embed/norm/head). Greedy, so the output is bit-identical to
+    vanilla decoding by construction (tests/test_speculative.py).
+
+    Reports the honestly-measured layer-prefix draft throughput + accept
+    rate, and the self-draft run (accept == 1 by construction) as the
+    mechanism ceiling — with random bench weights a 2-layer prefix is a
+    poor predictor, so the first number is a floor, not the story."""
+    import dataclasses
+
+    from accelerate_tpu.generation import GenerationConfig, Generator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.speculative import SpeculativeGenerator
+
+    tcfg = dataclasses.replace(config, remat=False, attention_impl="dot")
+    dcfg = dataclasses.replace(tcfg, n_layers=2)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16), llama.init(jax.random.PRNGKey(3), tcfg)
+    )
+    draft_params = dict(params, blocks=jax.tree.map(lambda x: x[:2], params["blocks"]))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (1, 128), 0, tcfg.vocab_size, jnp.int32
+    )
+    short, long = 16, 80
+    n_tokens = long - short
+
+    def t_pair(cfg):
+        return (
+            lambda p, t, c: llama.forward_with_cache(p, t, c, cfg),
+            lambda b, m: llama.init_cache(cfg, b, m),
+        )
+
+    ta, tc = t_pair(tcfg)
+    da, dc = t_pair(dcfg)
+
+    def run(gen, *args) -> float:
+        t0 = time.perf_counter()
+        out = gen(*args, prompt)
+        int(out[0, -1])
+        return time.perf_counter() - t0
+
+    out = {}
+    # Vanilla B=1 decode as the speedup denominator (the B=8 headline
+    # number amortizes per-step overhead differently).
+    van_s = Generator(ta, tc, GenerationConfig(max_new_tokens=short))
+    van_l = Generator(ta, tc, GenerationConfig(max_new_tokens=long))
+    run(van_s, params), run(van_l, params)  # compile
+    base_dt = max(
+        min(run(van_l, params) for _ in range(2))
+        - min(run(van_s, params) for _ in range(2)),
+        1e-9,
+    )
+    out["decode_b1_tokens_per_sec"] = round(n_tokens / base_dt, 1)
+    for label, dp in (("specdecode", draft_params), ("specdecode_selfdraft", None)):
+        d_apply, d_cache, d_params = (da, dc, dp) if dp is not None else (ta, tc, params)
+        spec = SpeculativeGenerator(
+            ta, tc, d_apply, d_cache, GenerationConfig(max_new_tokens=long), draft_tokens=4
+        )
+
+        cache_cap = prompt.shape[1] + long + 2 * (4 + 1)
+
+        def srun(n) -> float:
+            t0 = time.perf_counter()
+            o = spec(params, d_params, prompt, max_new_tokens=n, cache_len=cache_cap)
+            int(o[0, -1])
+            return time.perf_counter() - t0
+
+        srun(short), srun(long)  # compile prefill + spec_step once
+        dt = max(
+            min(srun(long) for _ in range(2)) - min(srun(short) for _ in range(2)),
+            1e-9,
+        )
+        out[f"{label}_tokens_per_sec"] = round(n_tokens / dt, 1)
+        out[f"{label}_speedup"] = round(base_dt / dt, 3)
+        if dp is not None:
+            out["specdecode_accept_rate"] = round(spec.last_accept_rate, 3)
+    return out
 
 
 def _bench_llama2b(fetch_latency: float) -> dict:
@@ -650,7 +733,7 @@ def _bench_bigmodel() -> dict:
     dt_long = min(run(long) for _ in range(2))
     decode_dt = max(dt_long - dt_short, 1e-9)
     n_tokens = long - short
-    return {
+    out = {
         "bigmodel_8b_params": loaded.config.param_count(),
         "bigmodel_8b_bits": 8,
         "bigmodel_8b_load_s": round(load_s, 1),
@@ -658,6 +741,88 @@ def _bench_bigmodel() -> dict:
         "io_read_mib_s": round(io_mib_s, 1),
         "bigmodel_8b_decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
         "bigmodel_8b_decode_ms_per_token": round(1000 * decode_dt / n_tokens, 2),
+    }
+    try:
+        out.update(_bench_bigmodel_specdecode(loaded, gen_config, prompt[:1]))
+    except Exception as e:  # never lose the headline load/decode numbers
+        out["bigmodel_spec_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
+def _bench_bigmodel_specdecode(loaded, gen_config, prompt) -> dict:
+    """Speculative decoding where it actually pays: 8B int8 single-row
+    decode is HBM-bandwidth-bound (every token streams all packed
+    weights), so a K+1-token verify costs barely more than one decode step.
+    Draft = the model's own first-2-layers prefix (zero extra load, shares
+    embed/norms/head — quantized leaves slice along the stacked layer axis
+    like any other). Greedy, so the stream equals vanilla decoding exactly;
+    with the synthetic repo's random weights the accept rate is a FLOOR —
+    report the self-consistency ceiling via implied tokens/iteration."""
+    import dataclasses
+
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.speculative import SpeculativeGenerator
+
+    K = 4
+    short, long = 8, 40
+    n_tokens = long - short
+    dcfg = dataclasses.replace(gen_config, n_layers=2)
+    draft_params = dict(
+        loaded.params,
+        blocks=jax.tree.map(lambda x: x[:2], loaded.params["blocks"]),
+    )
+
+    def pair(cfg):
+        return (
+            lambda p, t, c: llama.forward_with_cache(p, t, c, cfg),
+            lambda b, m: llama.init_cache(cfg, b, m),
+        )
+
+    ta, tc = pair(gen_config)
+    da, dc = pair(dcfg)
+
+    def vrun(n):
+        # llama.generate caches its Generator per (config, gen_config), so
+        # the short/long specializations compile once each.
+        t0 = time.perf_counter()
+        o = llama.generate(
+            loaded.params, prompt, gen_config,
+            generation_config=GenerationConfig(max_new_tokens=n),
+        )
+        int(o[0, -1])
+        return time.perf_counter() - t0
+
+    spec = SpeculativeGenerator(
+        ta, tc, da, dc, GenerationConfig(max_new_tokens=long), draft_tokens=K
+    )
+
+    # Pin one cache capacity so short/long share one compiled graph set.
+    spec_cache = prompt.shape[1] + long + 2 * (K + 1)
+
+    def srun(n):
+        t0 = time.perf_counter()
+        o = spec(
+            loaded.params, draft_params, prompt, max_new_tokens=n,
+            cache_len=spec_cache,
+        )
+        int(o[0, -1])
+        return time.perf_counter() - t0
+
+    # Warm EVERY measured specialization (vanilla caches size on
+    # prompt+max_new_tokens, so short and long are distinct compiles).
+    vrun(short), vrun(long), srun(short), srun(long)
+    base_dt = max(
+        min(vrun(long) for _ in range(2)) - min(vrun(short) for _ in range(2)), 1e-9
+    )
+    spec_dt = max(
+        min(srun(long) for _ in range(2)) - min(srun(short) for _ in range(2)), 1e-9
+    )
+    return {
+        "bigmodel_8b_b1_decode_tokens_per_sec": round(n_tokens / base_dt, 1),
+        "bigmodel_8b_specdecode_tokens_per_sec": round(n_tokens / spec_dt, 1),
+        "bigmodel_8b_specdecode_speedup": round(base_dt / spec_dt, 3),
+        "bigmodel_8b_specdecode_accept_rate": round(spec.last_accept_rate, 3),
     }
 
 
