@@ -10,6 +10,10 @@ from .dataclasses import (
     ShardingStrategyType,
     TensorParallelPlugin,
 )
+from .ds_config import (
+    accelerator_kwargs_from_deepspeed_config,
+    optax_from_deepspeed_config,
+)
 from .environment import (
     clear_environment,
     get_int_from_env,

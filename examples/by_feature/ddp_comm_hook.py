@@ -1,0 +1,107 @@
+"""Feature example: compressed gradient all-reduce (DDP comm-hook analog).
+
+Reference analog: `examples/by_feature/ddp_comm_hook.py` — there, DDP comm
+hooks (fp16/bf16 compress, PowerSGD) shrink the bytes the bucketed
+all-reduce moves over NCCL. Under GSPMD the gradient reduction is
+compiler-inserted, so the TPU version makes the reduction EXPLICIT: a
+`shard_map` over the data axis computes per-device gradients on the local
+batch shard, casts them to bf16 (half the ICI bytes — the fp16_compress
+hook's trade), `psum`s, and updates replicated params. The example trains
+the same model with fp32 and bf16 reductions and prints the parameter
+divergence: the compression noise is orders of magnitude below the
+gradient signal, which is why the reference ships the hook as a default-
+safe optimization.
+
+Run (8 simulated devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/by_feature/ddp_comm_hook.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import accelerate_tpu as atx
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+
+
+def train(comm_dtype, steps: int, lr: float) -> tuple[dict, dict]:
+    """Data-parallel training with an explicit, dtype-controlled gradient
+    all-reduce (the comm-hook seam DDP exposes in torch)."""
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = atx.Accelerator(seed=0)
+    mesh = acc.state.mesh
+    tx = optax.sgd(lr)
+    params = regression_init(jax.random.PRNGKey(0))
+    opt_state = tx.init(params)
+
+    from jax import shard_map
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P("data")),
+        out_specs=(P(), P(), P()),
+    )
+    def step(params, opt_state, batch):
+        grads = jax.grad(lambda p: regression_loss(p, batch))(params)
+        # THE HOOK: compress before the wire, reduce, decompress. bf16
+        # halves the bytes the data-axis all-reduce moves (fp16_compress /
+        # bf16_compress_hook semantics; mean-reduction like DDP's).
+        grads = jax.tree.map(lambda g: g.astype(comm_dtype), grads)
+        grads = jax.lax.pmean(grads, axis_name="data")
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, grads
+
+    ds = RegressionDataset(length=64, seed=5)
+    batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+    first_grads = None
+    for _ in range(steps):
+        params, opt_state, grads = step(params, opt_state, batch)
+        if first_grads is None:
+            first_grads = {k: float(np.asarray(v)) for k, v in grads.items()}
+    return {k: float(np.asarray(v)) for k, v in params.items()}, first_grads
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args(argv)
+
+    fp32, g32 = train(jnp.float32, args.steps, args.lr)
+    bf16, gbf = train(jnp.bfloat16, args.steps, args.lr)
+    grad_delta = max(abs(g32[k] - gbf[k]) for k in g32)
+    delta = max(abs(fp32[k] - bf16[k]) for k in fp32)
+    print(f"step-0 reduced grads fp32: {g32}")
+    print(f"step-0 reduced grads bf16: {gbf}  (compression is real: "
+          f"max grad delta {grad_delta:.2e})")
+    print(f"fp32-reduction params: {fp32}")
+    print(f"bf16-reduction params: {bf16}")
+    print(f"max param |delta| after {args.steps} steps: {delta:.2e} "
+          "(compression noise does not move the optimum)")
+    return delta
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Feature example: train from a DeepSpeed ds_config.json.
+
+Reference analog: `examples/by_feature/deepspeed_with_config_support.py` —
+there, the JSON configures the DeepSpeed engine; here,
+`utils.ds_config.accelerator_kwargs_from_deepspeed_config` maps the same
+file onto this framework's equivalents (ZeRO stage -> sharding strategy,
+offload_optimizer -> pinned-host moments, fp16/bf16 -> mixed precision,
+accumulation/clipping -> the same-named knobs) and
+`optax_from_deepspeed_config` builds the optimizer+schedule the JSON's
+optimizer/scheduler blocks describe. A team's existing ds_config drives
+the TPU run without re-derivation.
+
+Run: python examples/by_feature/deepspeed_with_config_support.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+
+import accelerate_tpu as atx
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils import RegressionDataset, regression_init, regression_loss
+from accelerate_tpu.utils import (
+    accelerator_kwargs_from_deepspeed_config,
+    optax_from_deepspeed_config,
+)
+
+# The shape of ds_config real runs ship: ZeRO-2 + cpu optimizer offload,
+# bf16, accumulation, clipping, AdamW + warmup-decay schedule.
+DS_CONFIG = {
+    "bf16": {"enabled": True},
+    "zero_optimization": {
+        "stage": 2,
+        "offload_optimizer": {"device": "cpu"},
+        "overlap_comm": True,  # engine knob: dropped with a warning on TPU
+        "contiguous_gradients": True,
+    },
+    "gradient_accumulation_steps": 2,
+    "gradient_clipping": 1.0,
+    "optimizer": {
+        "type": "AdamW",
+        "params": {"lr": 0.1, "betas": [0.9, 0.999], "eps": 1e-8, "weight_decay": 0.01},
+    },
+    "scheduler": {
+        "type": "WarmupDecayLR",
+        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.1, "warmup_num_steps": 5,
+                   "total_num_steps": "auto"},
+    },
+    "train_micro_batch_size_per_gpu": "auto",
+}
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--config", type=str, default=None,
+                        help="path to a ds_config.json (default: built-in sample)")
+    args = parser.parse_args(argv)
+
+    tmp_name = None
+    if args.config is None:
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
+        json.dump(DS_CONFIG, tmp)
+        tmp.close()
+        args.config = tmp_name = tmp.name
+
+    try:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        kwargs = accelerator_kwargs_from_deepspeed_config(args.config)
+        print(
+            f"ds_config -> Accelerator kwargs: { {k: str(v) for k, v in kwargs.items()} }"
+        )
+        acc = atx.Accelerator(seed=0, **kwargs)
+        # Same file drives the optimizer: with offload_optimizer.device=cpu
+        # this returns the offload-aware adamw the strategy requires.
+        optimizer = optax_from_deepspeed_config(args.config, total_num_steps=args.steps)
+
+        state = acc.create_train_state(regression_init, optimizer)
+        step = acc.make_train_step(regression_loss)
+        ds = RegressionDataset(length=64, seed=3)
+        batch = {"x": jnp.asarray(ds.x), "y": jnp.asarray(ds.y)}
+        loss = None
+        for _ in range(args.steps):
+            state, metrics = step(state, batch)
+            loss = float(np.asarray(metrics["loss"]))
+        print(f"final loss after {args.steps} steps: {loss:.5f}")
+        return loss
+    finally:
+        if tmp_name:
+            os.unlink(tmp_name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Feature example: Megatron-style GPT pretraining over a 3-D mesh.
+
+Reference analog: `examples/by_feature/megatron_lm_gpt_pretraining.py` —
+there, Megatron-LM supplies tensor/pipeline/data parallel GPT training.
+Here the same composition is a MESH SHAPE: ``data x fsdp x tensor`` axes
+plus the gpt family's registered TP plan (`parallel/tp.py`), and XLA
+inserts the collectives GSPMD-style. The training loop is IDENTICAL to
+the single-device one — the parallelism lives entirely in
+`MeshConfig` + `sharding_rules`.
+
+Run (8 simulated devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/by_feature/megatron_lm_gpt_pretraining.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+# Honor JAX_PLATFORMS even when a site hook latched another platform at
+# interpreter start (same contract as state.py / tests/conftest.py) —
+# this example queries jax.device_count() before Accelerator init.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.models import gpt
+from accelerate_tpu.parallel.tp import get_tp_plan
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def make_corpus(size: int, seq_len: int, vocab: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, vocab, (size, 1))
+    strides = rng.choice((1, 3, 7), (size, 1))
+    return ((starts + strides * np.arange(seq_len)) % vocab).astype(np.int32)
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--data", type=int, default=2, help="data-parallel axis size")
+    parser.add_argument("--fsdp", type=int, default=2, help="param-shard axis size")
+    parser.add_argument("--tensor", type=int, default=2, help="tensor-parallel axis size")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    args = parser.parse_args(argv)
+
+    need = args.data * args.fsdp * args.tensor
+    if jax.device_count() < need:
+        raise SystemExit(
+            f"need {need} devices (data*fsdp*tensor); run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need} "
+            "JAX_PLATFORMS=cpu for a simulated mesh."
+        )
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    accelerator = atx.Accelerator(
+        mesh_config=atx.MeshConfig(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
+        strategy="FSDP",
+        sharding_rules=get_tp_plan("gpt"),  # Megatron column/row splits
+        max_grad_norm=1.0,
+        seed=0,
+    )
+    config = gpt.GPTConfig(
+        vocab_size=128, d_model=128, n_layers=4, num_heads=4, d_ff=512,
+        max_seq_len=64,
+    )
+    corpus = make_corpus(512, 64, 128, seed=1)
+    loader = accelerator.prepare_data_loader(
+        atx.ArrayDataset({"input_ids": corpus}),
+        batch_size=args.batch_size, shuffle=True, seed=2,
+    )
+    tx = optax.adamw(optax.cosine_decay_schedule(args.lr, args.steps, alpha=0.1))
+    state = accelerator.create_train_state(lambda r: gpt.init(r, config), tx)
+    step = accelerator.make_train_step(lambda p, b, r: gpt.loss_fn(p, b, config, r))
+
+    # Params really land split over BOTH the fsdp and tensor axes.
+    wq = state.params["blocks"]["attn"]["wq"]
+    shard_shape = wq.addressable_shards[0].data.shape
+    accelerator.print(f"wq global {wq.shape} -> per-device shard {shard_shape}")
+    assert int(np.prod(shard_shape)) <= int(np.prod(wq.shape)) // (args.fsdp * args.tensor)
+
+    done, loss = 0, None
+    while done < args.steps:
+        for batch in loader:
+            state, metrics = step(state, batch)
+            done += 1
+            if done >= args.steps:
+                break
+    loss = float(np.asarray(metrics["loss"]))
+    accelerator.print(f"{args.data}x{args.fsdp}x{args.tensor} mesh: "
+                      f"loss {loss:.4f} after {done} steps")
+    return loss
+
+
+if __name__ == "__main__":
+    main()

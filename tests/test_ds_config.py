@@ -1,0 +1,212 @@
+"""DeepSpeed ds_config ingestion (`utils/ds_config.py`): mapping fidelity
+and loud refusal of capabilities with no training-time analog (reference
+`utils/deepspeed.py:119`, `examples/by_feature/deepspeed_with_config_support.py`)."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.parallel.sharding import ShardingStrategy, ShardingStrategyType
+from accelerate_tpu.utils import (
+    accelerator_kwargs_from_deepspeed_config,
+    optax_from_deepspeed_config,
+)
+
+
+def _kw(cfg):
+    return accelerator_kwargs_from_deepspeed_config(cfg)
+
+
+class TestStrategyMapping:
+    @pytest.mark.parametrize(
+        "stage,kind",
+        [
+            (1, ShardingStrategyType.ZERO1),
+            (2, ShardingStrategyType.ZERO2),
+            (3, ShardingStrategyType.FSDP),
+        ],
+    )
+    def test_zero_stages(self, stage, kind):
+        kw = _kw({"zero_optimization": {"stage": stage}})
+        assert isinstance(kw["strategy"], ShardingStrategy)
+        assert kw["strategy"].kind == kind
+        assert not kw["strategy"].offload_optimizer
+
+    def test_stage0_is_plain_dp(self):
+        assert "strategy" not in _kw({"zero_optimization": {"stage": 0}})
+        assert "strategy" not in _kw({})
+
+    def test_offload_optimizer_maps_to_host_offload(self):
+        kw = _kw({
+            "zero_optimization": {
+                "stage": 2, "offload_optimizer": {"device": "cpu"}
+            }
+        })
+        assert kw["strategy"].offload_optimizer
+
+    def test_param_offload_refused(self):
+        with pytest.raises(ValueError, match="offload_param"):
+            _kw({"zero_optimization": {"stage": 3,
+                                       "offload_param": {"device": "cpu"}}})
+
+    def test_nvme_refused(self):
+        with pytest.raises(ValueError, match="aio"):
+            _kw({"aio": {"block_size": 1048576}})
+
+    def test_unknown_zero_key_refused(self):
+        with pytest.raises(ValueError, match="mystery_knob"):
+            _kw({"zero_optimization": {"stage": 2, "mystery_knob": True}})
+
+    def test_engine_mechanics_dropped_with_warning(self):
+        with pytest.warns(UserWarning, match="overlap_comm"):
+            kw = _kw({
+                "zero_optimization": {"stage": 2, "overlap_comm": True,
+                                      "reduce_bucket_size": 5e8},
+                "train_micro_batch_size_per_gpu": "auto",
+            })
+        assert kw["strategy"].kind == ShardingStrategyType.ZERO2
+
+
+class TestPrecisionAndKnobs:
+    def test_fp16_bf16(self):
+        assert _kw({"fp16": {"enabled": True}})["mixed_precision"] == "fp16"
+        assert _kw({"bf16": {"enabled": True}})["mixed_precision"] == "bf16"
+        assert "mixed_precision" not in _kw({"fp16": {"enabled": False}})
+
+    def test_accumulation_and_clipping(self):
+        kw = _kw({"gradient_accumulation_steps": 4, "gradient_clipping": 0.5})
+        assert kw["gradient_accumulation_steps"] == 4
+        assert kw["max_grad_norm"] == 0.5
+
+    def test_auto_values_fall_back(self):
+        kw = _kw({"gradient_accumulation_steps": "auto",
+                  "zero_optimization": {"stage": "auto"}})
+        assert "gradient_accumulation_steps" not in kw
+        assert "strategy" not in kw
+
+    def test_path_input(self, tmp_path):
+        p = tmp_path / "ds.json"
+        json.dump({"bf16": {"enabled": True}}, open(p, "w"))
+        assert _kw(str(p))["mixed_precision"] == "bf16"
+
+
+class TestOptimizerMapping:
+    def test_adamw_with_warmup_decay(self):
+        import optax
+
+        tx = optax_from_deepspeed_config(
+            {
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-3, "betas": [0.9, 0.95],
+                                         "eps": 1e-8, "weight_decay": 0.1}},
+                "scheduler": {"type": "WarmupDecayLR",
+                              "params": {"warmup_num_steps": 10,
+                                         "warmup_max_lr": 1e-3,
+                                         "total_num_steps": 100}},
+            }
+        )
+        assert isinstance(tx, optax.GradientTransformation)
+        tx.init({"w": jnp.ones((2,))})  # structurally valid
+
+    def test_warmup_decay_auto_needs_total(self):
+        cfg = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "scheduler": {"type": "WarmupDecayLR",
+                          "params": {"warmup_num_steps": 5,
+                                     "total_num_steps": "auto"}},
+        }
+        with pytest.raises(ValueError, match="total_num_steps"):
+            optax_from_deepspeed_config(cfg)
+        optax_from_deepspeed_config(cfg, total_num_steps=200)  # filled like the reference
+
+    def test_unknown_types_refused(self):
+        with pytest.raises(ValueError, match="Lamb"):
+            optax_from_deepspeed_config({"optimizer": {"type": "Lamb"}})
+        with pytest.raises(ValueError, match="OneCycle"):
+            optax_from_deepspeed_config({
+                "optimizer": {"type": "AdamW"},
+                "scheduler": {"type": "OneCycle", "params": {}},
+            })
+
+    def test_no_optimizer_block_refused(self):
+        with pytest.raises(ValueError, match="no optimizer block"):
+            optax_from_deepspeed_config({})
+
+
+class TestReviewFindings:
+    def test_offload_config_returns_offload_aware_optimizer(self):
+        """The same ds_config that sets strategy.offload_optimizer must get
+        the streamable adamw — Accelerator refuses plain optax there."""
+        from accelerate_tpu.parallel.host_offload import HostOffloadedAdamW
+
+        cfg = {
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        }
+        tx = optax_from_deepspeed_config(cfg)
+        assert isinstance(tx, HostOffloadedAdamW)
+
+    def test_offload_with_sgd_refused(self):
+        cfg = {
+            "zero_optimization": {"stage": 1,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "optimizer": {"type": "SGD", "params": {"lr": 1e-2}},
+        }
+        with pytest.raises(ValueError, match="Adam/AdamW only"):
+            optax_from_deepspeed_config(cfg)
+
+    def test_warmup_decay_is_linear_to_zero(self):
+        """DeepSpeed WarmupDecayLR decays LINEARLY to 0 at total_num_steps;
+        a cosine or floored schedule would silently diverge from the GPU
+        run's trajectory."""
+        import numpy as np
+
+        cfg = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1.0}},
+            "scheduler": {"type": "WarmupDecayLR",
+                          "params": {"warmup_num_steps": 10,
+                                     "warmup_max_lr": 1.0,
+                                     "total_num_steps": 110}},
+        }
+        # Rebuild just the schedule through the public entry: inspect the
+        # learning rate the optimizer actually applies via inject stats —
+        # simplest is to re-derive from optax's injected hyperparams; here
+        # probe the schedule by building the same one the function does.
+        from accelerate_tpu.utils.ds_config import optax_from_deepspeed_config as f
+        tx = f(cfg)
+        # optax.adamw(schedule) hides the schedule; probe indirectly: one
+        # update at step counts around the breakpoints.
+        import jax.numpy as jnp
+        import optax
+
+        params = {"w": jnp.ones(())}
+        state = tx.init(params)
+        # advance to mid-decay (step 60): lr should be ~0.5 of max; at the
+        # end (110) ~0. Apply constant unit gradients and compare update
+        # magnitudes (adamw normalizes, so the update magnitude IS ~lr).
+        g = {"w": jnp.ones(())}
+        mags = {}
+        for step in range(110):
+            updates, state = tx.update(g, state, params)
+            if step in (59, 108):
+                mags[step] = abs(float(updates["w"]))
+        assert mags[59] == pytest.approx(0.5, rel=0.1)
+        assert mags[108] < 0.05
+
+    def test_warmup_decay_total_must_exceed_warmup(self):
+        cfg = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "scheduler": {"type": "WarmupDecayLR",
+                          "params": {"warmup_num_steps": 5,
+                                     "total_num_steps": 3}},
+        }
+        with pytest.raises(ValueError, match="total_num_steps"):
+            optax_from_deepspeed_config(cfg)
+
+    def test_unknown_top_level_section_refused(self):
+        with pytest.raises(ValueError, match="activation_checkpointing"):
+            _kw({"activation_checkpointing": {"partition_activations": True}})
+        with pytest.raises(ValueError, match="gradient_cliping"):
+            _kw({"gradient_cliping": 1.0})  # typo must not silently no-op

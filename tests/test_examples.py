@@ -126,6 +126,11 @@ def test_lm_example_learns_and_resumes(tmp_path):
         # whole-batch == accumulated on padded variable-length batches
         ("gradient_accumulation_for_autoregressive_models", ["--steps", "2"],
          lambda r: r < 1e-4),
+        # ds_config drives strategy/precision/optimizer; loss must actually
+        # come DOWN (untrained loss for this data/init is ~12.7)
+        ("deepspeed_with_config_support", ["--steps", "60"], lambda r: r < 1.0),
+        # bf16-compressed gradient all-reduce lands at the same optimum
+        ("ddp_comm_hook", ["--steps", "30"], lambda r: r < 1e-2),
     ],
 )
 def test_by_feature_examples(name, args, check):
@@ -157,3 +162,13 @@ def test_by_feature_finetune_from_hf():
     module = _load("by_feature/finetune_from_hf")
     drift = module.main(["--steps", "10"])
     assert drift < 1e-3
+
+
+def test_by_feature_megatron_style_mesh():
+    """3-D data x fsdp x tensor GPT pretraining (Megatron analog): loss
+    comes down and the in-example shard assertion (params split over BOTH
+    axes) holds."""
+    loss = _load("by_feature/megatron_lm_gpt_pretraining").main(
+        ["--steps", "20", "--batch_size", "8"]
+    )
+    assert loss < 4.9, loss
