@@ -23,6 +23,7 @@ Capability parity index (reference `accelerator.py` line refs):
 - accumulate/no_sync           :1116  -> `gradient_accumulation_steps` (scan)
 - backward                     :2357  -> inside the jitted step
 - clip_grad_norm_              :2485  -> `max_grad_norm` / clipping in-step
+- clip_grad_value_             :2523  -> `max_grad_value` elementwise clamp in-step
 - gather/gather_for_metrics    :2569/:2601 -> `gather` / `gather_for_metrics`
 - reduce/pad_across_processes  :2704/:2679 -> re-exported ops
 - unwrap_model                 :2745  -> `unwrap` (identity on pytrees)
@@ -174,6 +175,7 @@ class Accelerator:
         strategy: Any = None,
         sharding_rules: Sequence[tuple[str, PartitionSpec]] = (),
         max_grad_norm: float | None = None,
+        max_grad_value: float | None = None,
         dataloader_config: DataLoaderConfiguration | None = None,
         project_config: ProjectConfiguration | None = None,
         project_dir: str | None = None,
@@ -218,6 +220,7 @@ class Accelerator:
                 strategy = None  # the default; avoid requiring rules
         self.strategy = ShardingStrategy.resolve(strategy, rules=tuple(sharding_rules))
         self.max_grad_norm = max_grad_norm
+        self.max_grad_value = max_grad_value
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
         self.project_config = project_config or ProjectConfiguration(project_dir=project_dir)
         self.rng = _set_seed(seed) if seed is not None else jax.random.PRNGKey(0)
@@ -586,6 +589,7 @@ class Accelerator:
         accum = self.gradient_state.num_steps
         policy = self.policy
         max_grad_norm = self.max_grad_norm
+        max_grad_value = self.max_grad_value
         use_scaler = policy.compute_dtype == jnp.float16
         # Capture the planned specs NOW (create_train_state time), not at
         # trace time: a later create_train_state for a second model would
@@ -723,6 +727,13 @@ class Accelerator:
                 # below computes on clean numbers either way.
                 grads = jax.tree.map(
                     lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+                )
+            if max_grad_value is not None:
+                # clip_grad_value_ analog (reference accelerator.py:2523):
+                # elementwise clamp, applied BEFORE norm clipping like a
+                # torch loop calling both would.
+                grads = jax.tree.map(
+                    lambda g: jnp.clip(g, -max_grad_value, max_grad_value), grads
                 )
             grad_scale = None
             if max_grad_norm is not None:

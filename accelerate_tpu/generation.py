@@ -46,6 +46,21 @@ class GenerationConfig:
     top_p: float | None = None
     eos_token_id: int | None = None
     pad_token_id: int = 0
+    # KV cache storage: "bf16" (default), "fp32", or "int8" (per-token-scale
+    # quantized — half the cache bytes per decode step; llama family).
+    kv_cache_dtype: str = "bf16"
+
+
+def cache_dtype(config: "GenerationConfig"):
+    try:
+        return {"bf16": jnp.bfloat16, "fp32": jnp.float32, "int8": jnp.int8}[
+            config.kv_cache_dtype
+        ]
+    except KeyError:
+        raise ValueError(
+            f"kv_cache_dtype={config.kv_cache_dtype!r}; expected bf16, fp32, "
+            "or int8."
+        ) from None
 
 
 def warp_logits(logits: jax.Array, config: GenerationConfig) -> jax.Array:

@@ -348,6 +348,12 @@ def forward(
 def init_cache(
     config: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
 ) -> dict[str, jax.Array]:
+    if dtype == jnp.int8:
+        raise NotImplementedError(
+            "int8 KV caches are implemented for the llama family "
+            "(models/llama.py init_cache); the gpt cache path would "
+            "silently misread scale-free int8 values."
+        )
     shape = (config.n_layers, batch_size, max_len, config.num_heads, config.head_dim)
     return {
         "k": jnp.zeros(shape, dtype),
@@ -415,12 +421,14 @@ def forward_with_cache(
 
 @functools.lru_cache(maxsize=16)
 def _generator(config: GPTConfig, generation_config: Any, jit_loop: bool):
-    from ..generation import Generator
+    from ..generation import GenerationConfig, Generator, cache_dtype
 
+    gcfg = generation_config or GenerationConfig()
+    kv_dtype = cache_dtype(gcfg)  # int8 request fails loudly in init_cache
     return Generator(
         lambda p, t, c: forward_with_cache(p, t, c, config),
-        lambda b, m: init_cache(config, b, m),
-        generation_config,
+        lambda b, m: init_cache(config, b, m, dtype=kv_dtype),
+        gcfg,
         jit_loop=jit_loop,
     )
 

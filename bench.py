@@ -147,6 +147,7 @@ def main() -> None:
             ("longctx", _bench_long_context),
             ("generate", lambda: _bench_generate(config)),
             ("specdecode", lambda: _bench_specdecode(config)),
+            ("int8kv", lambda: _bench_int8_kv(config)),
             ("fp8", _bench_fp8),
             ("llama2b", lambda: _bench_llama2b(fetch_latency)),
             ("hostoffload", lambda: _bench_hostoffload_adamw(fetch_latency)),
@@ -314,6 +315,57 @@ def _bench_generate(config) -> dict:
         "decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
         "decode_ms_per_token": round(1000 * decode_dt / n_tokens, 3),
     }
+
+
+def _bench_int8_kv(config) -> dict:
+    """int8 KV cache at long context (beyond-reference: per-token-scale
+    quantized cache, `models/llama.py:init_cache`): at 16k context the
+    bf16 cache (~1.6 GiB) outweighs the 443M model's weights ~2:1, so
+    halving cache bytes moves the B=1 decode roofline directly. Prefill
+    runs in 2k chunks (the dot-attention score block stays bounded), then
+    a timed single-token decode loop."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+
+    S_ctx, chunk, decode_n = 16384, 2048, 48
+    gen_config = dataclasses.replace(
+        config, remat=False, attention_impl="dot", max_seq_len=S_ctx + 128
+    )
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16), llama.init(jax.random.PRNGKey(3), gen_config)
+    )
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (1, S_ctx), 0, gen_config.vocab_size, jnp.int32
+    )
+
+    # One jitted callable serves prefill chunks and 1-token decode: jit
+    # specializes per input shape anyway.
+    step_fn = jax.jit(
+        lambda p, t, c: llama.forward_with_cache(p, t, c, gen_config),
+        donate_argnums=(2,),
+    )
+    prefill = decode = step_fn
+
+    out = {}
+    rates = {}
+    for label, dt in (("bf16", jnp.bfloat16), ("int8", jnp.int8)):
+        cache = llama.init_cache(gen_config, 1, S_ctx + 128, dtype=dt)
+        for i in range(S_ctx // chunk):
+            logits, cache = prefill(params, prompt[:, i * chunk:(i + 1) * chunk], cache)
+        tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+        for _ in range(4):  # compile + warm
+            logits, cache = decode(params, tok, cache)
+        int(jnp.argmax(logits[0, -1]))  # sync
+        t0 = time.perf_counter()
+        for _ in range(decode_n):
+            logits, cache = decode(params, tok, cache)
+        int(jnp.argmax(logits[0, -1]))  # fetch barrier
+        dt_total = time.perf_counter() - t0
+        rates[label] = decode_n / dt_total
+        out[f"kv16k_decode_{label}_tokens_per_sec"] = round(rates[label], 1)
+    out["kv16k_int8_speedup"] = round(rates["int8"] / rates["bf16"], 3)
+    return out
 
 
 def _bench_specdecode(config) -> dict:

@@ -147,6 +147,24 @@ def test_grad_clipping():
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_grad_value_clipping():
+    """clip_grad_value_ analog (reference accelerator.py:2523): elementwise
+    clamp bounds every SGD update by lr * max_grad_value."""
+    acc = fresh_accelerator(max_grad_value=1e-8)
+    ds = RegressionDataset()
+    loader = acc.prepare_data_loader(ds, batch_size=2)
+    state = acc.create_train_state(init_params, optax.sgd(0.05), rng=jax.random.PRNGKey(5))
+    before = jax.tree.map(np.asarray, state.params)
+    step = acc.make_train_step(loss_fn)
+    for batch in loader:
+        state, _ = step(state, batch)
+        break
+    after = jax.tree.map(np.asarray, state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        # |update| <= lr * clip = 5e-10 per element
+        np.testing.assert_allclose(a, b, atol=1e-8)
+
+
 def test_zero1_strategy_shards_opt_state():
     from accelerate_tpu.parallel.sharding import ShardingStrategy
     from accelerate_tpu.utils.dataclasses import ShardingStrategyType

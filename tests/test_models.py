@@ -539,3 +539,73 @@ def test_gpt_chunked_loss_with_mask_matches():
     l1 = gpt.loss_fn(params, batch, config)
     l2 = gpt.loss_fn(params, batch, config_c)
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+class TestInt8KvCache:
+    """int8 KV cache (llama `init_cache(dtype=jnp.int8)`) and the dual
+    scan layout (xs/ys restack for short caches, in-place carry for long —
+    `forward_with_cache`): both must be numerically identical per dtype,
+    and int8 must stay within the per-token-scale quantization envelope."""
+
+    CFG = llama.LlamaConfig.tiny(vocab_size=97, max_seq_len=8192)
+
+    @pytest.fixture(scope="class")
+    def params(self):
+        return llama.init(jax.random.PRNGKey(0), self.CFG)
+
+    @pytest.mark.parametrize("cache_len", [64, 4096])  # xs/ys vs carry path
+    def test_fp32_cache_matches_forward_exactly(self, params, cache_len):
+        tok = jnp.asarray(np.arange(20, dtype=np.int32).reshape(2, 10) % 97)
+        want = np.asarray(llama.forward(params, tok, self.CFG))
+        cache = llama.init_cache(self.CFG, 2, cache_len, dtype=jnp.float32)
+        got, _ = llama.forward_with_cache(params, tok, cache, self.CFG)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("cache_len", [64, 4096])
+    def test_int8_cache_within_quantization_envelope(self, params, cache_len):
+        tok = jnp.asarray(np.arange(20, dtype=np.int32).reshape(2, 10) % 97)
+        want = np.asarray(llama.forward(params, tok, self.CFG))
+        cache = llama.init_cache(self.CFG, 2, cache_len, dtype=jnp.int8)
+        assert cache["k"].dtype == jnp.int8 and "k_scale" in cache
+        got, _ = llama.forward_with_cache(params, tok, cache, self.CFG)
+        drift = float(np.max(np.abs(np.asarray(got) - want)))
+        assert drift < 0.1, drift  # per-token-scale int8 envelope
+        assert drift > 0.0  # quantization actually happened
+
+    @pytest.mark.parametrize("cache_len", [64, 4096])
+    def test_int8_incremental_matches_oneshot(self, params, cache_len):
+        """Prefill-then-decode must quantize each token ONCE at its final
+        position: the int8 cache contents (values AND scales) are
+        bit-identical to one-shot prefill; logits agree to fp reduction
+        order (chunked attention sums in a different order)."""
+        tok = jnp.asarray(np.arange(20, dtype=np.int32).reshape(2, 10) % 97)
+        cache = llama.init_cache(self.CFG, 2, cache_len, dtype=jnp.int8)
+        one, c_one = llama.forward_with_cache(params, tok, cache, self.CFG)
+        cache = llama.init_cache(self.CFG, 2, cache_len, dtype=jnp.int8)
+        l1, cache = llama.forward_with_cache(params, tok[:, :6], cache, self.CFG)
+        l2, cache = llama.forward_with_cache(params, tok[:, 6:], cache, self.CFG)
+        for key in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(np.asarray(cache[key]), np.asarray(c_one[key]))
+        inc = np.concatenate([np.asarray(l1), np.asarray(l2)], axis=1)
+        np.testing.assert_allclose(inc, np.asarray(one), atol=1e-5, rtol=1e-5)
+
+    def test_generate_wires_kv_cache_dtype(self, params):
+        from accelerate_tpu.generation import GenerationConfig
+
+        tok = jnp.asarray(np.arange(10, dtype=np.int32).reshape(2, 5) % 97)
+        out = llama.generate(
+            params, tok, self.CFG,
+            generation_config=GenerationConfig(max_new_tokens=6, kv_cache_dtype="int8"),
+        )
+        assert out.shape == (2, 11)
+
+    def test_gpt_family_refuses_int8(self):
+        cfg = gpt.GPTConfig.tiny()
+        with pytest.raises(NotImplementedError, match="llama"):
+            gpt.init_cache(cfg, 1, 16, dtype=jnp.int8)
+
+    def test_unknown_kv_cache_dtype_rejected(self):
+        from accelerate_tpu.generation import GenerationConfig, cache_dtype
+
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            cache_dtype(GenerationConfig(kv_cache_dtype="fp8"))
