@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layers import (
+    CARRY_CACHE_MIN_LEN,
     AttentionSpec,
     activation_fn,
     apply_rope,
@@ -384,19 +385,15 @@ def forward_with_cache(
     else:
         cos, sin = _rope_tables(config)
 
-    def scan_body(carry, xs):
-        x = carry
-        block, k_cache, v_cache = xs
-        h1 = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
-        q, k, v = attention_qkv(block["attn"], h1)
-        if config.positional == "rotary":
-            q = _apply_rotary(q, cos, sin, positions, config)
-            k = _apply_rotary(k, cos, sin, positions, config)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
-        attn = dot_product_attention(
-            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
-        )
+    # Same dual cache layout as llama.forward_with_cache: long contexts
+    # carry the stacked cache through the scan (in-place, no per-step
+    # restack — measured 1.3x decode at 16k there); short ones keep xs/ys.
+    carry_cache = max_len >= CARRY_CACHE_MIN_LEN
+
+    def block_compute(block, x, k_full, v_full, q, h1, mask):
+        # h1 is project()'s pre-attention norm of the SAME x (the parallel-
+        # residual MLP branches off the block input, not the post-attn sum).
+        attn = dot_product_attention(q, k_full, v_full, mask=mask)
         attn_out = attention_out(block["attn"], attn)
         if config.parallel_residual:
             h2 = (
@@ -404,16 +401,59 @@ def forward_with_cache(
                 if config.shared_parallel_norm
                 else layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
             )
-            x = x + attn_out + _mlp(config, block["mlp"], h2)
-        else:
-            x = x + attn_out
-            h2 = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
-            x = x + _mlp(config, block["mlp"], h2)
-        return x, (k_cache, v_cache)
+            return x + attn_out + _mlp(config, block["mlp"], h2)
+        x = x + attn_out
+        h2 = layer_norm(x, block["ln2_scale"], block["ln2_bias"], config.norm_eps)
+        return x + _mlp(config, block["mlp"], h2)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_body, x, (params["blocks"], cache["k"], cache["v"])
-    )
+    def project(block, x):
+        h1 = layer_norm(x, block["ln1_scale"], block["ln1_bias"], config.norm_eps)
+        q, k, v = attention_qkv(block["attn"], h1)
+        if config.positional == "rotary":
+            q = _apply_rotary(q, cos, sin, positions, config)
+            k = _apply_rotary(k, cos, sin, positions, config)
+        return q, k, v, h1
+
+    if carry_cache:
+        def scan_body(carry, block):
+            x, k_all, v_all, i = carry
+            q, k, v, h1 = project(block, x)
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k.astype(k_all.dtype)[None], (i, 0, start, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v.astype(v_all.dtype)[None], (i, 0, start, 0, 0)
+            )
+            full = (1,) + k_all.shape[1:]
+            k_full = jax.lax.dynamic_slice(k_all, (i, 0, 0, 0, 0), full)[0].astype(x.dtype)
+            v_full = jax.lax.dynamic_slice(v_all, (i, 0, 0, 0, 0), full)[0].astype(x.dtype)
+            x = block_compute(block, x, k_full, v_full, q, h1, mask)
+            return (x, k_all, v_all, i + 1), None
+
+        (x, new_k, new_v, _), _ = jax.lax.scan(
+            scan_body,
+            (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+            params["blocks"],
+        )
+    else:
+        def scan_body(carry, xs):
+            x = carry
+            block, k_cache, v_cache = xs
+            q, k, v, h1 = project(block, x)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+            )
+            x = block_compute(
+                block, x, k_cache.astype(q.dtype), v_cache.astype(q.dtype), q, h1, mask
+            )
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"])
+        )
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], config.norm_eps)
     logits = _logits(params, x, config)
     return logits, {"k": new_k, "v": new_v, "length": start + T_new}

@@ -79,6 +79,13 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-
     return (x * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
 
 
+# Crossover where forward_with_cache switches the KV cache from scan xs/ys
+# (restacked every step — cheap while the cache is small) to an in-place scan
+# carry (no per-step restack; measured 1.3x decode at 16k ctx on one v5e).
+# Shared by every family's cache path so the layouts can't silently diverge.
+CARRY_CACHE_MIN_LEN = 4096
+
+
 # ---------------------------------------------------------------------- rope
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .layers import (
+    CARRY_CACHE_MIN_LEN,
     AttentionSpec,
     apply_rope,
     attention_out,
@@ -484,7 +485,7 @@ def forward_with_cache(
     # there and the carry's dynamic-slice read measured ~7% slower at
     # 2k/B=8). The threshold is static — the choice costs nothing at trace
     # time and both paths are numerically identical (tested).
-    carry_cache = max_len >= 4096
+    carry_cache = max_len >= CARRY_CACHE_MIN_LEN
 
     def attend(block, x, q, k_full, v_full):
         attn = dot_product_attention(q, k_full, v_full, mask=mask)
@@ -776,6 +777,13 @@ def forward_with_cache_offloaded(
     decodable — only one layer's weights are ever in flight (reference
     `disk_offload` + `OffloadedWeightsLoader`, `big_modeling.py:260`,
     `utils/offload.py:127`)."""
+    if cache["k"].dtype == jnp.int8:
+        raise NotImplementedError(
+            "int8 KV caches are not implemented for the offloaded decode "
+            "path (the streamed step would truncate float K/V into "
+            "scale-free int8 and read them back as garbage); use "
+            "forward_with_cache, or a bf16 cache here."
+        )
     from ..big_modeling import streamed_scan
 
     B, T_new = tokens.shape

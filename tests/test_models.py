@@ -609,3 +609,32 @@ class TestInt8KvCache:
 
         with pytest.raises(ValueError, match="kv_cache_dtype"):
             cache_dtype(GenerationConfig(kv_cache_dtype="fp8"))
+
+
+@pytest.mark.parametrize("cache_len", [32, 4096])  # xs/ys vs carry layout
+def test_gpt_cache_layouts_match_forward(cache_len):
+    """The gpt family's dual cache layout (same design as llama's) must be
+    numerically identical to the uncached forward on every block variant."""
+    cfg = gpt.GPTConfig.tiny(
+        max_seq_len=8192, positional="rotary", rotary_dim=8,
+        rotary_interleaved=True, parallel_residual=True,
+        shared_parallel_norm=True, attn_bias=False,
+        tie_embeddings=False, head_bias=True,
+    )
+    params = gpt.init(jax.random.PRNGKey(7), cfg)
+    tok = jnp.arange(24, dtype=jnp.int32).reshape(2, 12) % 256
+    want = np.asarray(gpt.forward(params, tok, cfg))
+    cache = gpt.init_cache(cfg, 2, cache_len, dtype=jnp.float32)
+    got, cache = gpt.forward_with_cache(params, tok, cache, cfg)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5, rtol=1e-5)
+
+
+def test_offloaded_decode_refuses_int8_cache():
+    """The streamed decode path has no dequant plumbing; it must refuse an
+    int8 cache rather than read scale-free garbage."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    cache = llama.init_cache(cfg, 1, 16, dtype=jnp.int8)
+    tok = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(NotImplementedError, match="offloaded"):
+        llama.forward_with_cache_offloaded(params, tok, cache, cfg)
