@@ -44,6 +44,9 @@ from .layers import (
     apply_rope_interleaved,
     attention_out,
     attention_qkv,
+    cache_positions,
+    cache_write,
+    cache_write_stacked,
     cross_entropy_loss,
     dot_product_attention,
     init_attention,
@@ -369,12 +372,14 @@ def forward_with_cache(
     cache: dict[str, jax.Array],
     config: GPTConfig,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
-    """Incremental forward (prefill or decode) against the KV cache."""
+    """Incremental forward (prefill or decode) against the KV cache.
+
+    ``cache['length']`` is a scalar or per-row (B,) cursor — same contract
+    as `llama.forward_with_cache` (per-row = speculative decoding)."""
     B, T_new = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["length"]
-    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (B, T_new))
+    positions = cache_positions(start, T_new, B)
     cache_pos = jnp.arange(max_len, dtype=jnp.int32)
     mask = cache_pos[None, None, :] <= positions[:, :, None]
 
@@ -418,16 +423,11 @@ def forward_with_cache(
         def scan_body(carry, block):
             x, k_all, v_all, i = carry
             q, k, v, h1 = project(block, x)
-            k_all = jax.lax.dynamic_update_slice(
-                k_all, k.astype(k_all.dtype)[None], (i, 0, start, 0, 0)
+            k_all, k_layer = cache_write_stacked(k_all, i, k, start)
+            v_all, v_layer = cache_write_stacked(v_all, i, v, start)
+            x = block_compute(
+                block, x, k_layer.astype(x.dtype), v_layer.astype(x.dtype), q, h1, mask
             )
-            v_all = jax.lax.dynamic_update_slice(
-                v_all, v.astype(v_all.dtype)[None], (i, 0, start, 0, 0)
-            )
-            full = (1,) + k_all.shape[1:]
-            k_full = jax.lax.dynamic_slice(k_all, (i, 0, 0, 0, 0), full)[0].astype(x.dtype)
-            v_full = jax.lax.dynamic_slice(v_all, (i, 0, 0, 0, 0), full)[0].astype(x.dtype)
-            x = block_compute(block, x, k_full, v_full, q, h1, mask)
             return (x, k_all, v_all, i + 1), None
 
         (x, new_k, new_v, _), _ = jax.lax.scan(
@@ -440,12 +440,8 @@ def forward_with_cache(
             x = carry
             block, k_cache, v_cache = xs
             q, k, v, h1 = project(block, x)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-            )
+            k_cache = cache_write(k_cache, k, start)
+            v_cache = cache_write(v_cache, v, start)
             x = block_compute(
                 block, x, k_cache.astype(q.dtype), v_cache.astype(q.dtype), q, h1, mask
             )

@@ -86,6 +86,59 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-
 CARRY_CACHE_MIN_LEN = 4096
 
 
+# ------------------------------------------------------------------ kv cache
+def cache_positions(start: jax.Array, t_new: int, batch: int) -> jax.Array:
+    """(B, T_new) logical positions for tokens appended at ``start``.
+
+    ``start`` is the cache length cursor: a scalar (every row appends at the
+    same offset — the plain decode contract) or shape (B,) (per-row offsets —
+    speculative decoding commits a different number of tokens per row, so
+    rows advance independently)."""
+    offs = jnp.arange(t_new, dtype=jnp.int32)[None, :]
+    pos = (start[:, None] if start.ndim == 1 else start) + offs
+    return jnp.broadcast_to(pos, (batch, t_new))
+
+
+def cache_write(buf: jax.Array, new: jax.Array, start: jax.Array) -> jax.Array:
+    """Write ``new`` (B, T, ...) into ``buf`` (B, S, ...) at offset ``start``
+    along the sequence dim.
+
+    Scalar ``start`` keeps the one-``dynamic_update_slice`` decode fast path;
+    a (B,) ``start`` vmaps the update over rows (per-row write offsets lower
+    to one scatter — the enabling primitive for per-row speculative commit
+    lengths)."""
+    new = new.astype(buf.dtype)
+    zeros = (0,) * (buf.ndim - 2)
+    if start.ndim == 0:
+        return jax.lax.dynamic_update_slice(buf, new, (0, start) + zeros)
+    return jax.vmap(
+        lambda b, n, s: jax.lax.dynamic_update_slice(b, n, (s,) + zeros)
+    )(buf, new, start)
+
+
+def cache_write_stacked(
+    all_buf: jax.Array, i: jax.Array, rows: jax.Array, start: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Write ``rows`` (B, T, ...) into layer ``i`` of a layer-stacked cache
+    buffer (L, B, S, ...) at offset ``start`` (scalar or (B,) — see
+    `cache_write`). Returns (updated stacked buffer, updated (B, S, ...)
+    layer) so carry-layout scan bodies can attend against the fresh layer
+    without re-slicing. Shared by every family's carry cache path."""
+    lead = (0,) * (all_buf.ndim - 1)
+    full = (1,) + all_buf.shape[1:]
+    if start.ndim == 1:
+        layer = jax.lax.dynamic_slice(all_buf, (i,) + lead, full)[0]
+        layer = cache_write(layer, rows, start)
+        all_buf = jax.lax.dynamic_update_slice(all_buf, layer[None], (i,) + lead)
+        return all_buf, layer
+    idx = (i, 0, start) + (0,) * (all_buf.ndim - 3)
+    all_buf = jax.lax.dynamic_update_slice(
+        all_buf, rows.astype(all_buf.dtype)[None], idx
+    )
+    layer = jax.lax.dynamic_slice(all_buf, (i,) + lead, full)[0]
+    return all_buf, layer
+
+
 # ---------------------------------------------------------------------- rope
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:

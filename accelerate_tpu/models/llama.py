@@ -35,6 +35,9 @@ from .layers import (
     apply_rope,
     attention_out,
     attention_qkv,
+    cache_positions,
+    cache_write,
+    cache_write_stacked,
     cross_entropy_loss,
     dot_product_attention,
     init_attention,
@@ -457,12 +460,16 @@ def forward_with_cache(
 
     Serves both prefill (T_new = prompt length) and decode (T_new = 1); the
     same jitted function handles either with static T_new.
+
+    ``cache['length']`` may be a scalar (all rows share one cursor — the
+    plain decode contract) or shape (B,) (per-row cursors: speculative
+    decoding commits a different number of tokens per row, `speculative.py`).
+    Positions, masks, and the KV writes are all per-row in the latter case.
     """
     B, T_new = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["length"]
-    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (B, T_new))
+    positions = cache_positions(start, T_new, B)
     cos, sin = _rope_tables(config)
 
     # (B, T_new, max_len) attention mask: cached positions < start+1+i.
@@ -502,6 +509,9 @@ def forward_with_cache(
         return q, k, v
 
     if carry_cache:
+        def _update_layer(all_buf, i, rows):
+            return cache_write_stacked(all_buf, i, rows, start)
+
         def scan_body(carry, block):
             if int8_kv:
                 x, k_all, v_all, ks_all, vs_all, i = carry
@@ -510,33 +520,21 @@ def forward_with_cache(
             block = _maybe_dequantize(block, x.dtype)
             q, k, v = project(block, x)
             q_dtype = x.dtype
-            full = (1,) + k_all.shape[1:]
             if int8_kv:
                 kq, ks = _quantize_kv(k)
                 vq, vs = _quantize_kv(v)
-                k_all = jax.lax.dynamic_update_slice(k_all, kq[None], (i, 0, start, 0, 0))
-                v_all = jax.lax.dynamic_update_slice(v_all, vq[None], (i, 0, start, 0, 0))
-                ks_all = jax.lax.dynamic_update_slice(ks_all, ks[None], (i, 0, start, 0))
-                vs_all = jax.lax.dynamic_update_slice(vs_all, vs[None], (i, 0, start, 0))
-                sfull = (1,) + ks_all.shape[1:]
+                k_all, k_layer = _update_layer(k_all, i, kq)
+                v_all, v_layer = _update_layer(v_all, i, vq)
+                ks_all, ks_layer = _update_layer(ks_all, i, ks)
+                vs_all, vs_layer = _update_layer(vs_all, i, vs)
                 # Dequant stays elementwise on the sliced layer: HBM reads int8.
-                k_full = _dequant_kv(
-                    jax.lax.dynamic_slice(k_all, (i, 0, 0, 0, 0), full)[0],
-                    jax.lax.dynamic_slice(ks_all, (i, 0, 0, 0), sfull)[0], q_dtype,
-                )
-                v_full = _dequant_kv(
-                    jax.lax.dynamic_slice(v_all, (i, 0, 0, 0, 0), full)[0],
-                    jax.lax.dynamic_slice(vs_all, (i, 0, 0, 0), sfull)[0], q_dtype,
-                )
+                k_full = _dequant_kv(k_layer, ks_layer, q_dtype)
+                v_full = _dequant_kv(v_layer, vs_layer, q_dtype)
             else:
-                k_all = jax.lax.dynamic_update_slice(
-                    k_all, k.astype(k_all.dtype)[None], (i, 0, start, 0, 0)
-                )
-                v_all = jax.lax.dynamic_update_slice(
-                    v_all, v.astype(v_all.dtype)[None], (i, 0, start, 0, 0)
-                )
-                k_full = jax.lax.dynamic_slice(k_all, (i, 0, 0, 0, 0), full)[0].astype(q_dtype)
-                v_full = jax.lax.dynamic_slice(v_all, (i, 0, 0, 0, 0), full)[0].astype(q_dtype)
+                k_all, k_layer = _update_layer(k_all, i, k)
+                v_all, v_layer = _update_layer(v_all, i, v)
+                k_full = k_layer.astype(q_dtype)
+                v_full = v_layer.astype(q_dtype)
             x = attend(block, x, q, k_full, v_full)
             if int8_kv:
                 return (x, k_all, v_all, ks_all, vs_all, i + 1), None
@@ -570,19 +568,15 @@ def forward_with_cache(
             if int8_kv:
                 kq, ks = _quantize_kv(k)
                 vq, vs = _quantize_kv(v)
-                k_cache = jax.lax.dynamic_update_slice(k_cache, kq, (0, start, 0, 0))
-                v_cache = jax.lax.dynamic_update_slice(v_cache, vq, (0, start, 0, 0))
-                k_sc = jax.lax.dynamic_update_slice(k_sc, ks, (0, start, 0))
-                v_sc = jax.lax.dynamic_update_slice(v_sc, vs, (0, start, 0))
+                k_cache = cache_write(k_cache, kq, start)
+                v_cache = cache_write(v_cache, vq, start)
+                k_sc = cache_write(k_sc, ks, start)
+                v_sc = cache_write(v_sc, vs, start)
                 k_full = _dequant_kv(k_cache, k_sc, q_dtype)
                 v_full = _dequant_kv(v_cache, v_sc, q_dtype)
             else:
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-                )
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-                )
+                k_cache = cache_write(k_cache, k, start)
+                v_cache = cache_write(v_cache, v, start)
                 k_full = k_cache.astype(q_dtype)
                 v_full = v_cache.astype(q_dtype)
             x = attend(block, x, q, k_full, v_full)

@@ -48,7 +48,14 @@ def list_tp_plans() -> list[str]:
 #   attn/wo (L, H, h, D)   — row-parallel (output proj reduces over heads)
 #   mlp/w_gate|w_up (L, D, F) — column-parallel
 #   mlp/w_down (L, F, D)   — row-parallel
-#   embed (V, D)           — vocab over tensor (ICI all-gather on lookup)
+#   embed (V, D)           — vocab over (tensor, fsdp): the Megatron parallel
+#     embedding (local masked gather + all-reduce). The gathered D dim must
+#     stay UNSHARDED: sharding it over fsdp hands the partitioner a
+#     (B,S,D)-activation layout (D over fsdp) that collides with the
+#     batch-over-(data,fsdp) activation constraint — two tilings of the same
+#     axis with permuted device orders, which GSPMD can only bridge by
+#     involuntary full rematerialization (replicate-then-slice) inside the
+#     train step.
 #   lm_head (D, V)         — vocab column-parallel
 register_tp_plan(
     "llama",
@@ -65,7 +72,7 @@ register_tp_plan(
         (r"blocks/moe/router$", P()),
         (r"blocks/moe/w_(gate|up)$", P(None, E, F, T)),
         (r"blocks/moe/w_down$", P(None, E, T, F)),
-        (r"^embed$", P(T, F)),
+        (r"^embed$", P((T, F), None)),
         (r"^lm_head$", P(F, T)),
         (r"norm", P()),
     ),
@@ -83,8 +90,11 @@ register_tp_plan(
         (r"blocks/mlp/w_in$", P(None, F, T)),
         (r"blocks/mlp/b_in$", P(None, T)),
         (r"blocks/mlp/w_out$", P(None, T, F)),
-        (r"^wte$", P(T, F)),
-        (r"^wpe$", P(None, F)),
+        # Gathered-table rows shard over (tensor, fsdp); the embedded D dim
+        # stays unsharded (see the llama plan note on involuntary SPMD
+        # rematerialization).
+        (r"^wte$", P((T, F), None)),
+        (r"^wpe$", P(F, None)),
         (r"^lm_head$", P(F, T)),
         (r"ln", P()),
     ),
@@ -100,7 +110,7 @@ register_tp_plan(
         (r"(encoder|decoder)/(self_|cross_)?attn/wo$", P(None, T, None, F)),
         (r"(encoder|decoder)/mlp/w_(gate|up)$", P(None, F, T)),
         (r"(encoder|decoder)/mlp/w_down$", P(None, T, F)),
-        (r"^embed$", P(T, F)),
+        (r"^embed$", P((T, F), None)),
         (r"^lm_head$", P(F, T)),
         (r"rel_bias|norm", P()),
     ),

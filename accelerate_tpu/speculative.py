@@ -22,12 +22,14 @@ past ``length`` are never attended (the mask is position-based), so
 rejecting draft tokens is just writing a smaller ``length`` back — no data
 movement.
 
-Batching: acceptance is per-row, but the caches share one scalar
-``length``, so an iteration commits the MINIMUM accepted count across
-rows; rows that accepted more simply re-propose those tokens next
-iteration (with fresh randomness — still a valid draw). Throughput
-degrades gracefully with batch divergence; the exactness guarantees are
-unaffected.
+Batching: acceptance AND commit are per-row. The caches carry per-row
+``length`` cursors (shape (B,) — the model cache contract supports both,
+`models/layers.py:cache_write`), so each row commits exactly its own
+accepted count every iteration: one unlucky row no longer throttles the
+batch to the minimum. Rows that hit EOS or their token budget freeze
+(commit 0, cursor pinned) while the rest keep going, and the host loop
+stops as soon as every row is frozen — no wasted target forwards after
+early termination.
 
 Guarantees (both tested):
 - greedy (``do_sample=False``): output is bit-identical to target-only
@@ -45,6 +47,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .generation import GenerationConfig, warp_logits
 
@@ -89,6 +92,7 @@ class SpeculativeGenerator:
         def prefill(pt, pd, prompt, t_cache, d_cache, rng):
             """Run the prompt through both models; sample the first token
             from the target (identical to non-speculative prefill)."""
+            B = prompt.shape[0]
             t_logits, t_cache = target_apply(pt, prompt, t_cache)
             _, d_cache = draft_apply(pd, prompt, d_cache)
             rng, sub = jax.random.split(rng)
@@ -98,17 +102,23 @@ class SpeculativeGenerator:
             done = (
                 first == eos
                 if eos is not None
-                else jnp.zeros((prompt.shape[0],), bool)
+                else jnp.zeros((B,), bool)
             )
             return first, t_cache, d_cache, rng, done
 
-        def spec_step(pt, pd, last, t_cache, d_cache, rng, done):
-            """One draft-K + verify iteration.
+        def spec_step(pt, pd, last, t_cache, d_cache, rng, done, committed, quota):
+            """One draft-K + verify iteration with PER-ROW commits.
 
-            Returns ``tokens`` (B, K+1) with the committed tokens in the
-            first ``n_commit`` columns (the host slices), updated caches
-            rolled back to the committed length, and the EOS state."""
+            Returns ``tokens`` (B, K+1) with row r's committed tokens in its
+            first ``n_row[r]`` columns (the host slices per row), caches
+            rolled back to each row's committed length, the EOS state, and
+            the per-row committed totals. Rows that are done (EOS) or have
+            reached ``quota`` committed tokens are FROZEN: they commit 0 and
+            their cache cursors stay put (bounding cache writes to
+            ``[len, len+K+1)`` regardless of how long the batch's slowest
+            row takes)."""
             B = last.shape[0]
+            frozen = done | (committed >= quota)
             rng, r_draft, r_accept, r_fix = jax.random.split(rng, 4)
 
             # --- draft phase: K+1 single-token steps under lax.scan. Only
@@ -151,27 +161,19 @@ class SpeculativeGenerator:
             else:
                 ok = drafted == jnp.argmax(t_logits[:, :K, :], axis=-1)
             accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1)  # still-accepted mask
-            a_raw = accepted.sum(axis=1)  # (B,) in [0, K]
-            # Finished rows must not throttle the shared commit count.
-            a_row = jnp.where(done, K, a_raw)
-            a = jnp.min(a_row)  # scalar commit length for this iteration
+            a_row = accepted.sum(axis=1)  # (B,) accepted drafts in [0, K]
 
-            # --- the (a+1)-th token: accepted rows take their next draft
-            # (greedy: equals the target argmax; sampling: it passed the
-            # accept test), rejected-at-a rows draw from the residual
-            # max(0, p - q) (sampling) / take the target's token (greedy).
-            p_a = jnp.take_along_axis(
-                p_probs, jnp.broadcast_to(a, (B,))[:, None, None], axis=1
-            )[:, 0, :]  # (B, V) target dist at the first uncommitted slot
+            # --- the (a+1)-th token, PER ROW: at a == K it's the bonus
+            # draw from the target's K-th distribution; at a < K the draft
+            # at slot a was rejected, so draw from the residual
+            # max(0, p - q) (sampling) / take the target's argmax (greedy).
+            a_idx = a_row[:, None, None]
+            p_a = jnp.take_along_axis(p_probs, a_idx, axis=1)[:, 0, :]  # (B, V)
             if config_.do_sample:
-                # Residual only exists where a draft was rejected (a < K);
-                # at a == K this is the plain bonus draw from p_K.
                 q_a = jnp.where(
-                    (a < K),
+                    (a_row < K)[:, None],
                     jnp.take_along_axis(
-                        q_probs,
-                        jnp.broadcast_to(jnp.minimum(a, K - 1), (B,))[:, None, None],
-                        axis=1,
+                        q_probs, jnp.minimum(a_row, K - 1)[:, None, None], axis=1
                     )[:, 0, :],
                     jnp.zeros_like(p_a),
                 )
@@ -180,55 +182,56 @@ class SpeculativeGenerator:
                 # Degenerate p<=q everywhere can't happen with exact math
                 # (both sum to 1) but guard the fp32 edge: fall back to p.
                 resid = jnp.where(resid_sum > 1e-9, resid / resid_sum, p_a)
-                fix = jax.random.categorical(
+                next_tok = jax.random.categorical(
                     r_fix, jnp.log(jnp.maximum(resid, 1e-38)), axis=-1
                 ).astype(jnp.int32)
             else:
-                fix = jnp.argmax(
-                    jnp.take_along_axis(
-                        t_logits, jnp.broadcast_to(a, (B,))[:, None, None], axis=1
-                    )[:, 0, :],
-                    axis=-1,
+                next_tok = jnp.argmax(
+                    jnp.take_along_axis(t_logits, a_idx, axis=1)[:, 0, :], axis=-1
                 ).astype(jnp.int32)
-            row_accepted_past_a = a_row > a
-            next_tok = jnp.where(
-                row_accepted_past_a,
-                jnp.take_along_axis(
-                    drafted, jnp.minimum(a, K - 1)[None].repeat(B)[:, None], axis=1
-                )[:, 0],
-                fix,
+
+            # --- per-row commit count: the a accepted drafts + next_tok,
+            # capped at the row's remaining quota; frozen rows commit 0.
+            n_row = jnp.where(
+                frozen, 0, jnp.minimum(a_row + 1, jnp.maximum(quota - committed, 0))
             )
 
-            # --- commit buffer: [d_1..d_a, next_tok] in columns 0..a.
-            cols = jnp.arange(K + 1)
+            # --- commit buffer: row r holds [d_1..d_a, next_tok] with
+            # next_tok in column a_row[r]; the host takes the first n_row[r].
+            cols = jnp.arange(K + 1)[None, :]
             buf = jnp.concatenate([drafted, jnp.zeros((B, 1), jnp.int32)], axis=1)
-            buf = jnp.where(cols[None, :] == a, next_tok[:, None], buf)
-            # EOS/pad discipline over the committed prefix.
+            buf = jnp.where(cols == a_row[:, None], next_tok[:, None], buf)
+            committed_mask = cols < n_row[:, None]
+            # EOS/pad discipline over each row's committed prefix.
             if eos is not None:
-                committed_mask = cols[None, :] <= a
                 is_eos = (buf == eos) & committed_mask
                 seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
                 dead = done[:, None] | (seen > 0)
                 buf = jnp.where(dead & committed_mask, pad, buf)
                 done = done | (is_eos & ~dead).any(axis=1)
-                next_tok = buf[jnp.arange(B), jnp.broadcast_to(a, (B,))]
 
-            # --- roll both caches back to the committed length. The verify
-            # wrote K+1 entries; committed are the first a+1 (last + a
-            # drafts), with `next_tok` pending for the next iteration.
+            # --- roll both caches back to each row's committed length. The
+            # verify wrote K+1 entries at the row's base; committed are the
+            # first n_row (last + n_row-1 drafts), with `next_tok` pending.
+            # Frozen rows stay at base (their writes land in [base, base+K+1)
+            # every iteration and are never read).
             base = t_cache["length"] - (K + 1)
-            t_cache = dict(t_cache, length=base + 1 + a)
-            d_cache = dict(d_cache, length=base + 1 + a)
-            # Observability: PER-ROW acceptance (not the min-commit count —
-            # with large divergent batches the min is pessimistic while
-            # per-row acceptance is what a draft-model choice controls).
-            live = ~done
+            t_cache = dict(t_cache, length=base + n_row)
+            d_cache = dict(d_cache, length=base + n_row)
+            committed = committed + n_row
+            # A row that just committed EOS (or exhausted its quota) is
+            # frozen from the next iteration on; keep its pending token
+            # stable so the draft input stays a valid id.
+            next_tok = jnp.where(done | (committed >= quota), last, next_tok)
+            # Observability: PER-ROW acceptance over live rows (what a
+            # draft-model choice controls).
+            live = ~frozen
             accept_frac = jnp.where(
                 live.any(),
-                (jnp.where(live, a_raw, 0).sum() / jnp.maximum(live.sum(), 1)) / K,
+                (jnp.where(live, a_row, 0).sum() / jnp.maximum(live.sum(), 1)) / K,
                 jnp.asarray(1.0),
             )
-            return buf, a + 1, next_tok, accept_frac, t_cache, d_cache, rng, done
+            return buf, n_row, next_tok, accept_frac, t_cache, d_cache, rng, done, committed
 
         if jit_loop:
             prefill = jax.jit(prefill, donate_argnums=(3, 4))
@@ -236,6 +239,11 @@ class SpeculativeGenerator:
         self._prefill = prefill
         self._spec_step = spec_step
         self.last_accept_rate = 0.0
+        # Iterations whose commits were actually consumed by the last call
+        # (excludes trailing over-dispatched ones) — the wall-clock driver
+        # for batched decoding: per-row commits make this track the SLOWEST
+        # row's own need instead of the min-commit count.
+        self.last_iterations = 0
 
     def __call__(
         self,
@@ -280,52 +288,78 @@ class SpeculativeGenerator:
         last, t_cache, d_cache, rng, done = self._prefill(
             target_params, draft_params, prompt, t_cache, d_cache, rng
         )
-        # The iteration chain lives on device; the host only needs commit
-        # COUNTS to know when to stop. A sync per iteration would serialize
-        # every step on the host<->device round trip (fatal over a remote
-        # tunnel, where one RTT dwarfs the verify itself), so dispatch
-        # iterations OPTIMISTICALLY in batches of ceil(remaining / (K+1)) —
-        # enough to finish if every draft is accepted — then read the whole
-        # batch's counts in one sync. Rejections just trigger another
-        # (smaller) batch; the token stream is identical either way.
+        # Switch to per-row length cursors AFTER prefill (the model cache
+        # contract accepts scalar or (B,)): prefill — the largest KV write
+        # of the whole call — keeps the scalar dynamic_update_slice fast
+        # path; from here on each row advances by its own commits.
+        t_cache = dict(t_cache, length=jnp.broadcast_to(t_cache["length"], (B,)))
+        d_cache = dict(d_cache, length=jnp.broadcast_to(d_cache["length"], (B,)))
+        # The iteration chain lives on device; the host only needs per-row
+        # commit COUNTS (and EOS flags) to know when to stop. A sync per
+        # iteration would serialize every step on the host<->device round
+        # trip (fatal over a remote tunnel, where one RTT dwarfs the verify
+        # itself), so dispatch iterations OPTIMISTICALLY in batches of
+        # ceil(remaining / (K+1)) — enough to finish the slowest live row if
+        # every draft is accepted — then read the whole batch's counts in
+        # one sync. Rejections just trigger another (smaller) batch; the
+        # token stream is identical either way. Rows that hit EOS or their
+        # budget freeze on device, and the loop ends as soon as no live row
+        # remains (no wasted target forwards after early termination).
+        quota = budget - 1  # per-row tokens still needed after `first_tok`
         first_tok = last
+        committed = jnp.zeros((B,), jnp.int32)
+        quota_dev = jnp.asarray(quota, jnp.int32)
         bufs: list[Any] = []  # device (B, K+1) commit buffers, in order
-        counts: list[int] = []
+        counts: list[Any] = []  # host (B,) per-iteration commit counts
         accepts: list[float] = []
-        got = 1
-        while got < budget:
-            m = -(-(budget - got) // (K + 1))
-            batch = []
+        totals = np.zeros((B,), np.int64)
+        done_h = np.asarray(jax.device_get(done))
+        while True:
+            live = ~done_h & (totals < quota)
+            if not live.any():
+                break
+            m = -(-int(quota - totals[live].min()) // (K + 1))
+            batch_n, batch_af = [], []
             for _ in range(m):
-                buf, n, last, accept_frac, t_cache, d_cache, rng, done = (
+                buf, n, last, accept_frac, t_cache, d_cache, rng, done, committed = (
                     self._spec_step(
-                        target_params, draft_params, last, t_cache, d_cache, rng, done
+                        target_params, draft_params, last, t_cache, d_cache, rng,
+                        done, committed, quota_dev,
                     )
                 )
                 bufs.append(buf)
-                batch.append((n, accept_frac))
-            ns, afs = jax.device_get(
-                (jnp.stack([b[0] for b in batch]), jnp.stack([b[1] for b in batch]))
+                batch_n.append(n)
+                batch_af.append(accept_frac)
+            ns, afs, done_h = jax.device_get(
+                (jnp.stack(batch_n), jnp.stack(batch_af), done)
             )
-            counts.extend(int(v) for v in ns)
+            counts.extend(np.asarray(row) for row in ns)
             accepts.extend(float(v) for v in afs)
-            got = 1 + sum(counts)
+            totals += np.asarray(ns).sum(axis=0)
+            done_h = np.asarray(done_h)
         # Assemble on host: one pipelined fetch of every commit buffer, then
-        # slice each to its committed width (trailing over-dispatched
-        # iterations may go entirely unused).
-        pieces = [jax.device_get(first_tok)[:, None]]
-        host_bufs = jax.device_get(bufs)
-        remaining = budget - 1
+        # per-row placement at each row's running offset. Rows frozen by EOS
+        # underfill their budget; the remainder stays pad (matching the
+        # vanilla generator's pad discipline).
+        out = np.full((B, quota), self.config.pad_token_id, np.int32)
+        pos = np.zeros((B,), np.int64)
         used = 0
+        host_bufs = jax.device_get(bufs)
         for hb, n in zip(host_bufs, counts):
-            if remaining <= 0:
+            if (pos >= np.minimum(totals, quota)).all():
                 break
-            take = min(n, remaining)
-            pieces.append(hb[:, :take])
-            remaining -= take
+            for r in range(B):
+                take = int(min(n[r], quota - pos[r]))
+                if take > 0:
+                    out[r, pos[r] : pos[r] + take] = hb[r, :take]
+                    pos[r] += take
             used += 1
         self.last_accept_rate = sum(accepts[:used]) / max(used, 1)
-        return jnp.concatenate([prompt] + [jnp.asarray(t) for t in pieces], axis=1)
+        self.last_iterations = used
+        first_h = np.asarray(jax.device_get(first_tok))[:, None].astype(np.int32)
+        return jnp.concatenate(
+            [prompt, jnp.asarray(first_h), jnp.asarray(out)], axis=1
+        )
 
 
 def generate_speculative(

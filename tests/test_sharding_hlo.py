@@ -182,3 +182,61 @@ class TestCompileStability:
         for _ in range(3):
             state, _ = step(state, batch)
         assert step._cache_size() == 1
+
+
+class TestSpmdWarningClean:
+    """The dryrun's phases must compile without involuntary SPMD resharding.
+
+    Round-4 verdict: `MULTICHIP_r04.json` passed with repeated "[SPMD]
+    Involuntary full rematerialization" warnings — the embed table's D dim
+    was sharded over fsdp, colliding with the batch-over-(data,fsdp)
+    activation constraint (fixed in `parallel/tp.py`; the plans now shard
+    table ROWS over (tensor, fsdp)). These tests compile the same steps
+    under fd-2 capture so the regression can never pass silently again;
+    `__graft_entry__.dryrun_multichip` applies the same guard at driver time.
+    """
+
+    def _compile_llama_step(self, mesh_config, **config_overrides):
+        from __graft_entry__ import _fail_on_spmd_warnings
+        from accelerate_tpu.models import llama
+
+        config = llama.LlamaConfig.tiny(**config_overrides)
+        with _fail_on_spmd_warnings():
+            acc = Accelerator(
+                seed=0,
+                strategy="HYBRID",
+                mesh_config=mesh_config,
+                sharding_rules=get_tp_plan("llama"),
+                mixed_precision="bf16",
+            )
+            state = acc.create_train_state(
+                lambda r: llama.init(r, config), optax.adamw(1e-3)
+            )
+            step = acc.make_train_step(
+                lambda p, b, r: llama.loss_fn(p, b, config, r)
+            )
+            batch = {"input_ids": jnp.zeros((8, 32), jnp.int32)}
+            step.lower(state, batch).compile()
+
+    def test_hybrid_3d_step_compiles_warning_free(self):
+        self._compile_llama_step(MeshConfig(data=2, fsdp=2, tensor=2))
+
+    def test_sequence_expert_step_compiles_warning_free(self):
+        self._compile_llama_step(
+            MeshConfig(data=2, sequence=2, expert=2),
+            n_experts=2,
+            attention_impl="ring",
+        )
+
+    def test_capture_detects_planted_warning(self):
+        import os as _os
+
+        from __graft_entry__ import _fail_on_spmd_warnings
+
+        with pytest.raises(RuntimeError, match="SPMD partitioner warning"):
+            with _fail_on_spmd_warnings():
+                _os.write(
+                    2,
+                    b"W0000 00:00:00.0 0 spmd_partitioner.cc:652] [SPMD] "
+                    b"Involuntary full rematerialization. (planted)\n",
+                )
