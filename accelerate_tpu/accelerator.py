@@ -176,6 +176,7 @@ class Accelerator:
         sharding_rules: Sequence[tuple[str, PartitionSpec]] = (),
         max_grad_norm: float | None = None,
         max_grad_value: float | None = None,
+        loss_scale_config: dict[str, Any] | None = None,
         dataloader_config: DataLoaderConfiguration | None = None,
         project_config: ProjectConfiguration | None = None,
         project_dir: str | None = None,
@@ -221,6 +222,7 @@ class Accelerator:
         self.strategy = ShardingStrategy.resolve(strategy, rules=tuple(sharding_rules))
         self.max_grad_norm = max_grad_norm
         self.max_grad_value = max_grad_value
+        self._loss_scale_config = dict(loss_scale_config or {})
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
         self.project_config = project_config or ProjectConfiguration(project_dir=project_dir)
         self.rng = _set_seed(seed) if seed is not None else jax.random.PRNGKey(0)
@@ -404,10 +406,14 @@ class Accelerator:
 
     def _maybe_loss_scale(self) -> DynamicLossScale | None:
         """fp16 compute requires a dynamic loss scaler (fp16's 5-bit exponent
-        underflows real gradients); bf16/fp32 need none."""
+        underflows real gradients); bf16/fp32 need none. ``loss_scale_config``
+        (init_scale / growth_factor / backoff_factor / growth_interval)
+        overrides the GradScaler-equivalent defaults — e.g. a ds_config's
+        fp16 block maps onto it (`utils/ds_config.py`)."""
         if self.policy.compute_dtype == jnp.float16:
             return jax.device_put(
-                DynamicLossScale.create(), NamedSharding(self.mesh, PartitionSpec())
+                DynamicLossScale.create(**self._loss_scale_config),
+                NamedSharding(self.mesh, PartitionSpec()),
             )
         return None
 

@@ -737,12 +737,8 @@ def _offloaded_cache_step(config: LlamaConfig):
         q, k, v = attention_qkv(block["attn"], h)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-        )
+        k_cache = cache_write(k_cache, k, start)
+        v_cache = cache_write(v_cache, v, start)
         attn = dot_product_attention(
             q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask=mask
         )
@@ -782,8 +778,7 @@ def forward_with_cache_offloaded(
 
     B, T_new = tokens.shape
     start = cache["length"]
-    positions = start + jnp.arange(T_new, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (B, T_new))
+    positions = cache_positions(start, T_new, B)
     cos, sin = _rope_tables(config)
     max_len = cache["k"].shape[2]
     cache_pos = jnp.arange(max_len, dtype=jnp.int32)

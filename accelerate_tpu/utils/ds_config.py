@@ -12,7 +12,9 @@ equivalents instead of asking them to re-derive the run configuration:
 - ``zero_optimization.offload_optimizer.device: cpu`` -> the pinned-host
   optimizer offload (`parallel/host_offload.py`, the ZeRO-Offload analog);
 - ``fp16`` / ``bf16`` -> ``mixed_precision`` (fp16 keeps dynamic loss
-  scaling semantics — the reference's GradScaler/DeepSpeed scaler path);
+  scaling semantics — the reference's GradScaler/DeepSpeed scaler path —
+  and ``loss_scale``/``initial_scale_power``/``loss_scale_window`` map
+  onto `DynamicLossScale` via ``loss_scale_config``);
 - ``gradient_accumulation_steps`` / ``gradient_clipping`` -> the same-named
   Accelerator knobs;
 - ``optimizer`` / ``scheduler`` blocks -> an optax chain
@@ -104,6 +106,56 @@ def _auto(value: Any, default: Any) -> Any:
     return default if value == "auto" else value
 
 
+def _check_params_block(
+    block: str, leftover: dict, *, ignored: tuple[str, ...] = ()
+) -> None:
+    """Apply the module's warn/refuse policy to a sub-block's REMAINING keys
+    (callers pop what they consume first): known-no-analog keys are dropped
+    with one warning, anything else raises — a typo'd scheduler param
+    silently changing the LR trajectory is exactly the divergence this
+    module exists to prevent."""
+    dropped = sorted(k for k in leftover if k in ignored)
+    if dropped:
+        warnings.warn(
+            f"ds_config {block} keys with no TPU analog were dropped: {dropped}",
+            stacklevel=3,
+        )
+    unknown = sorted(k for k in leftover if k not in ignored)
+    if unknown:
+        raise ValueError(
+            f"Unrecognized ds_config {block} keys {unknown}; refusing to "
+            "silently drop configuration that may change training semantics."
+        )
+
+
+def _warmup_schedule(min_lr: float, max_lr: float, warmup: int, warmup_type: str):
+    """DeepSpeed's WarmupLR ramp. Default warmup_type is 'log'
+    (deepspeed lr_schedules.WARMUP_LOG_RATE): gamma(t) = log(1+t)/log(W)
+    for t < W, then 1 — NOT linear; translating it as linear silently gives
+    a different LR trajectory than the team's GPU run."""
+    import math
+
+    import optax
+
+    if warmup_type not in ("log", "linear"):
+        raise ValueError(
+            f"ds scheduler warmup_type={warmup_type!r} is not a DeepSpeed "
+            "warmup type; expected 'log' (default) or 'linear'."
+        )
+    if warmup_type == "linear" or warmup <= 1:
+        return optax.schedules.linear_schedule(min_lr, max_lr, max(warmup, 1))
+    inv = 1.0 / math.log(warmup)
+
+    def sched(count):
+        import jax.numpy as jnp
+
+        t = jnp.minimum(jnp.asarray(count, jnp.float32), float(warmup - 1))
+        gamma = jnp.minimum(jnp.log1p(t) * inv, 1.0)
+        return min_lr + (max_lr - min_lr) * gamma
+
+    return sched
+
+
 def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
     """ds_config (path or dict) -> keyword arguments for `Accelerator`.
 
@@ -153,9 +205,40 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
     if kind != ShardingStrategyType.DATA_PARALLEL or offload:
         kwargs["strategy"] = ShardingStrategy(kind=kind, offload_optimizer=offload)
 
-    if _auto(cfg.get("fp16", {}).get("enabled", False), False):
+    fp16 = dict(cfg.get("fp16", {}))
+    fp16_enabled = _auto(fp16.pop("enabled", False), False)
+    # DeepSpeed fp16 loss-scaling knobs map onto DynamicLossScale (the
+    # GradScaler analog): loss_scale=0 means dynamic, >0 pins a static
+    # scale (growth/backoff disabled); initial_scale_power and
+    # loss_scale_window carry their DeepSpeed meanings.
+    ls_cfg: dict[str, Any] = {}
+    static_scale = float(_auto(fp16.pop("loss_scale", 0), 0))
+    power = fp16.pop("initial_scale_power", None)
+    window = fp16.pop("loss_scale_window", None)
+    if static_scale:
+        ls_cfg = {
+            "init_scale": static_scale,
+            "growth_factor": 1.0,
+            "backoff_factor": 1.0,
+        }
+    else:
+        if power is not None:
+            ls_cfg["init_scale"] = 2.0 ** int(_auto(power, 16))
+        if window is not None:
+            ls_cfg["growth_interval"] = int(_auto(window, 1000))
+    _check_params_block(
+        "fp16",
+        fp16,
+        ignored=("hysteresis", "consecutive_hysteresis", "min_loss_scale", "auto_cast"),
+    )
+    bf16 = dict(cfg.get("bf16", {}))
+    bf16_enabled = _auto(bf16.pop("enabled", False), False)
+    _check_params_block("bf16", bf16, ignored=("immediate_grad_update",))
+    if fp16_enabled:
         kwargs["mixed_precision"] = "fp16"
-    elif _auto(cfg.get("bf16", {}).get("enabled", False), False):
+        if ls_cfg:
+            kwargs["loss_scale_config"] = ls_cfg
+    elif bf16_enabled:
         kwargs["mixed_precision"] = "bf16"
 
     accum = _auto(cfg.get("gradient_accumulation_steps", 1), 1)
@@ -214,25 +297,33 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
         )
     name = opt_block.get("type", "AdamW")
     p = {k.lower(): v for k, v in dict(opt_block.get("params", {})).items()}
-    lr = float(_auto(p.get("lr", 1e-3), 1e-3))
-    betas = p.get("betas", (0.9, 0.999))
-    b1, b2 = (0.9, 0.999) if betas == "auto" else tuple(float(b) for b in betas)
-    eps = float(_auto(p.get("eps", 1e-8), 1e-8))
-    wd = float(_auto(p.get("weight_decay", 0.0), 0.0))
+    lr = float(_auto(p.pop("lr", 1e-3), 1e-3))
+    # Remaining params are consumed PER OPTIMIZER below, so e.g. `momentum`
+    # on AdamW (torch would reject it) or `betas` on SGD hit the same
+    # warn/refuse policy instead of being silently eaten.
 
     sched_block = cfg.get("scheduler")
     schedule = lr
     if sched_block is not None:
         sname = sched_block.get("type")
         sp = dict(sched_block.get("params", {}))
-        warmup = int(_auto(sp.get("warmup_num_steps", 0), 0))
-        max_lr = float(_auto(sp.get("warmup_max_lr", lr), lr))
-        min_lr = float(_auto(sp.get("warmup_min_lr", 0.0), 0.0))
+        warmup = int(_auto(sp.pop("warmup_num_steps", 0), 0))
+        max_lr = float(_auto(sp.pop("warmup_max_lr", lr), lr))
+        min_lr = float(_auto(sp.pop("warmup_min_lr", 0.0), 0.0))
+        # DeepSpeed's default warmup ramp is LOG, not linear.
+        warmup_type = str(_auto(sp.pop("warmup_type", "log"), "log"))
         if sname == "WarmupLR":
-            # DeepSpeed WarmupLR: linear min->max, then CONSTANT at max.
-            schedule = optax.schedules.linear_schedule(min_lr, max_lr, max(warmup, 1))
+            _check_params_block(
+                "scheduler.params", sp, ignored=("last_batch_iteration",)
+            )
+            # DeepSpeed WarmupLR: min->max over warmup (log by default),
+            # then CONSTANT at max.
+            schedule = _warmup_schedule(min_lr, max_lr, warmup, warmup_type)
         elif sname == "WarmupDecayLR":
-            total = _auto(sp.get("total_num_steps", total_num_steps), total_num_steps)
+            total = _auto(sp.pop("total_num_steps", total_num_steps), total_num_steps)
+            _check_params_block(
+                "scheduler.params", sp, ignored=("last_batch_iteration",)
+            )
             if total is None:
                 raise ValueError(
                     "WarmupDecayLR.total_num_steps is 'auto'/absent: pass "
@@ -245,13 +336,13 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
                     f"WarmupDecayLR needs total_num_steps ({total}) > "
                     f"warmup_num_steps ({warmup})."
                 )
-            # DeepSpeed WarmupDecayLR: linear min->max over warmup, then
+            # DeepSpeed WarmupDecayLR: warmup ramp (log by default), then
             # LINEAR max->0 at total_num_steps (NOT cosine — the schedule
             # must match or the loss trajectory silently diverges from the
             # team's GPU run).
             schedule = optax.schedules.join_schedules(
                 [
-                    optax.schedules.linear_schedule(min_lr, max_lr, max(warmup, 1)),
+                    _warmup_schedule(min_lr, max_lr, warmup, warmup_type),
                     optax.schedules.linear_schedule(max_lr, 0.0, total - warmup),
                 ],
                 boundaries=[max(warmup, 1)],
@@ -272,7 +363,14 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
 
     lname = name.lower()
     if lname in ("adam", "adamw"):
-        decoupled = lname == "adamw" or p.get("adam_w_mode", True) or wd == 0.0
+        betas = p.pop("betas", (0.9, 0.999))
+        b1, b2 = (0.9, 0.999) if betas == "auto" else tuple(float(b) for b in betas)
+        eps = float(_auto(p.pop("eps", 1e-8), 1e-8))
+        wd = float(_auto(p.pop("weight_decay", 0.0), 0.0))
+        adam_w_mode = p.pop("adam_w_mode", True)
+        # torch_adam/fused pick a kernel, not semantics, on the reference side.
+        _check_params_block("optimizer.params", p, ignored=("torch_adam", "fused"))
+        decoupled = lname == "adamw" or adam_w_mode or wd == 0.0
         if not decoupled:
             # DeepSpeed plain Adam applies weight decay as L2-in-loss;
             # nothing here reproduces that silently.
@@ -295,7 +393,16 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
             f"offload_optimizer is implemented for Adam/AdamW only, not {name!r}."
         )
     if lname == "sgd":
-        return optax.sgd(schedule, momentum=float(_auto(p.get("momentum", 0.0), 0.0)))
+        momentum = float(_auto(p.pop("momentum", 0.0), 0.0))
+        wd = float(_auto(p.pop("weight_decay", 0.0), 0.0))
+        _check_params_block("optimizer.params", p)
+        opt = optax.sgd(schedule, momentum=momentum)
+        if wd:
+            # torch SGD weight decay is coupled L2 (added to the gradient
+            # BEFORE momentum) — add_decayed_weights ahead of the update
+            # reproduces it exactly.
+            return optax.chain(optax.add_decayed_weights(wd), opt)
+        return opt
     raise ValueError(
         f"Unimplemented ds optimizer type {name!r}; implemented: AdamW, "
         "Adam, SGD."

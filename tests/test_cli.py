@@ -76,6 +76,74 @@ class TestLaunch:
         assert "--worker=all" in out
         assert "ATX_MULTIHOST=1" in out
 
+    def _fake_gcloud(self, tmp_path, exit_code=0):
+        """PATH-shim gcloud that logs each invocation's argv as a JSON line
+        (VERDICT r4 #5: the pod SSH path must be tested, not just dry-run)."""
+        bin_dir = tmp_path / "bin"
+        bin_dir.mkdir(exist_ok=True)
+        log = tmp_path / "gcloud_calls.jsonl"
+        shim = bin_dir / "gcloud"
+        shim.write_text(
+            "#!/usr/bin/env python3\n"
+            "import json, sys\n"
+            f"open({str(log)!r}, 'a').write(json.dumps(sys.argv[1:]) + '\\n')\n"
+            f"sys.exit({exit_code})\n"
+        )
+        shim.chmod(0o755)
+        return bin_dir, log
+
+    def test_pod_launch_runs_gcloud_with_env_contract(
+        self, tmp_path, monkeypatch
+    ):
+        bin_dir, log = self._fake_gcloud(tmp_path, exit_code=0)
+        monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+        script = tmp_path / "train.py"
+        script.write_text("")
+        rc = cli_main(
+            [
+                "launch", "--tpu_name", "mypod", "--tpu_zone", "us-central2-b",
+                "--tpu_project", "proj-1", "--num_processes", "4",
+                "--strategy", "FSDP", "--fsdp", "4", "--mixed_precision",
+                "bf16", str(script), "--epochs", "2",
+            ]
+        )
+        assert rc == 0
+        calls = [json.loads(l) for l in log.read_text().splitlines()]
+        assert len(calls) == 1
+        argv = calls[0]
+        # Command shape: gcloud compute tpus tpu-vm ssh --project=… NAME …
+        assert argv[:4] == ["compute", "tpus", "tpu-vm", "ssh"]
+        assert "--project=proj-1" in argv and argv.index("--project=proj-1") < argv.index("mypod")
+        assert "--zone=us-central2-b" in argv
+        assert "--worker=all" in argv  # fan-out to every pod worker
+        remote = [a for a in argv if a.startswith("--command=")][0]
+        # Per-worker env contract is injected into the remote command; pod
+        # rendezvous goes through TPU metadata (no coordinator address).
+        for frag in (
+            "ATX_SHARDING_STRATEGY=FSDP", "ATX_MESH_FSDP=4",
+            "ATX_MIXED_PRECISION=bf16", "ATX_NUM_PROCESSES=4",
+            "ATX_MULTIHOST=1", "train.py", "--epochs 2",
+        ):
+            assert frag in remote, f"{frag!r} missing from remote command"
+        assert "ATX_COORDINATOR_ADDRESS" not in remote
+
+    def test_pod_launch_propagates_failure_and_restarts(
+        self, tmp_path, monkeypatch
+    ):
+        bin_dir, log = self._fake_gcloud(tmp_path, exit_code=3)
+        monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+        script = tmp_path / "train.py"
+        script.write_text("")
+        rc = cli_main(
+            [
+                "launch", "--tpu_name", "mypod", "--tpu_zone", "us-central2-b",
+                "--num_processes", "4", "--max_restarts", "2", str(script),
+            ]
+        )
+        assert rc == 3  # nonzero remote exit propagates
+        # Initial attempt + 2 restarts, all through the same gcloud fan-out.
+        assert len(log.read_text().splitlines()) == 3
+
     def test_single_host_subprocess_env(self, tmp_path):
         """Launch a real child that dumps its env contract."""
         script = tmp_path / "dump.py"

@@ -210,3 +210,122 @@ class TestReviewFindings:
             _kw({"activation_checkpointing": {"partition_activations": True}})
         with pytest.raises(ValueError, match="gradient_cliping"):
             _kw({"gradient_cliping": 1.0})  # typo must not silently no-op
+
+
+class TestAdvisorRound4:
+    """ADVICE r4: DeepSpeed's default warmup_type is LOG, sub-block keys must
+    get the same warn/refuse policy as zero_optimization, and fp16
+    loss-scaling knobs map onto DynamicLossScale instead of vanishing."""
+
+    def _sched_lr(self, tx, step):
+        # Extract the schedule by running the ds-built optimizer over a
+        # dummy param for `step` updates and reading the applied scale.
+        import optax
+
+        cfg = {
+            "optimizer": {"type": "SGD", "params": {"lr": 1.0}},
+            "scheduler": tx,
+        }
+        opt = optax_from_deepspeed_config(cfg)
+        params = {"w": jnp.ones(())}
+        state = opt.init(params)
+        g = {"w": jnp.ones(())}
+        lr_seen = []
+        for _ in range(step):
+            updates, state = opt.update(g, state, params)
+            lr_seen.append(float(-updates["w"]))  # unit grad -> update = -lr
+        return lr_seen
+
+    def test_default_warmup_is_log_ramp(self):
+        import math
+
+        W, max_lr = 20, 1.0
+        lrs = self._sched_lr(
+            {"type": "WarmupLR",
+             "params": {"warmup_num_steps": W, "warmup_max_lr": max_lr}},
+            W + 3,
+        )
+        # DeepSpeed: gamma(t) = log(1+t)/log(W) for t < W, then 1.
+        for t in (1, 5, 10, W - 1):
+            want = max_lr * math.log(1 + t) / math.log(W)
+            assert lrs[t] == pytest.approx(want, rel=1e-5), f"step {t}"
+        assert lrs[W + 2] == pytest.approx(max_lr, rel=1e-6)
+        # A log ramp is NOT the linear one except at the endpoints.
+        assert lrs[5] != pytest.approx(max_lr * 5 / W, rel=0.05)
+
+    def test_linear_warmup_still_available(self):
+        W = 10
+        lrs = self._sched_lr(
+            {"type": "WarmupLR",
+             "params": {"warmup_num_steps": W, "warmup_max_lr": 1.0,
+                        "warmup_type": "linear"}},
+            W,
+        )
+        assert lrs[5] == pytest.approx(0.5, rel=1e-5)
+
+    def test_bad_warmup_type_refused(self):
+        with pytest.raises(ValueError, match="warmup_type"):
+            optax_from_deepspeed_config({
+                "optimizer": {"type": "AdamW"},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 5,
+                                         "warmup_type": "cosine"}},
+            })
+
+    def test_unknown_scheduler_param_refused_known_warned(self):
+        base = {
+            "optimizer": {"type": "AdamW"},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_num_steps": 5,
+                                     "warmup_lr_steps": 3}},  # typo
+        }
+        with pytest.raises(ValueError, match="warmup_lr_steps"):
+            optax_from_deepspeed_config(base)
+        with pytest.warns(UserWarning, match="last_batch_iteration"):
+            optax_from_deepspeed_config({
+                "optimizer": {"type": "AdamW"},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 5,
+                                         "last_batch_iteration": -1}},
+            })
+
+    def test_unknown_optimizer_param_refused_kernel_knobs_warned(self):
+        with pytest.raises(ValueError, match="weight_decy"):
+            optax_from_deepspeed_config({
+                "optimizer": {"type": "AdamW", "params": {"weight_decy": 0.1}},
+            })
+        with pytest.warns(UserWarning, match="torch_adam"):
+            optax_from_deepspeed_config({
+                "optimizer": {"type": "AdamW", "params": {"torch_adam": True}},
+            })
+
+    def test_fp16_loss_scaling_maps_to_dynamic_loss_scale(self):
+        kw = _kw({"fp16": {"enabled": True, "initial_scale_power": 12,
+                           "loss_scale_window": 500}})
+        assert kw["mixed_precision"] == "fp16"
+        assert kw["loss_scale_config"] == {
+            "init_scale": 2.0**12, "growth_interval": 500,
+        }
+        # Static scale pins growth/backoff off.
+        kw = _kw({"fp16": {"enabled": True, "loss_scale": 128.0}})
+        assert kw["loss_scale_config"] == {
+            "init_scale": 128.0, "growth_factor": 1.0, "backoff_factor": 1.0,
+        }
+        # Knobs with no analog warn; typos refuse.
+        with pytest.warns(UserWarning, match="hysteresis"):
+            _kw({"fp16": {"enabled": True, "hysteresis": 2}})
+        with pytest.raises(ValueError, match="los_scale"):
+            _kw({"fp16": {"enabled": True, "los_scale": 0}})
+
+    def test_fp16_config_reaches_the_accelerator_scaler(self):
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        kw = _kw({"fp16": {"enabled": True, "initial_scale_power": 10,
+                           "loss_scale_window": 250}})
+        acc = Accelerator(seed=0, **kw)
+        ls = acc._maybe_loss_scale()
+        assert float(ls.scale) == 2.0**10
+        assert ls.growth_interval == 250
+        AcceleratorState._reset_state()

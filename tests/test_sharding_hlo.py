@@ -196,33 +196,44 @@ class TestSpmdWarningClean:
     `__graft_entry__.dryrun_multichip` applies the same guard at driver time.
     """
 
-    def _compile_llama_step(self, mesh_config, **config_overrides):
+    def _compile_family_step(self, family, mesh_config, **config_overrides):
         from __graft_entry__ import _fail_on_spmd_warnings
-        from accelerate_tpu.models import llama
+        from accelerate_tpu.models import gpt, llama, t5
 
-        config = llama.LlamaConfig.tiny(**config_overrides)
+        mod = {"llama": llama, "gpt": gpt, "t5": t5}[family]
+        config = {
+            "llama": llama.LlamaConfig,
+            "gpt": gpt.GPTConfig,
+            "t5": t5.T5Config,
+        }[family].tiny(**config_overrides)
+        batch = {"input_ids": jnp.zeros((8, 32), jnp.int32)}
+        if family == "t5":
+            batch["decoder_input_ids"] = jnp.zeros((8, 32), jnp.int32)
         with _fail_on_spmd_warnings():
             acc = Accelerator(
                 seed=0,
                 strategy="HYBRID",
                 mesh_config=mesh_config,
-                sharding_rules=get_tp_plan("llama"),
+                sharding_rules=get_tp_plan(family),
                 mixed_precision="bf16",
             )
             state = acc.create_train_state(
-                lambda r: llama.init(r, config), optax.adamw(1e-3)
+                lambda r: mod.init(r, config), optax.adamw(1e-3)
             )
             step = acc.make_train_step(
-                lambda p, b, r: llama.loss_fn(p, b, config, r)
+                lambda p, b, r: mod.loss_fn(p, b, config, r)
             )
-            batch = {"input_ids": jnp.zeros((8, 32), jnp.int32)}
             step.lower(state, batch).compile()
 
-    def test_hybrid_3d_step_compiles_warning_free(self):
-        self._compile_llama_step(MeshConfig(data=2, fsdp=2, tensor=2))
+    @pytest.mark.parametrize("family", ["llama", "gpt", "t5"])
+    def test_hybrid_3d_step_compiles_warning_free(self, family):
+        # Every plan whose embed sharding changed (llama/gpt/t5) compiles
+        # clean on the 3-D mesh that used to trigger the rematerialization.
+        self._compile_family_step(family, MeshConfig(data=2, fsdp=2, tensor=2))
 
     def test_sequence_expert_step_compiles_warning_free(self):
-        self._compile_llama_step(
+        self._compile_family_step(
+            "llama",
             MeshConfig(data=2, sequence=2, expert=2),
             n_experts=2,
             attention_impl="ring",
