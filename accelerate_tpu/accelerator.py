@@ -224,9 +224,20 @@ class Accelerator:
         self.max_grad_value = max_grad_value
         self._loss_scale_config = dict(loss_scale_config or {})
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
+        # Launcher env contract fallbacks (`commands/launch.py build_child_env`
+        # forwards the config file's tracker/project knobs as ATX_*), same
+        # pattern as the mesh/strategy env reads.
+        import os
+
+        if project_dir is None and project_config is None:
+            project_dir = os.environ.get("ATX_PROJECT_DIR") or None
         self.project_config = project_config or ProjectConfiguration(project_dir=project_dir)
         self.rng = _set_seed(seed) if seed is not None else jax.random.PRNGKey(0)
         self.trackers: list[Any] = []
+        if log_with is None and os.environ.get("ATX_LOG_WITH"):
+            log_with = [
+                t.strip() for t in os.environ["ATX_LOG_WITH"].split(",") if t.strip()
+            ]
         self.log_with = log_with
         self._flag_tensor: jax.Array | None = None
         self._checkpoint_registry: list[Any] = []

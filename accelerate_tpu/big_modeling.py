@@ -537,14 +537,28 @@ def dispatch_leaves(
         make_stages(path, leaf, spec)
         for (path, leaf), spec in zip(flat, spec_leaves)
     ]
-    out = []
-    with ThreadPoolExecutor(max_workers=1) as ex:
-        depth = 2
-        futures = [ex.submit(h) for h, _p in stages[:depth]]
+    # Three-stage pipeline: one IO worker reads+packs ahead (sequential, the
+    # source's lazy handles are not thread-safe and disks want sequential
+    # reads), TWO placement workers push to the device concurrently (the
+    # remote-tunnel link serializes per call at ~50 MiB/s but aggregates to
+    # ~63 MiB/s with two streams — measured on the v5e tunnel), and the
+    # window keeps at most `depth` staged payloads + `window` un-finished
+    # placements alive so host RAM stays bounded.
+    depth, window = 2, 3
+    out: list = []
+    with ThreadPoolExecutor(max_workers=1) as io_ex, ThreadPoolExecutor(
+        max_workers=2
+    ) as put_ex:
+        host_futures = [io_ex.submit(h) for h, _p in stages[:depth]]
+        place_futures: list = []
         for i, (_h, place) in enumerate(stages):
             if i + depth < len(stages):
-                futures.append(ex.submit(stages[i + depth][0]))
-            out.append(place(futures[i].result()))
+                host_futures.append(io_ex.submit(stages[i + depth][0]))
+            place_futures.append(put_ex.submit(place, host_futures[i].result()))
+            host_futures[i] = None  # release the staged payload reference
+            if i >= window:
+                place_futures[i - window].result()  # backpressure
+        out = [f.result() for f in place_futures]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

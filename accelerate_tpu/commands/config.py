@@ -38,6 +38,16 @@ class LaunchConfig:
     mixed_precision: str = "bf16"
     sharding_strategy: str = "DATA_PARALLEL"
     gradient_accumulation_steps: int = 1
+    # Optimizer moments in pinned host RAM (parallel/host_offload.py; the
+    # DeepSpeed offload_optimizer analog) — forwarded as ATX_OFFLOAD_OPTIMIZER.
+    offload_optimizer: bool = False
+    # Run fp8 even where the recorded matmul speedup is <= 1 (the launch
+    # lose-lose gate, `commands/launch.py`).
+    force_fp8: bool = False
+    # Comma-separated tracker names (tracking.filter_trackers; "" = none),
+    # forwarded as ATX_LOG_WITH; project_dir feeds ProjectConfiguration.
+    log_with: str = ""
+    project_dir: str = ""
     # Relaunch the whole worker group (fresh coordinator port) up to this
     # many times after a worker death — the torch-elastic max_restarts analog
     # (reference `commands/launch.py:142-771`). 0 = fail on first death.
@@ -122,6 +132,17 @@ def interactive_config() -> LaunchConfig:
         "Sharding strategy (DATA_PARALLEL/ZERO1/ZERO2/FSDP/TENSOR_PARALLEL/HYBRID)",
         "FSDP" if cfg.mesh_fsdp > 1 else "DATA_PARALLEL",
     ).upper()
+    if cfg.sharding_strategy in ("FSDP", "ZERO1", "ZERO2", "HYBRID"):
+        cfg.offload_optimizer = (
+            _ask(
+                "Offload optimizer moments to pinned host RAM? (y/n; the "
+                "DeepSpeed offload_optimizer analog — fits ~3x larger "
+                "models at a per-step streaming cost)",
+                "n",
+            )
+            .lower()
+            .startswith("y")
+        )
     cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
     if cfg.mixed_precision == "fp8":
         print(
@@ -130,7 +151,31 @@ def interactive_config() -> LaunchConfig:
             "you keep the quantization error and get NO speedup. Check "
             "`bench.py`'s fp8_matmul_speedup field on your chip first."
         )
+        cfg.force_fp8 = (
+            _ask(
+                "Force fp8 even where the recorded speedup is <= 1x? (y/n; "
+                "otherwise launch refuses the lose-lose configuration)",
+                "n",
+            )
+            .lower()
+            .startswith("y")
+        )
     cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
+    cfg.max_restarts = _ask(
+        "Max worker-group restarts after a crash (torch-elastic "
+        "max_restarts analog; 0 = fail on first death)",
+        0,
+        int,
+    )
+    cfg.log_with = _ask(
+        "Experiment trackers, comma-separated (json/tensorboard/wandb/"
+        "mlflow/comet_ml/aim/clearml/dvclive; blank = none)",
+        "",
+    )
+    if cfg.log_with:
+        cfg.project_dir = _ask(
+            "Project directory (checkpoints + tracker logging dir)", ""
+        )
     if _ask("Launching on a GCE TPU pod via gcloud? (y/n)", "n").lower().startswith("y"):
         cfg.tpu_name = _ask("TPU name", "")
         cfg.tpu_zone = _ask("TPU zone", "")

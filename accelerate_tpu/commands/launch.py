@@ -45,7 +45,8 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
     p.add_argument(
         "--force_fp8",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
+        default=None,
         help="Run fp8 even on device kinds whose recorded fp8 matmul "
         "speedup is <= 1x (where fp8 costs accuracy for zero gain)",
     )
@@ -60,6 +61,23 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument("--sequence", type=int, default=None, help="mesh sequence axis size")
     p.add_argument("--expert", type=int, default=None, help="mesh expert axis size")
     p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    p.add_argument(
+        "--offload_optimizer",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="Keep optimizer moments in pinned host RAM "
+        "(parallel/host_offload.py; the DeepSpeed offload_optimizer "
+        "analog); --no-offload_optimizer overrides a config-file true",
+    )
+    p.add_argument(
+        "--log_with",
+        default=None,
+        help="Comma-separated experiment trackers "
+        "(json/tensorboard/wandb/mlflow/comet_ml/aim/clearml/dvclive)",
+    )
+    p.add_argument(
+        "--project_dir", default=None, help="Project/logging directory for trackers"
+    )
     p.add_argument("--tpu_name", default=None, help="GCE TPU name (pod launch)")
     p.add_argument("--tpu_zone", default=None)
     p.add_argument("--tpu_project", default=None)
@@ -103,6 +121,10 @@ def _merge_config(args: argparse.Namespace) -> LaunchConfig:
         "mesh_sequence": args.sequence,
         "mesh_expert": args.expert,
         "gradient_accumulation_steps": args.gradient_accumulation_steps,
+        "offload_optimizer": args.offload_optimizer,
+        "force_fp8": getattr(args, "force_fp8", None),
+        "log_with": args.log_with,
+        "project_dir": args.project_dir,
         "tpu_name": args.tpu_name,
         "tpu_zone": args.tpu_zone,
         "tpu_project": args.tpu_project,
@@ -131,6 +153,12 @@ def build_child_env(
     env["ATX_MESH_SEQUENCE"] = str(cfg.mesh_sequence)
     env["ATX_MESH_EXPERT"] = str(cfg.mesh_expert)
     env["ATX_GRADIENT_ACCUMULATION_STEPS"] = str(cfg.gradient_accumulation_steps)
+    if cfg.offload_optimizer:
+        env["ATX_OFFLOAD_OPTIMIZER"] = "1"
+    if cfg.log_with:
+        env["ATX_LOG_WITH"] = cfg.log_with
+    if cfg.project_dir:
+        env["ATX_PROJECT_DIR"] = cfg.project_dir
     if cfg.num_processes > 1:
         env["ATX_NUM_PROCESSES"] = str(cfg.num_processes)
         if process_id is not None:
@@ -362,7 +390,7 @@ def run(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         speedup = _fp8_speedup_for_local_devices()
-        if speedup is not None and speedup <= 1.0 and not getattr(args, "force_fp8", False):
+        if speedup is not None and speedup <= 1.0 and not cfg.force_fp8:
             print(
                 "[accelerate-tpu launch] refusing --mixed_precision fp8: "
                 f"measured fp8 matmul speedup on this device kind is "

@@ -318,10 +318,18 @@ def _maybe_dequantize(block: Params, dtype: Any) -> Params:
     """Transparent weight-only int8 support (utils/quantization.py): when a
     block carries quantized leaves, dequantize them to the compute dtype here
     — per layer, inside the scan — so HBM holds int8 while matmuls see the
-    compute dtype."""
+    compute dtype.
+
+    Inside an `ops.int8.int8_compute()` context the quantized nodes pass
+    through UNTOUCHED: every projection routes through `matmul_einsum`,
+    which contracts them int8×int8→int32 on the int8 MXU (~2× the bf16
+    rate — the compute-bound prefill/verify win; `ops/int8.py`)."""
+    from ..ops.int8 import int8_compute_enabled
     from ..utils.quantization import dequantize_pytree, has_quantized
 
     if has_quantized(block):
+        if int8_compute_enabled():
+            return block
         return dequantize_pytree(block, dtype)
     return block
 

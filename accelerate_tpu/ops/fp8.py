@@ -68,7 +68,7 @@ def fp8_matmuls(enabled: bool = True):
         _MODE.hits = prev_hits
 
 
-def matmul_einsum(eq: str, x: jax.Array, w: jax.Array) -> jax.Array:
+def matmul_einsum(eq: str, x: jax.Array, w) -> jax.Array:
     """The one matmul entry point for every projection in the model zoo
     (`models/layers.py`, `ops/moe.py`).
 
@@ -76,7 +76,19 @@ def matmul_einsum(eq: str, x: jax.Array, w: jax.Array) -> jax.Array:
     (the bf16-compute / fp32-master policy). Inside an `fp8_matmuls()`
     context — which `Accelerator` enters when ``mixed_precision='fp8'`` —
     it lowers to a dynamically-scaled fp8 contraction instead (reference fp8
-    backends: `utils/ao.py:103`, `utils/transformer_engine.py:26-88`)."""
+    backends: `utils/ao.py:103`, `utils/transformer_engine.py:26-88`).
+
+    ``w`` may also be a quantized-weight node from `utils/quantization.py`:
+    inside an `ops.int8.int8_compute()` context the contraction runs
+    int8×int8→int32 on the int8 MXU (`ops/int8.py`); otherwise the node
+    dequantizes to the activation dtype and takes the normal path."""
+    if isinstance(w, dict):
+        from ..utils.quantization import dequantize_array
+        from .int8 import int8_compute_enabled, int8_einsum_quantized
+
+        if int8_compute_enabled() and not fp8_enabled():
+            return int8_einsum_quantized(eq, x, w)
+        w = dequantize_array(w, x.dtype)
     if fp8_enabled():
         _MODE.hits = getattr(_MODE, "hits", 0) + 1
         return fp8_einsum(eq, x, w.astype(x.dtype))

@@ -226,14 +226,24 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
             ls_cfg["init_scale"] = 2.0 ** int(_auto(power, 16))
         if window is not None:
             ls_cfg["growth_interval"] = int(_auto(window, 1000))
-    _check_params_block(
-        "fp16",
-        fp16,
-        ignored=("hysteresis", "consecutive_hysteresis", "min_loss_scale", "auto_cast"),
-    )
+    if fp16_enabled:
+        # Disabled blocks are inert — their keys cannot change semantics,
+        # so only an ENABLED block gets the warn/refuse policy.
+        _check_params_block(
+            "fp16",
+            fp16,
+            ignored=(
+                "hysteresis",
+                "consecutive_hysteresis",
+                "min_loss_scale",
+                "auto_cast",
+                "fp16_master_weights_and_grads",
+            ),
+        )
     bf16 = dict(cfg.get("bf16", {}))
     bf16_enabled = _auto(bf16.pop("enabled", False), False)
-    _check_params_block("bf16", bf16, ignored=("immediate_grad_update",))
+    if bf16_enabled:
+        _check_params_block("bf16", bf16, ignored=("immediate_grad_update",))
     if fp16_enabled:
         kwargs["mixed_precision"] = "fp16"
         if ls_cfg:

@@ -346,6 +346,94 @@ def run_sharded_mode(ps: ProcessState, kind: str, ckpt_dir: str) -> None:
     print(f"[proc {ps.process_index}] SHARDED {kind.upper()} OK", flush=True)
 
 
+def run_longcontext_mode(ps: ProcessState, kind: str) -> None:
+    """Sequence/expert parallelism with the axis SPANNING the process
+    boundary (VERDICT r4 #7): 2 processes × 4 devices with sequence=8 (the
+    KV ring's ppermute hops cross hosts) or expert=8 (the MoE dispatch
+    all-to-all crosses hosts), trained for 5 steps with loss parity against
+    a single-device oracle of the same math — not just a finite-loss check."""
+    from accelerate_tpu.data.loader import _form_global_batch
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.parallel.tp import get_tp_plan
+
+    n_dev = len(jax.devices())
+    if kind == "ring":
+        config = llama.LlamaConfig.tiny(attention_impl="ring")
+        mesh_config = atx.MeshConfig(data=1, sequence=n_dev)
+        span_axis = "sequence"
+    else:
+        config = llama.LlamaConfig.tiny(n_experts=n_dev, moe_top_k=2)
+        mesh_config = atx.MeshConfig(data=1, expert=n_dev)
+        span_axis = "expert"
+    acc = atx.Accelerator(
+        seed=0,
+        mesh_config=mesh_config,
+        strategy="HYBRID",
+        sharding_rules=get_tp_plan("llama"),
+    )
+    # The parallel axis must genuinely cross the process boundary: one
+    # axis GROUP contains devices owned by both processes.
+    from accelerate_tpu.parallel.mesh import MESH_AXES
+
+    axis_idx = MESH_AXES.index(span_axis)
+    groups = np.moveaxis(acc.mesh.devices, axis_idx, -1).reshape(
+        -1, acc.mesh.shape[span_axis]
+    )
+    owners = {d.process_index for d in groups[0]}
+    assert len(owners) == ps.num_processes, (span_axis, owners)
+
+    state = acc.create_train_state(
+        lambda r: llama.init(r, config), optax.adamw(1e-2)
+    )
+    if kind == "moe":
+        # Expert weights are global non-addressable arrays sharded over the
+        # spanning axis.
+        moe_leaf = state.params["blocks"]["moe"]["w_gate"]
+        assert not moe_leaf.is_fully_addressable
+        assert "expert" in str(moe_leaf.sharding.spec), moe_leaf.sharding.spec
+
+    step = acc.make_train_step(
+        lambda p, b, r: llama.loss_fn(p, b, config, r), donate=False
+    )
+    rng = np.random.RandomState(11)
+    tokens = rng.randint(0, config.vocab_size, size=(8, 32)).astype(np.int32)
+    batch = _form_global_batch({"input_ids": tokens}, acc.mesh)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    # Single-device oracle: same init/seed/batch; ring attention is exact,
+    # so the oracle uses plain dot attention; the MoE math is identical.
+    import dataclasses as _dc
+
+    ref_config = (
+        _dc.replace(config, attention_impl="dot") if kind == "ring" else config
+    )
+    ref_params = llama.init(jax.random.PRNGKey(0), ref_config)
+    ref_tx = optax.adamw(1e-2)
+    ref_opt = ref_tx.init(ref_params)
+
+    @jax.jit
+    def ref_step(params, opt):
+        def loss_fn(p):
+            return llama.loss_fn(
+                p, {"input_ids": jnp.asarray(tokens)}, ref_config, None
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = ref_tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    ref_losses = []
+    for _ in range(5):
+        ref_params, ref_opt, ref_loss = ref_step(ref_params, ref_opt)
+        ref_losses.append(float(ref_loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-4)
+    ps.wait_for_everyone()
+    print(f"[proc {ps.process_index}] LONGCTX {kind.upper()} OK", flush=True)
+
+
 def run_mismatch_mode(ps: ProcessState) -> None:
     assert ps.debug, "mismatch mode requires ATX_DEBUG_MODE=1"
     shape = (2,) if ps.process_index == 0 else (3,)
@@ -361,7 +449,9 @@ def run_mismatch_mode(ps: ProcessState) -> None:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--mode", default="all", choices=["all", "mismatch", "fsdp", "tp"]
+        "--mode",
+        default="all",
+        choices=["all", "mismatch", "fsdp", "tp", "ring", "moe"],
     )
     parser.add_argument("--ckpt_dir", default="")
     args = parser.parse_args()
@@ -372,6 +462,9 @@ def main() -> int:
         return 0
     if args.mode in ("fsdp", "tp"):
         run_sharded_mode(ps, args.mode, args.ckpt_dir)
+        return 0
+    if args.mode in ("ring", "moe"):
+        run_longcontext_mode(ps, args.mode)
         return 0
 
     check_identity_and_barrier(ps)

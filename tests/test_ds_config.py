@@ -329,3 +329,16 @@ class TestAdvisorRound4:
         assert float(ls.scale) == 2.0**10
         assert ls.growth_interval == 250
         AcceleratorState._reset_state()
+
+    def test_disabled_precision_block_keys_are_inert(self):
+        # A disabled fp16 block's keys can't change semantics; real-world
+        # configs carry inert keys like fp16_master_weights_and_grads.
+        kw = _kw({"bf16": {"enabled": True},
+                  "fp16": {"enabled": False,
+                           "fp16_master_weights_and_grads": False}})
+        assert kw["mixed_precision"] == "bf16"
+        # Enabled fp16 tolerates the same known key (warn-free no-analog? it
+        # is torch-master-weights bookkeeping -> ignored with a warning).
+        with pytest.warns(UserWarning, match="fp16_master_weights_and_grads"):
+            _kw({"fp16": {"enabled": True,
+                          "fp16_master_weights_and_grads": True}})

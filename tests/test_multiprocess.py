@@ -120,3 +120,33 @@ def test_two_process_tensor_parallel_training(tmp_path):
         timeout=420,
     )
     assert_all_ranks(proc, "SHARDED TP OK", 2)
+
+
+@pytest.mark.multiprocess
+def test_two_process_ring_attention_training():
+    """Sequence parallelism with the ring axis SPANNING the process boundary
+    (VERDICT r4 #7): KV ppermute hops cross hosts; loss parity vs a
+    single-device dot-attention oracle (ring attention is exact)."""
+    proc = launch(
+        DRIVER,
+        "--mode", "ring",
+        num_processes=2,
+        host_devices=4,
+        timeout=420,
+    )
+    assert_all_ranks(proc, "LONGCTX RING OK", 2)
+
+
+@pytest.mark.multiprocess
+def test_two_process_expert_parallel_training():
+    """Expert parallelism with experts sharded across hosts: the MoE
+    dispatch all-to-all crosses the process boundary; loss parity vs a
+    single-device oracle of identical math."""
+    proc = launch(
+        DRIVER,
+        "--mode", "moe",
+        num_processes=2,
+        host_devices=4,
+        timeout=420,
+    )
+    assert_all_ranks(proc, "LONGCTX MOE OK", 2)
