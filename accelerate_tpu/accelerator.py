@@ -675,9 +675,10 @@ class Accelerator:
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
 
-        def step_fn(state: TrainState, batch: Any) -> tuple[TrainState, dict[str, jax.Array]]:
-            rng = jax.random.fold_in(self.rng, state.step)
-            scale = state.loss_scale.scale if use_scaler else jnp.float32(1.0)
+        def accumulated_grads(params, batch, rng, scale):
+            """(grads, loss, reduced aux) — the one microbatch-accumulation
+            pipeline, shared by the monolithic step and the disk-tier grad
+            pass so the two cannot drift."""
             if accum > 1:
                 def reshape(x):
                     b = x.shape[0]
@@ -697,13 +698,13 @@ class Accelerator:
                     # Distinct rng per microbatch: otherwise dropout masks are
                     # identical across the accumulation window.
                     (_, (loss, aux)), grads = grad_fn(
-                        state.params, mb, jax.random.fold_in(rng, mb_idx), scale
+                        params, mb, jax.random.fold_in(rng, mb_idx), scale
                     )
                     g_acc = jax.tree.map(jnp.add, g_acc, grads)
                     return (g_acc, l_acc + loss), aux
 
                 zero_grads = jax.tree.map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), state.params
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params
                 )
                 (grads, loss_sum), aux = jax.lax.scan(
                     scan_body,
@@ -723,8 +724,14 @@ class Accelerator:
                         else jnp.sum(x, axis=0),
                         aux,
                     )
-            else:
-                (_, (loss, aux)), grads = grad_fn(state.params, batch, rng, scale)
+                return grads, loss, aux
+            (_, (loss, aux)), grads = grad_fn(params, batch, rng, scale)
+            return grads, loss, aux
+
+        def step_fn(state: TrainState, batch: Any) -> tuple[TrainState, dict[str, jax.Array]]:
+            rng = jax.random.fold_in(self.rng, state.step)
+            scale = state.loss_scale.scale if use_scaler else jnp.float32(1.0)
+            grads, loss, aux = accumulated_grads(state.params, batch, rng, scale)
 
             # Loss math stays fp32 throughout; output_dtype only changes the
             # dtype the metric is *reported* in.
@@ -838,7 +845,94 @@ class Accelerator:
         donate_args = (0,) if donate else ()
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
+        # ---- disk-tier optimizer offload (parallel/disk_offload.py): the
+        # step splits into a compiled grad pass and a host-streamed update
+        # against disk-resident moments, so it cannot ride the monolithic
+        # jit above. Closures are built lazily on first use.
+        _disk_jits: dict[str, Any] = {}
+
+        def run_disk_step(state: TrainState, batch: Any):
+            from .parallel.disk_offload import disk_streamed_update
+
+            if use_scaler:
+                raise ValueError(
+                    "disk offload_optimizer with fp16 dynamic loss scaling "
+                    "is not supported (the overflow-skip select would span "
+                    "the host update); use bf16 mixed precision."
+                )
+            if not all(
+                l.is_fully_addressable for l in jax.tree.leaves(state.params)
+            ):
+                raise NotImplementedError(
+                    "disk_offloaded_adamw streams grads through THIS host, so "
+                    "it requires fully-addressable (single-process) params — "
+                    "the DeepSpeed per-node NVMe-swap shape. For sharded "
+                    "multi-process params use the pinned-host tier "
+                    "(host_offloaded_adamw), whose update runs inside the "
+                    "compiled SPMD program."
+                )
+            if "grad" not in _disk_jits:
+                def grad_step(params, batch, step_idx):
+                    rng = jax.random.fold_in(self.rng, step_idx)
+                    grads, loss, aux = accumulated_grads(
+                        params, batch, rng, jnp.float32(1.0)
+                    )
+                    metrics = {
+                        "loss": loss
+                        if policy.output_dtype is None
+                        else loss.astype(policy.output_dtype)
+                    }
+                    if max_grad_value is not None:
+                        grads = jax.tree.map(
+                            lambda g: jnp.clip(g, -max_grad_value, max_grad_value),
+                            grads,
+                        )
+                    gs = jnp.float32(1.0)
+                    if max_grad_norm is not None:
+                        gnorm = global_norm(grads)
+                        gs = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                        metrics["grad_norm"] = gnorm
+                    return grads, metrics, gs, aux
+
+                _disk_jits["grad"] = jax.jit(grad_step)
+                _disk_jits["apply"] = jax.jit(
+                    lambda p, u: optax.apply_updates(p, u),
+                    donate_argnums=(0,) if donate else (),
+                )
+            with jax.sharding.set_mesh(self.mesh):
+                grads, metrics, gs, aux = _disk_jits["grad"](
+                    state.params, batch, state.step
+                )
+            count = int(jax.device_get(state.step)) + 1
+            grad_scale = (
+                float(jax.device_get(gs)) if max_grad_norm is not None else None
+            )
+            updates = disk_streamed_update(
+                state.tx, grads, state.params, count, grad_scale
+            )
+            del grads
+            with jax.sharding.set_mesh(self.mesh):
+                # Each update leaf lands directly in its param's sharding —
+                # one flat device_put to the default device would commit the
+                # whole tree to one chip on a multi-chip mesh.
+                updates = jax.device_put(
+                    updates, jax.tree.map(lambda p: p.sharding, state.params)
+                )
+                new_params = _disk_jits["apply"](state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state={"count": jnp.asarray(count, jnp.int32)},
+            )
+            if extra_metrics_fn is not None:
+                metrics.update(extra_metrics_fn(new_state, aux))
+            return new_state, metrics
+
         def run_step(state: TrainState, batch: Any):
+            from .parallel.disk_offload import DiskOffloadedAdamW
+
+            if isinstance(state.tx, DiskOffloadedAdamW):
+                return run_disk_step(state, batch)
             # Trace (and run) under the ambient mesh so the model's
             # activation constraints (parallel.mesh.constrain_batch) bind to
             # this Accelerator's axes.

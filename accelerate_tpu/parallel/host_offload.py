@@ -156,7 +156,9 @@ def host_offloaded_adamw(
     def update(grads, state, params):
         # Whole-tree path (used when offload is inactive).
         count = state["count"] + 1
-        lr_t = _lr(count)
+        # optax convention: the schedule sees the number of PREVIOUS updates
+        # (schedule(0) on the first step); bias correction uses `count`.
+        lr_t = _lr(state["count"])
 
         def leaf(g, mu, nu, p):
             return _adamw_slice(
@@ -175,22 +177,30 @@ def host_offloaded_adamw(
     )
 
 
-def _adamw_slice(g, mu, nu, p, count, lr_t, b1, b2, eps, weight_decay, grad_scale=None):
+def _adamw_slice(
+    g, mu, nu, p, count, lr_t, b1, b2, eps, weight_decay, grad_scale=None, xp=None
+):
     """One adamw step for one leaf (or one layer slice of one leaf); fp32
     moment math, update returned in fp32 (caller casts to param dtype).
     ``grad_scale`` applies global-norm clipping per slice (so the caller
-    never materializes a scaled copy of the whole gradient tree)."""
-    import jax.numpy as jnp
+    never materializes a scaled copy of the whole gradient tree).
+
+    ``xp`` is the array namespace: jnp (default — the in-jit streamed
+    update) or numpy (the disk-tier update runs on the host against
+    memmapped moments, `parallel/disk_offload.py`); one body serves both
+    so the two tiers cannot drift numerically."""
+    if xp is None:
+        import jax.numpy as xp  # type: ignore[no-redef]
 
     g32 = g.astype(mu.dtype)
     if grad_scale is not None:
-        g32 = g32 * grad_scale.astype(mu.dtype)
+        g32 = g32 * xp.asarray(grad_scale, dtype=mu.dtype)
     new_mu = b1 * mu + (1.0 - b1) * g32
-    new_nu = b2 * nu + (1.0 - b2) * jnp.square(g32)
-    c = count.astype(new_mu.dtype)
+    new_nu = b2 * nu + (1.0 - b2) * xp.square(g32)
+    c = count.astype(new_mu.dtype) if hasattr(count, "astype") else new_mu.dtype.type(count)
     mu_hat = new_mu / (1.0 - b1**c)
     nu_hat = new_nu / (1.0 - b2**c)
-    step = mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * p.astype(new_mu.dtype)
+    step = mu_hat / (xp.sqrt(nu_hat) + eps) + weight_decay * p.astype(new_mu.dtype)
     return (-lr_t * step), new_mu, new_nu
 
 
@@ -216,8 +226,11 @@ def streaming_adamw_update(
     from jax.sharding import PartitionSpec
 
     count = opt_state["count"] + 1
+    # Schedule at the PRE-increment count (optax convention; see update()).
     lr_t = (
-        tx.learning_rate(count) if callable(tx.learning_rate) else tx.learning_rate
+        tx.learning_rate(opt_state["count"])
+        if callable(tx.learning_rate)
+        else tx.learning_rate
     )
 
     flat_g, treedef = jax.tree_util.tree_flatten_with_path(grads)

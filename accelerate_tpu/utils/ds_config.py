@@ -11,6 +11,9 @@ equivalents instead of asking them to re-derive the run configuration:
   parallel, 1/2 = ZERO1/ZERO2 optimizer-state sharding, 3 = FSDP);
 - ``zero_optimization.offload_optimizer.device: cpu`` -> the pinned-host
   optimizer offload (`parallel/host_offload.py`, the ZeRO-Offload analog);
+  ``device: nvme`` + ``nvme_path`` -> the disk tier
+  (`parallel/disk_offload.py`, the ZeRO-Infinity analog: moments live in
+  memmaps under nvme_path and persist across restarts);
 - ``fp16`` / ``bf16`` -> ``mixed_precision`` (fp16 keeps dynamic loss
   scaling semantics — the reference's GradScaler/DeepSpeed scaler path —
   and ``loss_scale``/``initial_scale_power``/``loss_scale_window`` map
@@ -23,10 +26,11 @@ equivalents instead of asking them to re-derive the run configuration:
 
 Knobs that configure NCCL/engine mechanics XLA owns on TPU
 (``overlap_comm``, ``contiguous_gradients``, bucket sizes,
-``round_robin_gradients``...) are reported once via warning and dropped —
-the compiler schedules collectives. Capabilities with no training-time
-analog here (parameter CPU/NVMe offload, ``aio``) fail loudly rather than
-silently training something else.
+``round_robin_gradients``, the ``aio`` IO-engine tuning...) are reported
+once via warning and dropped — the compiler schedules collectives and the
+disk tier streams via memmaps. Capabilities with no training-time analog
+here (parameter CPU/NVMe offload) fail loudly rather than silently
+training something else.
 """
 
 from __future__ import annotations
@@ -179,19 +183,43 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
             "use FSDP sharding (stage 3) plus offload_optimizer instead."
         )
     if cfg.get("aio"):
-        raise ValueError(
-            "aio/NVMe offload has no analog here; remove the block or keep "
-            "the optimizer offload on host RAM (offload_optimizer.device: cpu)."
+        # aio tunes DeepSpeed's async-IO engine (queue depth, block size);
+        # the disk tier here streams through numpy memmaps — engine
+        # mechanics with no analog, same policy as the NCCL knobs.
+        warnings.warn(
+            "ds_config aio block tunes DeepSpeed's NVMe IO engine and has "
+            "no analog here (the disk tier streams via memmaps); dropped.",
+            stacklevel=2,
         )
     offload = False
     if offload_opt is not None:
-        device = offload_opt.get("device", "none")
+        offload_opt = dict(offload_opt)
+        device = offload_opt.pop("device", "none")
+        nvme_path = offload_opt.pop("nvme_path", None)
+        _check_params_block(
+            "zero_optimization.offload_optimizer",
+            offload_opt,
+            # IO-engine tuning knobs: the memmap tier has no analog.
+            ignored=("pin_memory", "buffer_count", "fast_init", "ratio"),
+        )
         if device == "cpu":
             offload = True
+        elif device == "nvme":
+            # ZeRO-Infinity NVMe tier: moments live on disk. Handled by the
+            # OPTIMIZER object (optax_from_deepspeed_config returns
+            # disk_offloaded_adamw bound to nvme_path), not by the sharding
+            # placement machinery — so `offload` stays False here.
+            if not nvme_path:
+                raise ValueError(
+                    "offload_optimizer.device='nvme' needs nvme_path (the "
+                    "directory for the moment memmaps — DeepSpeed requires "
+                    "it too)."
+                )
         elif device not in ("none",):
             raise ValueError(
                 f"offload_optimizer.device={device!r} is not supported; "
-                "'cpu' maps to the pinned-host optimizer offload."
+                "'cpu' maps to the pinned-host optimizer offload, 'nvme' "
+                "to the disk tier (parallel/disk_offload.py)."
             )
 
     kind = {
@@ -366,10 +394,18 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
     # The SAME config's offload request changes which optimizer object is
     # valid: Accelerator.create_train_state refuses offload_optimizer with
     # a non-streamable optimizer (accelerator.py `_offload_opt_placement`),
-    # so the translator must hand back the offload-aware one.
-    offload = (
+    # so the translator must hand back the offload-aware one. 'nvme' maps
+    # to the disk tier (`parallel/disk_offload.py`), whose moments live in
+    # memmaps under nvme_path.
+    offload_block = (
         dict(cfg.get("zero_optimization", {})).get("offload_optimizer", {}) or {}
-    ).get("device") == "cpu"
+    )
+    offload = offload_block.get("device") == "cpu"
+    nvme_path = (
+        offload_block.get("nvme_path")
+        if offload_block.get("device") == "nvme"
+        else None
+    )
 
     lname = name.lower()
     if lname in ("adam", "adamw"):
@@ -384,13 +420,20 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
         if not decoupled:
             # DeepSpeed plain Adam applies weight decay as L2-in-loss;
             # nothing here reproduces that silently.
-            if offload:
+            if offload or nvme_path:
                 raise ValueError(
                     "offload_optimizer with non-decoupled Adam weight decay "
                     "(adam_w_mode=false) has no analog; use AdamW."
                 )
             opt = optax.adam(schedule, b1=b1, b2=b2, eps=eps)
             return optax.chain(optax.add_decayed_weights(wd), opt)
+        if nvme_path:
+            from ..parallel.disk_offload import disk_offloaded_adamw
+
+            return disk_offloaded_adamw(
+                schedule, offload_dir=nvme_path, b1=b1, b2=b2, eps=eps,
+                weight_decay=wd,
+            )
         if offload:
             from ..parallel.host_offload import host_offloaded_adamw
 
@@ -398,7 +441,7 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
                 schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd
             )
         return optax.adamw(schedule, b1=b1, b2=b2, eps=eps, weight_decay=wd)
-    if offload:
+    if offload or nvme_path:
         raise ValueError(
             f"offload_optimizer is implemented for Adam/AdamW only, not {name!r}."
         )

@@ -50,8 +50,10 @@ class TestStrategyMapping:
             _kw({"zero_optimization": {"stage": 3,
                                        "offload_param": {"device": "cpu"}}})
 
-    def test_nvme_refused(self):
-        with pytest.raises(ValueError, match="aio"):
+    def test_aio_block_dropped_with_warning(self):
+        # Round 5: the NVMe tier exists (parallel/disk_offload.py), so the
+        # aio engine-tuning block downgrades from refusal to warn-drop.
+        with pytest.warns(UserWarning, match="aio"):
             _kw({"aio": {"block_size": 1048576}})
 
     def test_unknown_zero_key_refused(self):
