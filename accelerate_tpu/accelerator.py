@@ -899,6 +899,21 @@ class Accelerator:
                     lambda p, u: optax.apply_updates(p, u),
                     donate_argnums=(0,) if donate else (),
                 )
+            if "resume_checked" not in _disk_jits:
+                # The memmaps are the optimizer checkpoint; pairing them
+                # with a state restored from any OTHER step would silently
+                # corrupt the bias correction (moments ahead of the count).
+                stored = state.tx.store.count()
+                here = int(jax.device_get(state.step))
+                if stored is not None and stored != here:
+                    raise ValueError(
+                        f"disk-offloaded moments in {state.tx.store.dir!r} "
+                        f"were last written at step {stored}, but the "
+                        f"restored train state is at step {here}. Restore "
+                        "the matching checkpoint, or point offload_dir at a "
+                        "fresh directory to restart the optimizer."
+                    )
+                _disk_jits["resume_checked"] = True
             with jax.sharding.set_mesh(self.mesh):
                 grads, metrics, gs, aux = _disk_jits["grad"](
                     state.params, batch, state.step

@@ -90,10 +90,24 @@ class DiskMomentStore:
         self._maps[key] = pair
         return pair
 
-    def flush(self) -> None:
+    def flush(self, count: int | None = None) -> None:
         for mu, nu in self._maps.values():
             mu.flush()
             nu.flush()
+        if count is not None:
+            with open(os.path.join(self.dir, "count.json"), "w") as f:
+                json.dump({"count": int(count)}, f)
+
+    def count(self) -> int | None:
+        """The step count the moments were last flushed at (None = fresh
+        store). Lets resume detect a state/moments mismatch: restoring any
+        checkpoint other than the latest would otherwise silently pair an
+        old count with newer moments."""
+        path = os.path.join(self.dir, "count.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return int(json.load(f)["count"])
 
 
 class DiskOffloadedAdamW(NamedTuple):
@@ -223,5 +237,5 @@ def disk_streamed_update(
             nu[...] = nu_n
             out[...] = u.astype(out.dtype)
         updates.append(out)
-    tx.store.flush()
+    tx.store.flush(count=count)
     return jax.tree_util.tree_unflatten(treedef, updates)

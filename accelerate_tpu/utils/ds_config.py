@@ -200,7 +200,15 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
             "zero_optimization.offload_optimizer",
             offload_opt,
             # IO-engine tuning knobs: the memmap tier has no analog.
-            ignored=("pin_memory", "buffer_count", "fast_init", "ratio"),
+            ignored=(
+                "pin_memory",
+                "buffer_count",
+                "fast_init",
+                "ratio",
+                "pipeline",
+                "pipeline_read",
+                "pipeline_write",
+            ),
         )
         if device == "cpu":
             offload = True
@@ -401,11 +409,18 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
         dict(cfg.get("zero_optimization", {})).get("offload_optimizer", {}) or {}
     )
     offload = offload_block.get("device") == "cpu"
-    nvme_path = (
-        offload_block.get("nvme_path")
-        if offload_block.get("device") == "nvme"
-        else None
-    )
+    nvme_path = None
+    if offload_block.get("device") == "nvme":
+        nvme_path = offload_block.get("nvme_path")
+        if not nvme_path:
+            # Mirror accelerator_kwargs_from_deepspeed_config: silently
+            # handing back device-resident adamw would be the exact
+            # downgrade this module refuses.
+            raise ValueError(
+                "offload_optimizer.device='nvme' needs nvme_path (the "
+                "directory for the moment memmaps — DeepSpeed requires it "
+                "too)."
+            )
 
     lname = name.lower()
     if lname in ("adam", "adamw"):

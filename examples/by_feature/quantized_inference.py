@@ -1,0 +1,94 @@
+"""Feature example: int8 weight-only quantization + the int8 MXU compute path.
+
+Reference analog: bitsandbytes int8 inference (`utils/bnb.py:44`
+`load_and_quantize_model` — 8-bit weight storage, higher-precision compute).
+This framework goes two steps further, both TPU-native:
+
+1. weight-only int8/int4 with per-channel scales (`utils/quantization.py`) —
+   HBM holds packed weights, blocks dequantize per layer inside the scan;
+2. the int8 COMPUTE path (`ops/int8.py`): inside ``int8_compute()`` the
+   quantized matmuls run int8×int8→int32 directly on the MXU (~2× the bf16
+   rate on v5e) with dynamic per-token activation scales — the win for
+   compute-bound prefill and speculative verify. Wrap the jitted forward
+   with ``with_int8_compute`` so the int8 variant owns its trace.
+
+The example quantizes a small llama, runs greedy generation on the
+dequantize path and a prefill on the int8 MXU path, and reports the logit
+agreement between the two (the returned value; ~1.0 = the fast path is
+faithful).
+
+Run: python examples/by_feature/quantized_inference.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu.generation import GenerationConfig, Generator
+from accelerate_tpu.models import llama
+from accelerate_tpu.ops.int8 import with_int8_compute
+from accelerate_tpu.utils.quantization import quantize_pytree, quantized_nbytes
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--bits", type=int, default=8, choices=[4, 8])
+    parser.add_argument("--max_new_tokens", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    config = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(jax.random.PRNGKey(0), config)
+
+    # 1. Quantize: big matmul weights pack to int8/int4, embeddings/norms/
+    #    head stay full precision (the bnb skip-list trade).
+    before = quantized_nbytes(params)
+    qparams = quantize_pytree(params, min_size=512, bits=args.bits)
+    after = quantized_nbytes(qparams)
+    print(f"params: {before / 2**20:.1f} MiB -> {after / 2**20:.1f} MiB packed")
+
+    # 2. Generation works transparently on the quantized tree (per-layer
+    #    dequant inside the scan — the decode path is bandwidth-bound, so
+    #    weight-only is already the right trade there).
+    prompt = jnp.asarray(np.arange(32, dtype=np.int32).reshape(2, 16) % 128)
+    gen = Generator(
+        lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+        lambda b, m: llama.init_cache(config, b, m),
+        GenerationConfig(max_new_tokens=args.max_new_tokens),
+    )
+    out = gen(qparams, prompt)
+    print("generated:", np.asarray(out)[0].tolist())
+
+    # 3. Compute-bound prefill on the int8 MXU path: same quantized tree,
+    #    matmuls run int8×int8→int32 (only activation rounding differs).
+    def fwd(p, t):
+        return llama.forward(p, t, config)
+
+    logits_deq = jax.jit(fwd)(qparams, prompt).astype(jnp.float32)
+    logits_i8 = jax.jit(with_int8_compute(fwd))(qparams, prompt).astype(jnp.float32)
+    agree = float(
+        jnp.mean(
+            (jnp.argmax(logits_i8, -1) == jnp.argmax(logits_deq, -1)).astype(
+                jnp.float32
+            )
+        )
+    )
+    drift = float(
+        jnp.sqrt(jnp.mean((logits_i8 - logits_deq) ** 2))
+        / jnp.maximum(jnp.sqrt(jnp.mean(logits_deq**2)), 1e-9)
+    )
+    assert drift > 0.0, "int8 path did not engage (trace aliasing?)"
+    print(f"int8-MXU prefill: argmax agreement {agree:.3f}, logit drift {drift:.4f}")
+    return agree
+
+
+if __name__ == "__main__":
+    # 0.7 is the 4-bit bar (tests/test_examples.py); 8-bit typically ~0.94.
+    raise SystemExit(0 if main() > 0.7 else 1)

@@ -161,6 +161,29 @@ class TestPersistence:
         _, _, l_rest = _run(tx2, 2, state=state2, acc=acc2)
         np.testing.assert_allclose(l_first + l_rest, l_full, rtol=2e-4, atol=2e-5)
 
+    def test_stale_checkpoint_against_newer_moments_refused(self, tmp_path):
+        """Restoring any checkpoint other than the latest must fail loudly:
+        the moments on disk are ahead of the restored count, and silently
+        pairing them corrupts bias correction."""
+        from accelerate_tpu.state import AcceleratorState
+
+        d = str(tmp_path / "m")
+        ck = str(tmp_path / "ck")
+        acc, state, _ = _run(disk_offloaded_adamw(1e-2, offload_dir=d), 2)
+        acc.save_state(ck, state)  # checkpoint at step 2
+        _run(disk_offloaded_adamw(1e-2, offload_dir=d), 2, state=state, acc=acc)
+        # moments now at step 4; restore the step-2 checkpoint.
+        AcceleratorState._reset_state()
+        acc2 = atx.Accelerator(seed=0, max_grad_norm=1.0)
+        tx2 = disk_offloaded_adamw(1e-2, offload_dir=d)
+        state2 = acc2.create_train_state(lambda r: llama.init(r, CFG), tx2)
+        state2 = acc2.load_state(ck, state2)
+        step = acc2.make_train_step(
+            lambda p, b, r: llama.loss_fn(p, b, CFG, r), donate=False
+        )
+        with pytest.raises(ValueError, match="last written at step 4"):
+            step(state2, _batch())
+
     def test_wrong_model_shape_in_offload_dir_refused(self, tmp_path):
         d = str(tmp_path / "m")
         store = DiskMomentStore(d)
@@ -210,3 +233,24 @@ class TestGuards:
         }
         with pytest.raises(ValueError, match="nvme_path"):
             accelerator_kwargs_from_deepspeed_config(ds_bad)
+        # BOTH translators refuse — optax_from_deepspeed_config must not
+        # silently hand back device-resident adamw for the same config.
+        with pytest.raises(ValueError, match="nvme_path"):
+            optax_from_deepspeed_config(ds_bad)
+
+    def test_deepspeed_pipeline_offload_keys_tolerated(self, tmp_path):
+        from accelerate_tpu.utils.ds_config import (
+            accelerator_kwargs_from_deepspeed_config,
+        )
+
+        ds = {
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {
+                    "device": "cpu", "pin_memory": True, "pipeline_read": True,
+                },
+            },
+        }
+        with pytest.warns(UserWarning, match="pipeline_read"):
+            kw = accelerator_kwargs_from_deepspeed_config(ds)
+        assert kw["strategy"].offload_optimizer is True

@@ -131,6 +131,11 @@ def test_lm_example_learns_and_resumes(tmp_path):
         ("deepspeed_with_config_support", ["--steps", "60"], lambda r: r < 1.0),
         # bf16-compressed gradient all-reduce lands at the same optimum
         ("ddp_comm_hook", ["--steps", "30"], lambda r: r < 1e-2),
+        # int8-MXU prefill must agree with the dequantize path (argmax
+        # over 32 positions of an untrained tiny model — near-uniform
+        # logits make perfect agreement impossible by construction)
+        ("quantized_inference", [], lambda r: r > 0.8),
+        ("quantized_inference", ["--bits", "4"], lambda r: r > 0.7),
     ],
 )
 def test_by_feature_examples(name, args, check):
