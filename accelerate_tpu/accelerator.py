@@ -899,12 +899,14 @@ class Accelerator:
                     lambda p, u: optax.apply_updates(p, u),
                     donate_argnums=(0,) if donate else (),
                 )
-            if "resume_checked" not in _disk_jits:
-                # The memmaps are the optimizer checkpoint; pairing them
-                # with a state restored from any OTHER step would silently
-                # corrupt the bias correction (moments ahead of the count).
+            here = int(jax.device_get(state.step))
+            if _disk_jits.get("next_step") != here:
+                # First call, or the state's step jumped (a checkpoint was
+                # restored mid-run): the memmaps are the optimizer
+                # checkpoint, and pairing them with a state from any OTHER
+                # step silently corrupts the bias correction (moments ahead
+                # of the count). Steady-state steps skip the file read.
                 stored = state.tx.store.count()
-                here = int(jax.device_get(state.step))
                 if stored is not None and stored != here:
                     raise ValueError(
                         f"disk-offloaded moments in {state.tx.store.dir!r} "
@@ -913,12 +915,12 @@ class Accelerator:
                         "the matching checkpoint, or point offload_dir at a "
                         "fresh directory to restart the optimizer."
                     )
-                _disk_jits["resume_checked"] = True
             with jax.sharding.set_mesh(self.mesh):
                 grads, metrics, gs, aux = _disk_jits["grad"](
                     state.params, batch, state.step
                 )
-            count = int(jax.device_get(state.step)) + 1
+            count = here + 1
+            _disk_jits["next_step"] = count
             grad_scale = (
                 float(jax.device_get(gs)) if max_grad_norm is not None else None
             )

@@ -95,8 +95,13 @@ class DiskMomentStore:
             mu.flush()
             nu.flush()
         if count is not None:
-            with open(os.path.join(self.dir, "count.json"), "w") as f:
+            # Atomic replace: this rewrites every step, and a crash inside a
+            # plain open('w') would leave an empty file that blocks resume.
+            path = os.path.join(self.dir, "count.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump({"count": int(count)}, f)
+            os.replace(tmp, path)
 
     def count(self) -> int | None:
         """The step count the moments were last flushed at (None = fresh

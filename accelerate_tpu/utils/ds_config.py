@@ -110,6 +110,17 @@ def _auto(value: Any, default: Any) -> Any:
     return default if value == "auto" else value
 
 
+def _require_nvme_path(nvme_path: Any) -> str:
+    """Shared nvme validation for both translators — silently downgrading
+    to device-resident moments is the failure mode this module refuses."""
+    if not nvme_path:
+        raise ValueError(
+            "offload_optimizer.device='nvme' needs nvme_path (the directory "
+            "for the moment memmaps — DeepSpeed requires it too)."
+        )
+    return nvme_path
+
+
 def _check_params_block(
     block: str, leftover: dict, *, ignored: tuple[str, ...] = ()
 ) -> None:
@@ -217,12 +228,7 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
             # OPTIMIZER object (optax_from_deepspeed_config returns
             # disk_offloaded_adamw bound to nvme_path), not by the sharding
             # placement machinery — so `offload` stays False here.
-            if not nvme_path:
-                raise ValueError(
-                    "offload_optimizer.device='nvme' needs nvme_path (the "
-                    "directory for the moment memmaps — DeepSpeed requires "
-                    "it too)."
-                )
+            _require_nvme_path(nvme_path)
         elif device not in ("none",):
             raise ValueError(
                 f"offload_optimizer.device={device!r} is not supported; "
@@ -411,16 +417,7 @@ def optax_from_deepspeed_config(config: Any, *, total_num_steps: int | None = No
     offload = offload_block.get("device") == "cpu"
     nvme_path = None
     if offload_block.get("device") == "nvme":
-        nvme_path = offload_block.get("nvme_path")
-        if not nvme_path:
-            # Mirror accelerator_kwargs_from_deepspeed_config: silently
-            # handing back device-resident adamw would be the exact
-            # downgrade this module refuses.
-            raise ValueError(
-                "offload_optimizer.device='nvme' needs nvme_path (the "
-                "directory for the moment memmaps — DeepSpeed requires it "
-                "too)."
-            )
+        nvme_path = _require_nvme_path(offload_block.get("nvme_path"))
 
     lname = name.lower()
     if lname in ("adam", "adamw"):

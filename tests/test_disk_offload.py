@@ -184,6 +184,26 @@ class TestPersistence:
         with pytest.raises(ValueError, match="last written at step 4"):
             step(state2, _batch())
 
+    def test_rollback_mid_run_with_same_step_closure_refused(self, tmp_path):
+        """The guard must re-fire when the state's step JUMPS through the
+        same compiled step function (restore-older-checkpoint mid-run), not
+        only on the first call."""
+        d = str(tmp_path / "m")
+        ck = str(tmp_path / "ck")
+        acc = atx.Accelerator(seed=0, max_grad_norm=1.0)
+        tx = disk_offloaded_adamw(1e-2, offload_dir=d)
+        state = acc.create_train_state(lambda r: llama.init(r, CFG), tx)
+        step = acc.make_train_step(
+            lambda p, b, r: llama.loss_fn(p, b, CFG, r), donate=False
+        )
+        state, _ = step(state, _batch())
+        state, _ = step(state, _batch())
+        acc.save_state(ck, state)  # checkpoint at step 2
+        state, _ = step(state, _batch())  # moments now at step 3
+        rolled = acc.load_state(ck, state)  # roll back THROUGH the same step fn
+        with pytest.raises(ValueError, match="last written at step 3"):
+            step(rolled, _batch())
+
     def test_wrong_model_shape_in_offload_dir_refused(self, tmp_path):
         d = str(tmp_path / "m")
         store = DiskMomentStore(d)
