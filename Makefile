@@ -8,10 +8,15 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all
+.PHONY: test test-heavy test-all smoke-transfer
 
 test:
 	python -m pytest tests/ -q
+
+# Fast CPU smoke over the transfer-engine code paths (tiny arrays, no TPU):
+# the engine unit tests plus the disk-offload overlap/sentinel integration.
+smoke-transfer:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_transfer.py tests/test_disk_offload.py -q -m 'not slow'
 
 test-heavy:
 	python -m pytest tests/ -q -m heavy

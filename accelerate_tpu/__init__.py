@@ -32,6 +32,7 @@ from .local_sgd import (
 from .logging import get_logger
 from .parallel import MeshConfig, build_mesh
 from .parallel.disk_offload import disk_offloaded_adamw
+from .parallel.transfer import TransferEngine, get_transfer_engine
 from .parallel.host_offload import host_offloaded_adamw
 from .parallel.pipeline import Pipeline, llama_pipeline
 from .parallel.sharding import ShardingStrategy

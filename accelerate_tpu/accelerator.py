@@ -56,6 +56,7 @@ from .parallel.mesh import (
     MeshConfig,
     batch_sharding,
     data_parallel_size,
+    use_mesh,
 )
 from .parallel.sharding import (
     ShardingStrategy,
@@ -434,6 +435,22 @@ class Accelerator:
         (and the optimizer is offload-aware), a loud fallback otherwise.
         Records the host shardings for the train step's streaming path."""
         self._opt_host_shardings = None
+        if getattr(self.strategy, "offload_optimizer_device", None) == "nvme":
+            # The run configuration (e.g. a ds_config with
+            # offload_optimizer.device='nvme') requested the DISK tier,
+            # which rides the optimizer object — a plain optax optimizer
+            # here would silently train with device-resident moments, the
+            # exact downgrade the 'cpu' tier already refuses.
+            from .parallel.disk_offload import DiskOffloadedAdamW
+
+            if not isinstance(tx, DiskOffloadedAdamW):
+                raise ValueError(
+                    "offload_optimizer.device='nvme' was requested but the "
+                    "optimizer is not disk-offloaded; use "
+                    "disk_offloaded_adamw(..., offload_dir=<nvme_path>) (or "
+                    "optax_from_deepspeed_config, which builds it from the "
+                    "same ds_config) instead of a plain optax transformation."
+                )
         if not self.strategy.offload_optimizer:
             return opt_sh
         from .parallel import host_offload as _ho
@@ -511,6 +528,8 @@ class Accelerator:
 
     def prepare_train_state(self, state: TrainState) -> TrainState:
         """Shard an existing (host or single-device) TrainState onto the mesh."""
+        from .parallel.host_offload import place_opt_state as _ho_place
+
         params_shapes = jax.eval_shape(lambda: state.params)
         param_specs, opt_specs = self._resolve_specs(params_shapes, state.tx)
         loss_scale = state.loss_scale
@@ -531,9 +550,9 @@ class Accelerator:
                 state.step, NamedSharding(self.mesh, PartitionSpec())
             ),
             params=shard_pytree(state.params, param_specs, self.mesh),
-            opt_state=jax.tree.map(
-                lambda x, s: jax.device_put(x, s), state.opt_state, opt_sh
-            ),
+            # Chunked pooled placement (host-offloaded moments are the big
+            # case: GiBs of fp32 headed for pinned host RAM).
+            opt_state=_ho_place(state.opt_state, opt_sh),
             loss_scale=loss_scale,
         )
 
@@ -906,6 +925,8 @@ class Accelerator:
                 # checkpoint, and pairing them with a state from any OTHER
                 # step silently corrupts the bias correction (moments ahead
                 # of the count). Steady-state steps skip the file read.
+                # count() joins the overlapped flush from the previous step
+                # first, so the guard judges completed moments.
                 stored = state.tx.store.count()
                 if stored is not None and stored != here:
                     raise ValueError(
@@ -915,7 +936,7 @@ class Accelerator:
                         "the matching checkpoint, or point offload_dir at a "
                         "fresh directory to restart the optimizer."
                     )
-            with jax.sharding.set_mesh(self.mesh):
+            with use_mesh(self.mesh):
                 grads, metrics, gs, aux = _disk_jits["grad"](
                     state.params, batch, state.step
                 )
@@ -928,13 +949,18 @@ class Accelerator:
                 state.tx, grads, state.params, count, grad_scale
             )
             del grads
-            with jax.sharding.set_mesh(self.mesh):
-                # Each update leaf lands directly in its param's sharding —
-                # one flat device_put to the default device would commit the
-                # whole tree to one chip on a multi-chip mesh.
-                updates = jax.device_put(
-                    updates, jax.tree.map(lambda p: p.sharding, state.params)
-                )
+            # Each update leaf lands directly in its param's sharding —
+            # one flat device_put to the default device would commit the
+            # whole tree to one chip on a multi-chip mesh. The transfer
+            # engine streams the big stacked leaves in chunks from its
+            # worker pool instead of serializing behind one Python-level
+            # device_put per leaf.
+            from .parallel.transfer import get_transfer_engine
+
+            updates = get_transfer_engine().put_tree(
+                updates, jax.tree.map(lambda p: p.sharding, state.params)
+            ).result()
+            with use_mesh(self.mesh):
                 new_params = _disk_jits["apply"](state.params, updates)
             new_state = state.replace(
                 step=state.step + 1,
@@ -953,11 +979,11 @@ class Accelerator:
             # Trace (and run) under the ambient mesh so the model's
             # activation constraints (parallel.mesh.constrain_batch) bind to
             # this Accelerator's axes.
-            with jax.sharding.set_mesh(self.mesh):
+            with use_mesh(self.mesh):
                 return jitted(state, batch)
 
         def lower(*args: Any, **kwargs: Any):
-            with jax.sharding.set_mesh(self.mesh):
+            with use_mesh(self.mesh):
                 return jitted.lower(*args, **kwargs)
 
         # Keep the jit surface the HLO-verification tooling relies on.

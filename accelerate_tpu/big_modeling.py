@@ -453,18 +453,30 @@ def dispatch_leaves(
 
     ``leaf_override(plan_key, leaf, fetch)`` may return either a finished
     replacement leaf, or a ``(host_fn, place_fn)`` pair — the host stage
-    runs on the pipeline's IO worker, the place stage on the caller's
-    thread — or None to take the normal path.
+    runs on the pipeline's IO worker, the place stage on the shared
+    transfer engine's worker pool — or None to take the normal path.
 
-    The loop is a two-stage pipeline: while the caller's thread pushes leaf
-    i's bytes to the device(s), a worker thread is already reading and
+    The loop is a pipeline: while the transfer engine pushes leaf i's
+    bytes to the device(s) (chunked, multiple concurrent streams —
+    `parallel/transfer.py`), a worker thread is already reading and
     transforming leaf i+1 (and i+2). Loads through a slow device link are
-    then bounded by max(read+pack, transfer) instead of their sum —
-    measured 859 s -> the transfer roofline on the v5e 8B quantize-on-load
-    path. One worker, because the checkpoint source's lazy file handles are
-    not thread-safe; the read order also stays sequential, which is what
-    spinning-disk and network filesystems want."""
-    from concurrent.futures import ThreadPoolExecutor
+    then bounded by max(read+pack, transfer) instead of their sum, and the
+    transfer term itself is no longer serialized behind one Python-level
+    ``device_put`` call per leaf (BENCH_r05 measured that serialization at
+    23.9 MiB/s against a 2655.9 MiB/s disk). One IO worker, because the
+    checkpoint source's lazy file handles are not thread-safe; the read
+    order also stays sequential, which is what spinning-disk and network
+    filesystems want."""
+    from concurrent.futures import Future, ThreadPoolExecutor
+
+    from .parallel.transfer import get_transfer_engine
+
+    engine = get_transfer_engine()
+
+    def _done(value: Any) -> Future:
+        f: Future = Future()
+        f.set_result(value)
+        return f
 
     mesh = plan.mesh
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
@@ -480,8 +492,10 @@ def dispatch_leaves(
 
     def make_stages(path, leaf, spec):
         """-> (host_fn, place_fn): host_fn runs on the IO worker and returns
-        the staged host-side payload; place_fn consumes it on the caller's
-        thread (device transfers / identity for offload)."""
+        the staged host-side payload; place_fn consumes it and returns a
+        FUTURE of the finished leaf (device traffic rides the shared
+        transfer engine — chunked multi-stream H2D for fully-owned leaves,
+        pooled make_array for multi-host sharded ones)."""
         key = _path_str(path)
         shape = tuple(leaf.shape)
         target_dtype = np.dtype(dtype) if dtype is not None else np.dtype(leaf.dtype)
@@ -490,8 +504,9 @@ def dispatch_leaves(
             replaced = leaf_override(key, leaf, fetch)
             if replaced is not None:
                 if isinstance(replaced, tuple) and callable(replaced[0]):
-                    return replaced
-                return (lambda _r=replaced: _r), (lambda r: r)
+                    h, p = replaced
+                    return h, (lambda staged, _p=p: engine.submit(_p, staged))
+                return (lambda _r=replaced: _r), _done
         if key in plan.offload:
             if offload_dir is not None:
                 # Disk offload: the leaf never fully materializes in host
@@ -503,15 +518,16 @@ def dispatch_leaves(
                         offload_dir, key, shape, target_dtype, fetch,
                         fingerprint=source_id,
                     ),
-                    lambda r: r,
+                    _done,
                 )
             return (
                 lambda: np.asarray(
                     fetch(tuple(slice(0, d) for d in shape)), dtype=target_dtype
                 ),
-                lambda r: r,
+                _done,
             )
         sharding = NamedSharding(mesh, spec)
+        full_idx = tuple((0, d) for d in shape)
 
         def host_fn():
             # Prefetch exactly this process's addressable shard slices
@@ -527,8 +543,15 @@ def dispatch_leaves(
             return staged
 
         def place_fn(staged):
-            return jax.make_array_from_callback(
-                shape, sharding, lambda idx: staged[_norm(idx, shape)]
+            if set(staged.keys()) == {full_idx}:
+                # This process stages the whole leaf (single chip, or a
+                # replicated/one-slice layout): the chunked engine path
+                # replaces the single serialized device_put call.
+                return engine.put(staged[full_idx], sharding=sharding)
+            return engine.submit(
+                lambda: jax.make_array_from_callback(
+                    shape, sharding, lambda idx: staged[_norm(idx, shape)]
+                )
             )
 
         return host_fn, place_fn
@@ -537,24 +560,24 @@ def dispatch_leaves(
         make_stages(path, leaf, spec)
         for (path, leaf), spec in zip(flat, spec_leaves)
     ]
-    # Three-stage pipeline: one IO worker reads+packs ahead (sequential, the
-    # source's lazy handles are not thread-safe and disks want sequential
-    # reads), TWO placement workers push to the device concurrently (the
-    # remote-tunnel link serializes per call at ~50 MiB/s but aggregates to
-    # ~63 MiB/s with two streams — measured on the v5e tunnel), and the
-    # window keeps at most `depth` staged payloads + `window` un-finished
-    # placements alive so host RAM stays bounded.
-    depth, window = 2, 3
+    # Pipeline: one IO worker reads+packs ahead (sequential, the source's
+    # lazy handles are not thread-safe and disks want sequential reads);
+    # placement goes through the shared transfer engine, whose worker pool
+    # keeps several chunk streams in flight per leaf (the remote-tunnel
+    # link serializes per call at ~50 MiB/s but aggregates with concurrent
+    # streams — measured on the v5e tunnel). The window keeps at most
+    # `depth` staged payloads + `window` un-finished placements alive so
+    # host RAM stays bounded.
+    depth = max(2, engine.prefetch_depth)
+    window = depth + 1
     out: list = []
-    with ThreadPoolExecutor(max_workers=1) as io_ex, ThreadPoolExecutor(
-        max_workers=2
-    ) as put_ex:
+    with ThreadPoolExecutor(max_workers=1) as io_ex:
         host_futures = [io_ex.submit(h) for h, _p in stages[:depth]]
         place_futures: list = []
         for i, (_h, place) in enumerate(stages):
             if i + depth < len(stages):
                 host_futures.append(io_ex.submit(stages[i + depth][0]))
-            place_futures.append(put_ex.submit(place, host_futures[i].result()))
+            place_futures.append(place(host_futures[i].result()))
             host_futures[i] = None  # release the staged payload reference
             if i >= window:
                 place_futures[i - window].result()  # backpressure
@@ -565,8 +588,11 @@ def dispatch_leaves(
 # ------------------------------------------------------------- layer streaming
 def offload_blocks(blocks: Any) -> Any:
     """Move a stacked block pytree (leading layer axis on every leaf) to host
-    RAM (reference `cpu_offload`, `big_modeling.py:170`)."""
-    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), blocks)
+    RAM (reference `cpu_offload`, `big_modeling.py:170`). All leaves drain
+    concurrently through the transfer engine's D2H path."""
+    from .parallel.transfer import get_transfer_engine
+
+    return get_transfer_engine().get_tree(blocks).result()
 
 
 def streamed_scan(
@@ -576,33 +602,37 @@ def streamed_scan(
     *,
     sharding: Any | None = None,
     dtype: Any | None = None,
+    engine: Any | None = None,
+    prefetch_depth: int | None = None,
 ) -> Any:
     """Run ``carry = body(carry, block_i)`` over layer-stacked host-resident
-    blocks, streaming layer i+1 to device while layer i computes (the
-    `AlignDevicesHook` pre-forward staging pattern, reference `hooks.py:329`,
-    without forward monkey-patching — double buffering via async
-    `jax.device_put`).
+    blocks, streaming layers ahead of compute (the `AlignDevicesHook`
+    pre-forward staging pattern, reference `hooks.py:329`, without forward
+    monkey-patching).
 
-    ``host_blocks`` leaves are numpy arrays with a leading layer axis.
-    ``sharding`` optionally places staged layers (a pytree of NamedShardings
-    matching one layer, or a single sharding applied to every leaf).
+    Staging rides the shared transfer engine (`parallel/transfer.py`):
+    while layer *i* computes, layers *i+1..i+depth* are already in flight
+    — chunked ``device_put`` issued from the engine's worker pool, with
+    ``prefetch_depth`` (default ``ATX_TRANSFER_PREFETCH``, >= 2)
+    double-buffered device slots. Memmap-backed leaves (disk offload) have
+    their disk reads staged chunk-by-chunk through the same path, so the
+    read, the cast, and the H2D copy of layer *i+1* all overlap layer
+    *i*'s compute.
+
+    ``host_blocks`` leaves are numpy arrays (or memmaps) with a leading
+    layer axis. ``sharding`` optionally places staged layers (a pytree of
+    NamedShardings matching one layer, or a single sharding applied to
+    every leaf).
     """
+    from .parallel.transfer import get_transfer_engine
+
+    eng = engine if engine is not None else get_transfer_engine()
     n_layers = jax.tree.leaves(host_blocks)[0].shape[0]
 
     def stage(i: int) -> Any:
         layer = jax.tree.map(lambda x: x[i], host_blocks)
-        if dtype is not None:
-            layer = jax.tree.map(lambda x: x.astype(dtype), layer)
-        if sharding is None:
-            return jax.device_put(layer)
-        if isinstance(sharding, (NamedSharding, jax.sharding.Sharding)):
-            return jax.tree.map(lambda x: jax.device_put(x, sharding), layer)
-        return jax.tree.map(lambda x, s: jax.device_put(x, s), layer, sharding)
+        return eng.put_tree(layer, shardings=sharding, dtype=dtype)
 
-    next_block = stage(0)
-    for i in range(n_layers):
-        block = next_block
-        if i + 1 < n_layers:
-            next_block = stage(i + 1)  # async: dispatches before compute blocks
+    for block in eng.prefetch(n_layers, stage, depth=prefetch_depth):
         carry = body(carry, block)
     return carry

@@ -1184,7 +1184,8 @@ def _make_quantize_override(plan, bits):
             return None
 
         # (host_fn, place_fn) pair: dispatch_leaves runs the read+pack on
-        # its IO worker, overlapped with the previous leaf's device_put.
+        # its IO worker and the place stage on the transfer engine's
+        # pool, overlapped with the previous leaf's device traffic.
         def host_fn():
             return quantize_streaming(leaf, fetch, stack)
 
@@ -1198,7 +1199,8 @@ def _make_quantize_override(plan, bits):
             }
             # One pytree transfer per leaf: values + scales ride a single
             # device_put call instead of paying the link's per-call
-            # overhead once per array.
+            # overhead once per array (runs on a transfer-engine worker,
+            # so packed leaves stream concurrently).
             return jax.device_put(packed, shardings)
 
         return host_fn, place_fn

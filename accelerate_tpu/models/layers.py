@@ -93,7 +93,9 @@ def cache_positions(start: jax.Array, t_new: int, batch: int) -> jax.Array:
     ``start`` is the cache length cursor: a scalar (every row appends at the
     same offset — the plain decode contract) or shape (B,) (per-row offsets —
     speculative decoding commits a different number of tokens per row, so
-    rows advance independently)."""
+    rows advance independently). Plain Python ints are accepted (caches
+    built with host-side int lengths) and normalized here."""
+    start = jnp.asarray(start, jnp.int32)
     offs = jnp.arange(t_new, dtype=jnp.int32)[None, :]
     pos = (start[:, None] if start.ndim == 1 else start) + offs
     return jnp.broadcast_to(pos, (batch, t_new))
@@ -106,7 +108,8 @@ def cache_write(buf: jax.Array, new: jax.Array, start: jax.Array) -> jax.Array:
     Scalar ``start`` keeps the one-``dynamic_update_slice`` decode fast path;
     a (B,) ``start`` vmaps the update over rows (per-row write offsets lower
     to one scatter — the enabling primitive for per-row speculative commit
-    lengths)."""
+    lengths). Plain Python int ``start`` is normalized to a jnp scalar."""
+    start = jnp.asarray(start, jnp.int32)
     new = new.astype(buf.dtype)
     zeros = (0,) * (buf.ndim - 2)
     if start.ndim == 0:
@@ -124,6 +127,7 @@ def cache_write_stacked(
     `cache_write`). Returns (updated stacked buffer, updated (B, S, ...)
     layer) so carry-layout scan bodies can attend against the fresh layer
     without re-slicing. Shared by every family's carry cache path."""
+    start = jnp.asarray(start, jnp.int32)
     lead = (0,) * (all_buf.ndim - 1)
     full = (1,) + all_buf.shape[1:]
     if start.ndim == 1:

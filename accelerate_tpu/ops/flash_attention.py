@@ -757,11 +757,20 @@ def _make_bh_partitioned(inner, n_out: int, sharding_rule: str):
     wrapped = custom_partitioning(inner, static_argnums=tuple(range(
         _N_TENSORS[inner], _N_TENSORS[inner] + 6
     )))
-    wrapped.def_partition(
-        partition=partition,
-        infer_sharding_from_operands=infer,
-        sharding_rule=sharding_rule,
-    )
+    try:
+        wrapped.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer,
+            sharding_rule=sharding_rule,
+        )
+    except TypeError:
+        # jax < 0.5.x: def_partition has no sharding_rule (the einsum-like
+        # rule string newer shard_map tracing wants); the callbacks alone
+        # carry the same partitioning.
+        wrapped.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer,
+        )
     return wrapped
 
 
@@ -803,21 +812,39 @@ _bwd_p = _make_bh_partitioned(
 )
 
 
+def _call_partitioned(p_fn, inner, args):
+    try:
+        return p_fn(*args)
+    except TypeError:
+        # jax < 0.5: custom_partitioning passes its static_args as a LIST
+        # bind param, which is unhashable under shard_map tracing. A
+        # per-shard call is already partitioned by the enclosing shard_map,
+        # so the raw kernel is equivalent there.
+        return inner(*args)
+
+
 # --------------------------------------------------------------- entry point
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(q, k, v, scale, block, causal, interpret, valid, window):
-    o, _ = _fwd_p(q, k, v, scale, block, causal, interpret, valid, window)
+    o, _ = _call_partitioned(
+        _fwd_p, _fwd_tensors, (q, k, v, scale, block, causal, interpret, valid, window)
+    )
     return o
 
 
 def _flash_fwd(q, k, v, scale, block, causal, interpret, valid, window):
-    o, lse = _fwd_p(q, k, v, scale, block, causal, interpret, valid, window)
+    o, lse = _call_partitioned(
+        _fwd_p, _fwd_tensors, (q, k, v, scale, block, causal, interpret, valid, window)
+    )
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(scale, block, causal, interpret, valid, window, residuals, g):
     q, k, v, o, lse = residuals
-    return _bwd_p(q, k, v, o, lse, g, scale, block, causal, interpret, valid, window)
+    return _call_partitioned(
+        _bwd_p, _bwd_tensors,
+        (q, k, v, o, lse, g, scale, block, causal, interpret, valid, window),
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

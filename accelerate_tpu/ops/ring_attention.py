@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, SEQUENCE_AXIS
-from .in_jit import ring_neighbors
+from .in_jit import ring_neighbors, shard_map_over
 
 _NEG_INF = -1e30
 
@@ -370,8 +370,9 @@ def ring_attention(
         def fused(q, k, v):
             # custom_vjp nondiff args must be positional
             return _ring_fused(q, k, v, axis_name, causal, scale, block, interp)
-        shard_fused = jax.shard_map(
-            fused, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        shard_fused = shard_map_over(
+            fused, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
         )
         return shard_fused(q, k, v)
 
@@ -381,7 +382,7 @@ def ring_attention(
     )
     if kv_mask is not None:
         kv_mask = kv_mask.astype(bool)
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_over(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, mask_spec if kv_mask is not None else P()),

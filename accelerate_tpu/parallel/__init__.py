@@ -17,6 +17,7 @@ from .mesh import (
     single_device_mesh,
 )
 from .tp import get_tp_plan, list_tp_plans, register_tp_plan
+from .transfer import TransferEngine, get_transfer_engine
 from .pipeline import (
     Pipeline,
     build_pipeline,

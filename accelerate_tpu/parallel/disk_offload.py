@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, NamedTuple
 
 import jax
@@ -41,16 +42,114 @@ from .host_offload import _adamw_slice
 
 __all__ = ["DiskMomentStore", "DiskOffloadedAdamW", "disk_offloaded_adamw"]
 
+# In-flight async moment writebacks (flush + sentinel clear), keyed by the
+# store directory's realpath. A second store instance over the same dir
+# (checkpoint-resume tests, same-process handoff) joins the pending flush
+# before judging the dirty sentinel.
+_PENDING_WRITEBACK: dict[str, Any] = {}
+_PENDING_LOCK = threading.Lock()
+
 
 class DiskMomentStore:
     """fp32 adam moments as memmaps under ``offload_dir`` (one ``.mu.bin``/
     ``.nu.bin`` pair per param leaf, plus a manifest with shapes so a
-    restart can validate it is resuming the same model)."""
+    restart can validate it is resuming the same model).
+
+    Crash safety: `begin_update` writes a dirty sentinel (``dirty.json``)
+    BEFORE the first memmap mutation of a step and `end_update` removes it
+    after the flush — a process that dies mid-update leaves the sentinel
+    behind, and both resume (this constructor) and same-process retry
+    (`begin_update`) refuse while it is set. Without it, a crash between
+    two leaves would let a retry re-apply the update to already-written
+    moments (double-stepped mu/nu — round-5 advisor finding)."""
 
     def __init__(self, offload_dir: str) -> None:
         self.dir = offload_dir
         os.makedirs(offload_dir, exist_ok=True)
         self._maps: dict[str, tuple[np.memmap, np.memmap]] = {}
+        # Join any async flush still in flight over this dir before judging
+        # the sentinel (a clean in-progress writeback is not a crash).
+        self.wait_writeback()
+        self._refuse_if_dirty(resuming=True)
+
+    # ------------------------------------------------ dirty-sentinel guard
+    def _dirty_path(self) -> str:
+        return os.path.join(self.dir, "dirty.json")
+
+    def _refuse_if_dirty(self, resuming: bool) -> None:
+        path = self._dirty_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                at = json.load(f).get("count")
+        except ValueError:
+            at = "?"
+        raise ValueError(
+            f"disk-offloaded moments in {self.dir!r} carry a dirty sentinel: "
+            f"a moment update (toward step {at}) died mid-update, so some "
+            "leaves hold step-N moments and others step-N-1 — "
+            + ("resuming" if resuming else "retrying")
+            + " would re-apply the update to the already-written leaves "
+            "(double-stepped mu/nu). Point offload_dir at a fresh directory "
+            "to restart the optimizer, or restore a full checkpoint."
+        )
+
+    def begin_update(self, count: int) -> None:
+        """Mark the store dirty BEFORE the first memmap mutation of the
+        update toward ``count``; refuses if a previous update never
+        completed (crash or mid-update exception)."""
+        self._refuse_if_dirty(resuming=False)
+        path = self._dirty_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"count": int(count)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def end_update(self) -> None:
+        """Clear the dirty sentinel (the update fully hit the memmaps and
+        the flush completed)."""
+        try:
+            os.remove(self._dirty_path())
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------- async moment flush
+    def _pending_key(self) -> str:
+        return os.path.realpath(self.dir)
+
+    def wait_writeback(self) -> None:
+        """Join the in-flight async flush for this dir, re-raising any
+        writeback error here (the overlap contract: step N's flush must
+        complete — successfully — before step N+1 touches the moments)."""
+        with _PENDING_LOCK:
+            fut = _PENDING_WRITEBACK.pop(self._pending_key(), None)
+        if fut is not None:
+            fut.result()
+
+    def flush_async(self, count: int, engine: Any | None = None) -> None:
+        """`flush` + `end_update` on a transfer-engine worker so the msync
+        and count.json write overlap the NEXT step's compute instead of
+        blocking this one (the D2H-drain completion-future pattern —
+        `parallel/transfer.py`). `wait_writeback` joins it."""
+        from .transfer import get_transfer_engine
+
+        eng = engine if engine is not None else get_transfer_engine()
+
+        def _do():
+            self.flush(count=count)
+            self.end_update()
+
+        with _PENDING_LOCK:
+            prev = _PENDING_WRITEBACK.get(self._pending_key())
+            if prev is not None and not prev.done():
+                # Never reorder two writebacks over one dir.
+                fut = eng.submit(lambda: (prev.result(), _do())[1])
+            else:
+                fut = eng.submit(_do)
+            _PENDING_WRITEBACK[self._pending_key()] = fut
 
     def _paths(self, key: str) -> tuple[str, str, str]:
         safe = key.replace("/", "__")
@@ -107,7 +206,9 @@ class DiskMomentStore:
         """The step count the moments were last flushed at (None = fresh
         store). Lets resume detect a state/moments mismatch: restoring any
         checkpoint other than the latest would otherwise silently pair an
-        old count with newer moments."""
+        old count with newer moments. Joins any in-flight async flush first
+        so the answer reflects the latest completed update."""
+        self.wait_writeback()
         path = os.path.join(self.dir, "count.json")
         if not os.path.exists(path):
             return None
@@ -187,6 +288,8 @@ def disk_streamed_update(
     params: Any,
     count: int,
     grad_scale: float | None,
+    *,
+    overlap: bool | None = None,
 ) -> Any:
     """Host-side streamed adamw over disk-resident moments.
 
@@ -194,8 +297,29 @@ def disk_streamed_update(
     -process constraint is checked by the caller); returns a pytree of
     numpy UPDATES (same structure/dtype as params) for the caller to apply
     on device. Layer-stacked leaves stream one layer at a time, so peak
-    host RAM is one layer's (grad + 2 moments); moments hit the memmaps
-    (page cache -> disk) as they are produced."""
+    host RAM is a small window of layers' (grad + 2 moments); moments hit
+    the memmaps (page cache -> disk) as they are produced.
+
+    Overlap mode (default ON — ``ATX_OFFLOAD_OVERLAP``, see
+    `parallel/transfer.py`): the D2H drain of slice *i+1*'s grad/param
+    runs on the transfer engine's workers while slice *i*'s numpy math
+    executes, and the final memmap flush + count bump is handed to a
+    writeback worker whose completion future the NEXT update joins — so
+    the msync overlaps step N+1's compiled grad pass instead of blocking
+    step N. The math (and therefore the moments) is bit-identical with
+    overlap on or off: the same slices run the same ops in the same
+    order; only the scheduling moves (tested)."""
+    from .transfer import get_transfer_engine, overlap_enabled
+
+    do_overlap = overlap_enabled() if overlap is None else bool(overlap)
+    engine = get_transfer_engine()
+    # Step N-1's async flush must have COMPLETED (successfully) before this
+    # update reads or mutates the memmaps; its errors re-raise here.
+    tx.store.wait_writeback()
+    # Dirty sentinel BEFORE the first memmap mutation: a crash anywhere in
+    # the loop below leaves it set, and resume/retry refuse loudly instead
+    # of re-applying the update to already-written leaves.
+    tx.store.begin_update(count)
     # One host float per step: a schedule returns a jax scalar, and letting
     # it into the numpy slice math would silently promote every slice to a
     # device op (round-tripping each layer through the slow link twice —
@@ -208,31 +332,58 @@ def disk_streamed_update(
     c = np.float32(count)
     flat_g, treedef = jax.tree_util.tree_flatten_with_path(grads)
     flat_p = jax.tree.leaves(params)
-    updates = []
-    for (path, g), p in zip(flat_g, flat_p):
+
+    # Flat worklist of (leaf index, layer index | None) slices spanning ALL
+    # leaves, so the D2H prefetch pipelines across leaf boundaries too.
+    jobs: list[tuple[int, int | None]] = []
+    opened: list[tuple[np.memmap, np.memmap]] = []
+    stacked_flags: list[bool] = []
+    updates: list[np.ndarray] = []
+    for li, ((path, g), p) in enumerate(zip(flat_g, flat_p)):
         key = _key(path)
-        mu, nu = tx.store.open(key, tuple(g.shape))
+        opened.append(tx.store.open(key, tuple(g.shape)))
         stacked = (
             len(path) > 0
             and getattr(path[0], "key", None) in tx.stacked_paths
             and g.ndim >= 2
         )
-        out = np.empty(g.shape, dtype=np.dtype(p.dtype))
+        stacked_flags.append(stacked)
+        updates.append(np.empty(g.shape, dtype=np.dtype(p.dtype)))
         if stacked:
-            for i in range(g.shape[0]):
-                g_i = np.asarray(jax.device_get(g[i]), np.float32)
-                p_i = np.asarray(jax.device_get(p[i]), np.float32)
-                u_i, mu_i, nu_i = _adamw_slice(
-                    g_i, mu[i], nu[i], p_i, c, lr_t,
-                    tx.b1, tx.b2, tx.eps, tx.weight_decay,
-                    grad_scale=grad_scale, xp=np,
-                )
-                mu[i] = mu_i
-                nu[i] = nu_i
-                out[i] = u_i.astype(out.dtype)
+            jobs.extend((li, i) for i in range(g.shape[0]))
         else:
-            g_h = np.asarray(jax.device_get(g), np.float32)
-            p_h = np.asarray(jax.device_get(p), np.float32)
+            jobs.append((li, None))
+
+    def fetch(job: tuple[int, int | None]) -> tuple[np.ndarray, np.ndarray]:
+        li, i = job
+        g, p = flat_g[li][1], flat_p[li]
+        if i is not None:
+            g, p = g[i], p[i]
+        return (
+            np.asarray(jax.device_get(g), np.float32),
+            np.asarray(jax.device_get(p), np.float32),
+        )
+
+    if do_overlap:
+        fetched = engine.prefetch(
+            len(jobs), lambda idx: engine.submit(fetch, jobs[idx])
+        )
+    else:
+        fetched = (fetch(job) for job in jobs)
+
+    for (li, i), (g_h, p_h) in zip(jobs, fetched):
+        mu, nu = opened[li]
+        out = updates[li]
+        if i is not None:
+            u_i, mu_i, nu_i = _adamw_slice(
+                g_h, mu[i], nu[i], p_h, c, lr_t,
+                tx.b1, tx.b2, tx.eps, tx.weight_decay,
+                grad_scale=grad_scale, xp=np,
+            )
+            mu[i] = mu_i
+            nu[i] = nu_i
+            out[i] = u_i.astype(out.dtype)
+        else:
             u, mu_n, nu_n = _adamw_slice(
                 g_h, mu[...], nu[...], p_h, c, lr_t,
                 tx.b1, tx.b2, tx.eps, tx.weight_decay,
@@ -241,6 +392,12 @@ def disk_streamed_update(
             mu[...] = mu_n
             nu[...] = nu_n
             out[...] = u.astype(out.dtype)
-        updates.append(out)
-    tx.store.flush(count=count)
+
+    if do_overlap:
+        # msync + count bump + sentinel clear overlap step N+1's compute;
+        # the next update (or the next store over this dir) joins it.
+        tx.store.flush_async(count=count, engine=engine)
+    else:
+        tx.store.flush(count=count)
+        tx.store.end_update()
     return jax.tree_util.tree_unflatten(treedef, updates)

@@ -52,6 +52,20 @@ def offload_requested_from_env() -> bool:
     return parse_flag_from_env("ATX_OFFLOAD_OPTIMIZER")
 
 
+def place_opt_state(opt_state: Any, shardings: Any, engine: Any | None = None) -> Any:
+    """Move a concrete optimizer-state pytree onto its (pinned-host)
+    shardings through the shared transfer engine (`parallel/transfer.py`):
+    big moment leaves stream in chunks from the worker pool instead of one
+    blocking ``jax.device_put`` per leaf. Used by
+    `Accelerator.prepare_train_state` when restoring host-offloaded state —
+    the Python-level sibling of the in-jit streamed update below (which XLA
+    already overlaps with compute)."""
+    from .transfer import get_transfer_engine
+
+    eng = engine if engine is not None else get_transfer_engine()
+    return eng.put_tree(opt_state, shardings).result()
+
+
 def host_opt_shardings(opt_shapes: Any, opt_shardings: Any) -> Any:
     """Placement for offloaded optimizer state: float leaves (the moments)
     move to pinned host; integer leaves (adam's step count) stay in device

@@ -155,6 +155,32 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context manager: ``jax.sharding.set_mesh`` where it
+    exists (jax >= 0.5.x), the legacy ``with mesh:`` context on older jax —
+    one call site, both jax generations."""
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
+def ambient_mesh():
+    """The active ambient mesh, or None. ``jax.sharding.get_abstract_mesh``
+    on new jax; the thread-resources physical mesh on 0.4.x (private path,
+    so failures degrade to "no ambient mesh" instead of crashing)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        return get()
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        return None
+
+
 def constrain_batch(x: jax.Array) -> jax.Array:
     """Pin dim 0 of an activation to the global batch axes when an ambient
     mesh is active (``jax.sharding.set_mesh`` — `Accelerator.make_train_step`
@@ -165,7 +191,7 @@ def constrain_batch(x: jax.Array) -> jax.Array:
     attention activations into 34 GiB-per-chip buffers (caught by
     tests/test_pod_aot.py). Explicit activation annotation is the standard
     TPU recipe: pick a mesh, annotate, let XLA insert the collectives."""
-    am = jax.sharding.get_abstract_mesh()
+    am = ambient_mesh()
     if am is None or not am.axis_names:
         return x
     axes = tuple(a for a in BATCH_AXES if a in am.axis_names and am.shape[a] > 1)

@@ -117,7 +117,11 @@ def build_pipeline(
             try:
                 return jax.lax.pcast(x, (STAGE_AXIS,), to="varying")
             except (AttributeError, TypeError):  # pragma: no cover - jax version
-                return jax.lax.pvary(x, (STAGE_AXIS,))
+                pvary = getattr(jax.lax, "pvary", None)
+                # jax < 0.5 has neither pcast nor pvary; its shard_map runs
+                # without replication typing (check_rep=False here), so the
+                # marker is a no-op there.
+                return pvary(x, (STAGE_AXIS,)) if pvary is not None else x
 
         cur0 = _varying(jnp.zeros(mb_all.shape[1:], mb_all.dtype))
         out0 = _varying(jnp.zeros_like(mb_all))
@@ -125,11 +129,16 @@ def build_pipeline(
         # Only the last stage holds real outputs; replicate to all.
         return jax.lax.psum(jnp.where(s == n_stages - 1, out, 0), STAGE_AXIS)
 
-    sharded = shard_map(
+    from ..ops.in_jit import shard_map_over
+
+    # check_vma=False: the stage-varying carries and the final psum are
+    # deliberate; old jax's replication checker has no rule for them anyway.
+    sharded = shard_map_over(
         schedule,
         mesh=mesh,
         in_specs=(PartitionSpec(STAGE_AXIS), PartitionSpec()),
         out_specs=PartitionSpec(),
+        check_vma=False,
     )
     return jax.jit(sharded)
 

@@ -49,6 +49,13 @@ class ShardingStrategy:
     zero1_axes: tuple[str, ...] = BATCH_AXES
     # Optimizer moments in pinned host RAM (parallel/host_offload.py).
     offload_optimizer: bool = False
+    # Which offload tier the run configuration REQUESTED ("cpu" | "nvme" |
+    # None). Recorded so create_train_state can refuse an optimizer that
+    # does not match the request — the 'cpu' tier always had this
+    # cross-check (HostOffloadedAdamW required); 'nvme' rides the optimizer
+    # object (disk_offloaded_adamw), so without this field a plain optax
+    # adamw would silently train with device-resident moments.
+    offload_optimizer_device: str | None = None
 
     @classmethod
     def resolve(cls, strategy: Any, rules: Rules = ()) -> "ShardingStrategy":
@@ -264,6 +271,11 @@ def to_named_shardings(spec_tree: Any, mesh: Mesh) -> Any:
 
 
 def shard_pytree(tree: Any, spec_tree: Any, mesh: Mesh) -> Any:
-    """Place a concrete pytree onto the mesh per the spec tree."""
+    """Place a concrete pytree onto the mesh per the spec tree. Rides the
+    shared transfer engine: host-resident leaves stream in pinned chunks
+    from a worker pool instead of one blocking ``device_put`` per leaf
+    (`parallel/transfer.py`); device-resident leaves reshard as before."""
+    from .transfer import get_transfer_engine
+
     shardings = to_named_shardings(spec_tree, mesh)
-    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+    return get_transfer_engine().put_tree(tree, shardings).result()

@@ -203,6 +203,7 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
             stacklevel=2,
         )
     offload = False
+    offload_device: str | None = None
     if offload_opt is not None:
         offload_opt = dict(offload_opt)
         device = offload_opt.pop("device", "none")
@@ -223,12 +224,18 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
         )
         if device == "cpu":
             offload = True
+            offload_device = "cpu"
         elif device == "nvme":
             # ZeRO-Infinity NVMe tier: moments live on disk. Handled by the
             # OPTIMIZER object (optax_from_deepspeed_config returns
             # disk_offloaded_adamw bound to nvme_path), not by the sharding
-            # placement machinery — so `offload` stays False here.
+            # placement machinery — so `offload` stays False here. The
+            # REQUEST is still recorded on the strategy
+            # (offload_optimizer_device) so create_train_state fails loudly
+            # when handed a non-disk-offloaded optimizer, exactly as the
+            # cpu tier refuses a non-streamable one.
             _require_nvme_path(nvme_path)
+            offload_device = "nvme"
         elif device not in ("none",):
             raise ValueError(
                 f"offload_optimizer.device={device!r} is not supported; "
@@ -244,8 +251,12 @@ def accelerator_kwargs_from_deepspeed_config(config: Any) -> dict[str, Any]:
     }.get(int(stage))
     if kind is None:
         raise ValueError(f"zero_optimization.stage={stage!r} is not a DeepSpeed stage.")
-    if kind != ShardingStrategyType.DATA_PARALLEL or offload:
-        kwargs["strategy"] = ShardingStrategy(kind=kind, offload_optimizer=offload)
+    if kind != ShardingStrategyType.DATA_PARALLEL or offload_device is not None:
+        kwargs["strategy"] = ShardingStrategy(
+            kind=kind,
+            offload_optimizer=offload,
+            offload_optimizer_device=offload_device,
+        )
 
     fp16 = dict(cfg.get("fp16", {}))
     fp16_enabled = _auto(fp16.pop("enabled", False), False)

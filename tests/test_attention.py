@@ -13,7 +13,7 @@ from accelerate_tpu import MeshConfig
 from accelerate_tpu.models.layers import dot_product_attention
 from accelerate_tpu.ops.flash_attention import flash_attention
 from accelerate_tpu.ops.ring_attention import ring_attention
-from accelerate_tpu.parallel.mesh import build_mesh
+from accelerate_tpu.parallel.mesh import build_mesh, use_mesh
 
 
 def _qkv(rng, B=2, S=128, H=4, K=2, h=32, dtype=jnp.float32):
@@ -342,7 +342,7 @@ def test_flash_partitions_under_jit():
     kd = jax.device_put(k, kvsh)
     vd = jax.device_put(v, kvsh)
 
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(qd, kd, vd)
     expected = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-3, rtol=2e-2)
@@ -353,7 +353,7 @@ def test_flash_partitions_under_jit():
     def loss(a, b, c):
         return jnp.sum(flash_attention(a, b, c, causal=True) ** 2)
 
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         g = jax.jit(jax.grad(loss))(qd, kd, vd)
     g_ref = jax.grad(lambda a, b, c: jnp.sum(dot_product_attention(a, b, c, causal=True) ** 2))(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=5e-3, rtol=5e-2)

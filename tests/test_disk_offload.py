@@ -8,6 +8,8 @@ the optimizer checkpoint (restart resumes bit-continuously), and sharded
 multi-process params are refused loudly.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -210,6 +212,107 @@ class TestPersistence:
         store.open("blocks/attn/wq", (3, 3))
         with pytest.raises(ValueError, match="different model"):
             DiskMomentStore(d).open("blocks/attn/wq", (4, 4))
+
+
+class TestOverlap:
+    """The transfer-engine overlap mode (`parallel/transfer.py`,
+    ``ATX_OFFLOAD_OVERLAP`` — ON by default): step N's moment D2H prefetch
+    and flush overlap step N+1's compute. Scheduling only — the moments on
+    disk must be BIT-identical with overlap on vs off."""
+
+    def test_overlap_on_off_bit_identical_moments(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("ATX_OFFLOAD_OVERLAP", raising=False)
+        da, db = str(tmp_path / "on"), str(tmp_path / "off")
+        _, s_on, l_on = _run(disk_offloaded_adamw(1e-2, offload_dir=da), 4)
+        monkeypatch.setenv("ATX_OFFLOAD_OVERLAP", "0")
+        _, s_off, l_off = _run(disk_offloaded_adamw(1e-2, offload_dir=db), 4)
+        np.testing.assert_array_equal(np.asarray(l_on), np.asarray(l_off))
+        for a, b in zip(jax.tree.leaves(s_on.params), jax.tree.leaves(s_off.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Opening a fresh store joins the pending async flush, so the files
+        # below are final. Every moment byte must match.
+        DiskMomentStore(da)
+        bins = sorted(n for n in os.listdir(da) if n.endswith(".bin"))
+        assert bins and bins == sorted(
+            n for n in os.listdir(db) if n.endswith(".bin")
+        )
+        for name in bins:
+            np.testing.assert_array_equal(
+                np.fromfile(os.path.join(da, name), np.float32),
+                np.fromfile(os.path.join(db, name), np.float32),
+            )
+
+    def test_overlap_flush_lands_before_next_store_reads(self, tmp_path):
+        d = str(tmp_path / "m")
+        _, _, _ = _run(disk_offloaded_adamw(1e-2, offload_dir=d), 2)
+        # A fresh store over the same dir (the restart path) must see the
+        # overlapped step-2 flush completed: count.json at 2, no sentinel.
+        store = DiskMomentStore(d)
+        assert store.count() == 2
+        assert not os.path.exists(os.path.join(d, "dirty.json"))
+
+
+class TestDirtySentinel:
+    """Crash mid-update (round-5 advisor finding): the sentinel is written
+    BEFORE the first memmap mutation, so a died update leaves mixed
+    step-N/step-N-1 moments behind — resume and retry must refuse instead
+    of re-applying the update to already-written leaves."""
+
+    def _step_setup(self, d):
+        acc = atx.Accelerator(seed=0, max_grad_norm=1.0)
+        tx = disk_offloaded_adamw(1e-2, offload_dir=d)
+        state = acc.create_train_state(lambda r: llama.init(r, CFG), tx)
+        step = acc.make_train_step(
+            lambda p, b, r: llama.loss_fn(p, b, CFG, r), donate=False
+        )
+        return state, step
+
+    def test_crash_mid_update_refuses_retry_and_resume(self, tmp_path, monkeypatch):
+        import accelerate_tpu.parallel.disk_offload as dmod
+
+        d = str(tmp_path / "m")
+        state, step = self._step_setup(d)
+        state, _ = step(state, _batch())  # one healthy step
+
+        real = dmod._adamw_slice
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            if calls["n"] >= 2:  # die AFTER the first slice already wrote
+                raise RuntimeError("synthetic crash")
+            return real(*a, **k)
+
+        monkeypatch.setattr(dmod, "_adamw_slice", boom)
+        with pytest.raises(RuntimeError, match="synthetic crash"):
+            step(state, _batch())
+        monkeypatch.setattr(dmod, "_adamw_slice", real)
+        # Same-process retry: refused (some leaves already hold the update).
+        with pytest.raises(ValueError, match="mid-update"):
+            step(state, _batch())
+        # Fresh-process resume over the same dir: refused at construction.
+        with pytest.raises(ValueError, match="mid-update"):
+            disk_offloaded_adamw(1e-2, offload_dir=d)
+
+    def test_sentinel_written_before_first_mutation(self, tmp_path, monkeypatch):
+        import accelerate_tpu.parallel.disk_offload as dmod
+
+        d = str(tmp_path / "m")
+        state, step = self._step_setup(d)
+
+        def boom(*a, **k):  # die before ANY slice math
+            assert os.path.exists(os.path.join(d, "dirty.json"))
+            raise RuntimeError("first-slice crash")
+
+        monkeypatch.setattr(dmod, "_adamw_slice", boom)
+        with pytest.raises(RuntimeError, match="first-slice crash"):
+            step(state, _batch())
+
+    def test_clean_runs_leave_no_sentinel(self, tmp_path):
+        d = str(tmp_path / "m")
+        _run(disk_offloaded_adamw(1e-2, offload_dir=d), 2)
+        DiskMomentStore(d)  # joins the async flush; must not raise
+        assert not os.path.exists(os.path.join(d, "dirty.json"))
 
 
 class TestGuards:

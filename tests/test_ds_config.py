@@ -45,6 +45,61 @@ class TestStrategyMapping:
         })
         assert kw["strategy"].offload_optimizer
 
+    def test_nvme_request_recorded_on_strategy(self, tmp_path):
+        # Even at stage 0 the nvme request must survive into the strategy:
+        # it is the cross-check create_train_state uses to refuse a
+        # non-disk-offloaded optimizer (the cpu tier's HostOffloadedAdamW
+        # requirement, disk flavored).
+        kw = _kw({
+            "zero_optimization": {
+                "stage": 0,
+                "offload_optimizer": {
+                    "device": "nvme", "nvme_path": str(tmp_path / "nv")
+                },
+            }
+        })
+        assert kw["strategy"].offload_optimizer_device == "nvme"
+        # nvme rides the optimizer object, not the placement machinery.
+        assert kw["strategy"].offload_optimizer is False
+        kw_cpu = _kw({
+            "zero_optimization": {
+                "stage": 2, "offload_optimizer": {"device": "cpu"}
+            }
+        })
+        assert kw_cpu["strategy"].offload_optimizer_device == "cpu"
+
+    def test_nvme_request_refuses_plain_optimizer_at_create_train_state(
+        self, tmp_path
+    ):
+        import optax
+
+        import accelerate_tpu as atx
+        from accelerate_tpu.models import llama
+        from accelerate_tpu.parallel.disk_offload import disk_offloaded_adamw
+
+        kw = _kw({
+            "zero_optimization": {
+                "stage": 0,
+                "offload_optimizer": {
+                    "device": "nvme", "nvme_path": str(tmp_path / "nv")
+                },
+            }
+        })
+        cfg = llama.LlamaConfig.tiny(vocab_size=64, n_layers=2)
+        acc = atx.Accelerator(seed=0, **kw)
+        with pytest.raises(ValueError, match="disk_offloaded_adamw"):
+            acc.create_train_state(
+                lambda r: llama.init(r, cfg), optax.adamw(1e-3)
+            )
+        # The matching optimizer sails through the same path.
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        acc2 = atx.Accelerator(seed=0, **kw)
+        tx = disk_offloaded_adamw(1e-3, offload_dir=str(tmp_path / "nv"))
+        state = acc2.create_train_state(lambda r: llama.init(r, cfg), tx)
+        assert set(state.opt_state.keys()) == {"count"}
+
     def test_param_offload_refused(self):
         with pytest.raises(ValueError, match="offload_param"):
             _kw({"zero_optimization": {"stage": 3,

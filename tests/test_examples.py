@@ -7,7 +7,7 @@ import os
 
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # subprocess example runs; excluded from the tier-1 smoke lane
 
 from launch_helpers import REPO_ROOT, launch
 

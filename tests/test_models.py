@@ -13,7 +13,7 @@ import numpy as np
 import optax
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # model-zoo forward parity compiles; excluded from the tier-1 smoke lane
 
 from accelerate_tpu import Accelerator, MeshConfig
 from accelerate_tpu.models import bert, gpt, llama, t5, vit

@@ -7,7 +7,7 @@ import numpy as np
 import optax
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # MoE compiles; excluded from the tier-1 smoke lane
 
 from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.models import llama

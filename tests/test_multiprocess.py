@@ -15,7 +15,7 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # real multi-process launches; excluded from the tier-1 smoke lane
 
 from launch_helpers import REPO_ROOT, assert_all_ranks, clean_env, free_port, launch
 

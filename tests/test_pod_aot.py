@@ -19,11 +19,12 @@ import numpy as np
 import optax
 import pytest
 
-pytestmark = pytest.mark.heavy  # multi-minute XLA compiles
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # multi-minute XLA compiles; excluded from the tier-1 smoke lane
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from accelerate_tpu.models import llama
+from accelerate_tpu.parallel.mesh import use_mesh
 from accelerate_tpu.parallel.sharding import (
     ShardingStrategy,
     infer_opt_specs,
@@ -95,7 +96,7 @@ def _aot_train_step(mesh: Mesh, rules=()):
                      opt_shapes, opt_sh),
         jax.ShapeDtypeStruct((n, 4096), jnp.int32, sharding=batch_sh),
     )
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         lowered = jax.jit(
             step,
             out_shardings=(param_sh, opt_sh, NamedSharding(mesh, PartitionSpec())),
@@ -177,7 +178,7 @@ def test_70b_generate_decode_step_fits_v5e_32():
         jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
                      cache_shapes, cache_sh),
     )
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         compiled = jax.jit(decode_step, donate_argnums=(2,)).lower(*arg_shapes).compile()
     mem = compiled.memory_analysis()
     per_chip = (

@@ -9,7 +9,7 @@ import numpy as np
 import optax
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # mixed-precision compiles; excluded from the tier-1 smoke lane
 
 from accelerate_tpu.accelerator import Accelerator, DynamicLossScale, TrainState
 from accelerate_tpu.test_utils.training import (

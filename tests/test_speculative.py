@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.heavy  # compile-heavy lane
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]  # speculative-decode compiles; excluded from the tier-1 smoke lane
 
 from accelerate_tpu.generation import GenerationConfig, Generator
 from accelerate_tpu.models import gpt, llama
