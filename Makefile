@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer
+.PHONY: test test-heavy test-all smoke-transfer lint-graph
 
 test:
 	python -m pytest tests/ -q
@@ -18,8 +18,16 @@ test:
 smoke-transfer:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_transfer.py tests/test_disk_offload.py -q -m 'not slow'
 
+# Ahead-of-time step lint over the examples/ entry points (no training, no
+# weights): fails on any error-severity finding (docs/static_analysis.md).
+# The 8 simulated host devices give the sharding/collective rules a real
+# mesh to check against.
+lint-graph:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint examples --severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all:
+test-all: lint-graph
 	python -m pytest tests/ -q --heavy

@@ -10,6 +10,8 @@ checkpointing, trackers) mirrors the reference's feature set.
 __version__ = "0.1.0"
 
 from .accelerator import Accelerator, DynamicLossScale, TrainState
+from . import analysis
+from .analysis import AnalysisWarning, LintError, lint_step, lint_training
 from .big_modeling import (
     ShardingPlan,
     infer_sharding_plan,

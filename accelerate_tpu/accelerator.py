@@ -319,23 +319,56 @@ class Accelerator:
         return self.process_state.main_process_first()
 
     # -------------------------------------------------------------- prepare
-    def prepare(self, *args: Any) -> Any:
+    def prepare(self, *args: Any, lint: str | None = None) -> Any:
         """Polymorphic prepare (reference `prepare`, `accelerator.py:1283`).
 
         Dispatch per object type (`_prepare_one`, reference :1266-1281):
         `DataLoader` -> mesh-bound loader; `TrainState` -> sharded onto the
         mesh; optax `GradientTransformation` and schedules pass through
         (they live inside the jitted step). Returns objects in input order.
+
+        ``lint`` runs the ahead-of-time sharding analyzer (ATX1xx family,
+        docs/static_analysis.md) over each TrainState's planned specs
+        BEFORE any buffer moves: ``"warn"`` surfaces findings as
+        `AnalysisWarning`s, ``"error"`` raises `LintError` on
+        error-severity findings (e.g. a spec axis missing from the mesh),
+        ``"off"`` (default) skips. The ``ATX_LINT`` env var supplies the
+        default so a launcher can turn it on fleet-wide.
         """
-        prepared = tuple(self._prepare_one(a) for a in args)
+        mode = self._resolve_lint_mode(lint)
+        prepared = tuple(self._prepare_one(a, lint=mode) for a in args)
         return prepared[0] if len(prepared) == 1 else prepared
 
-    def _prepare_one(self, obj: Any) -> Any:
+    def _prepare_one(self, obj: Any, lint: str = "off") -> Any:
         if isinstance(obj, DataLoader):
             return self._prepare_data_loader_obj(obj)
         if isinstance(obj, TrainState):
-            return self.prepare_train_state(obj)
+            return self.prepare_train_state(obj, lint=lint)
         return obj
+
+    @staticmethod
+    def _resolve_lint_mode(lint: str | None) -> str:
+        import os
+
+        mode = lint if lint is not None else os.environ.get("ATX_LINT") or "off"
+        if mode not in ("off", "warn", "error"):
+            raise ValueError(
+                f"lint={mode!r}: expected 'off', 'warn', or 'error' "
+                "(or unset ATX_LINT)"
+            )
+        return mode
+
+    def _dispatch_lint(self, report: Any, mode: str) -> None:
+        """Route lint findings per mode: raise on errors under "error",
+        everything else becomes an `AnalysisWarning`."""
+        import warnings
+
+        from .analysis import AnalysisWarning, LintError, Severity
+
+        if mode == "error" and report.has_errors:
+            raise LintError(report.findings)
+        for finding in report.filter(Severity.WARNING):
+            warnings.warn(finding.format(), AnalysisWarning, stacklevel=3)
 
     def _prepare_data_loader_obj(self, dl: DataLoader) -> DataLoader:
         dl._rebind(self.mesh, self.dataloader_config)
@@ -526,9 +559,27 @@ class Accelerator:
             loss_scale=self._maybe_loss_scale(),
         )
 
-    def prepare_train_state(self, state: TrainState) -> TrainState:
-        """Shard an existing (host or single-device) TrainState onto the mesh."""
+    def prepare_train_state(self, state: TrainState, *, lint: str | None = None) -> TrainState:
+        """Shard an existing (host or single-device) TrainState onto the mesh.
+
+        ``lint`` ("off"|"warn"|"error", default from ``ATX_LINT``) runs the
+        sharding analyzer over the planned specs first — a bad spec is
+        caught here, before GiBs start moving, not three hours into a pod
+        run (see `prepare`)."""
         from .parallel.host_offload import place_opt_state as _ho_place
+
+        mode = self._resolve_lint_mode(lint)
+        if mode != "off":
+            from . import analysis
+
+            report = analysis.lint_specs(
+                jax.eval_shape(lambda: state.params),
+                self.mesh,
+                strategy=self.strategy,
+                opt_shapes=jax.eval_shape(lambda: state.opt_state),
+                target="prepare_train_state",
+            )
+            self._dispatch_lint(report, mode)
 
         params_shapes = jax.eval_shape(lambda: state.params)
         param_specs, opt_specs = self._resolve_specs(params_shapes, state.tx)

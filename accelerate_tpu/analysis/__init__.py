@@ -1,0 +1,64 @@
+"""Ahead-of-time static analysis for jitted train/infer steps (`atx lint`).
+
+A wrong PartitionSpec on TPU does not error — XLA silently inserts
+replication or a full all-gather and the job runs 5-50x slower. Because
+GSPMD derives every collective from the annotations, those mistakes are
+statically checkable: this package traces a step with `jax.eval_shape` /
+`jax.make_jaxpr`, inspects the lowered StableHLO and the compiled HLO, and
+emits structured `Finding` records across four rule families:
+
+- **ATX1xx sharding** — spec axes missing from the mesh, dims the mesh
+  can't divide (silent padding/replication), large params left fully
+  replicated, param-vs-optimizer-state spec conflicts;
+- **ATX2xx donation** — train state not donated (2x HBM), donations XLA
+  dropped because no output could alias the buffer;
+- **ATX3xx recompilation** — unhashable/unstable static args, batch-shape
+  drift across calls, dtype/weak-type flips;
+- **ATX4xx host sync & collectives** — callbacks/`debug.print` in the hot
+  jaxpr, and collective byte accounting mined from the compiled HLO with a
+  threshold catching accidental full-param gathers.
+
+Three surfaces: `lint_step(fn, *abstract_args, mesh=...)` /
+`lint_training(accelerator, ...)` as a library, `Accelerator.prepare(...,
+lint="warn"|"error")` inline, and the `atx lint` CLI over the `examples/`
+entry points (`make lint-graph`). Rule catalogue: docs/static_analysis.md.
+"""
+
+from .findings import AnalysisWarning, Finding, LintError, Report, Severity
+from .engine import (
+    DEFAULT_OPTIONS,
+    LintContext,
+    RuleSpec,
+    lint_specs,
+    lint_step,
+    lint_training,
+    registered_rules,
+    rule,
+)
+from .hbm import HbmBreakdown, human_bytes, state_hbm_per_device, tree_device_bytes
+
+# Importing the rule modules registers their rules.
+from . import rules_collectives  # noqa: F401  (ATX4xx)
+from . import rules_donation  # noqa: F401  (ATX2xx)
+from . import rules_recompile  # noqa: F401  (ATX3xx)
+from . import rules_sharding  # noqa: F401  (ATX1xx)
+
+__all__ = [
+    "AnalysisWarning",
+    "DEFAULT_OPTIONS",
+    "Finding",
+    "HbmBreakdown",
+    "LintContext",
+    "LintError",
+    "Report",
+    "RuleSpec",
+    "Severity",
+    "human_bytes",
+    "lint_specs",
+    "lint_step",
+    "lint_training",
+    "registered_rules",
+    "rule",
+    "state_hbm_per_device",
+    "tree_device_bytes",
+]

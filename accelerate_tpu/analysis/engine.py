@@ -1,0 +1,529 @@
+"""Rule engine: trace/lower/compile a step once, run every registered rule.
+
+The analyzer works entirely ahead of time — nothing executes on device:
+
+- `jax.eval_shape` gives output shapes (donation recycling analysis);
+- `jax.make_jaxpr` gives the traced program (callback / host-sync rules);
+- `jit(...).lower(...)` gives the StableHLO module (donation aliasing — the
+  `tf.aliasing_output` markers — plus jax's own "donated buffers were not
+  usable" warning, captured here);
+- `.compile().as_text()` gives the optimized HLO with the concrete
+  collectives GSPMD inserted (byte accounting for accidental gathers) —
+  the same machinery `tests/test_sharding_hlo.py` asserts against.
+
+Every artifact is lazy and cached on the `LintContext`; a rule that needs an
+artifact the build failed to produce simply skips (the failure itself is
+reported once, as ATX002).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from contextlib import nullcontext
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import use_mesh
+from ..parallel.sharding import (
+    ShardingSpecWarning,
+    _path_str,
+    infer_opt_specs,
+    infer_param_specs,
+)
+from .findings import Finding, Report, Severity
+
+_UNSET = object()
+
+# Tunable thresholds; every lint entry point accepts them as keyword
+# overrides (`lint_step(..., gather_bytes_threshold=1 << 20)`).
+DEFAULT_OPTIONS: dict[str, Any] = {
+    # ATX103: replicated params smaller than this never flag (biases,
+    # layernorm scales — replication is the right call for them).
+    "replicated_bytes_threshold": 1 << 20,
+    # ATX201: an undonated arg flags only when outputs could recycle at
+    # least this many of its bytes.
+    "donation_bytes_threshold": 1 << 20,
+    # ATX403: absolute floor — any single all-gather output this large
+    # flags regardless of model size.
+    "gather_bytes_threshold": 256 << 20,
+    # ATX403: relative trigger — a single all-gather moving this fraction
+    # of the TOTAL param bytes (and at least gather_min_bytes) is the
+    # "accidental full-param gather" signature.
+    "gather_param_fraction": 0.5,
+    "gather_min_bytes": 8 << 20,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleSpec:
+    """Registry entry: identity + docs for one rule. ``severity`` is the
+    rule's typical/maximum severity (individual findings may be lower,
+    e.g. ATX301 downgrades hashable-but-drifting statics to INFO)."""
+
+    rule_id: str
+    severity: Severity
+    family: str
+    summary: str
+    fix_hint: str = ""
+    needs: frozenset = frozenset()
+    fn: Callable[["LintContext"], Iterator[Finding]] | None = None
+
+
+_RULES: dict[str, RuleSpec] = {}
+
+
+def rule(
+    rule_id: str,
+    severity: Severity,
+    family: str,
+    summary: str,
+    fix_hint: str = "",
+    needs: Iterable[str] = (),
+):
+    """Register a rule: a generator ``fn(ctx) -> Iterator[Finding]``.
+    ``needs={"fn"}`` marks rules that require a step function (skipped by
+    `lint_specs`, which has only shapes and specs)."""
+
+    def deco(fn: Callable) -> Callable:
+        _RULES[rule_id] = RuleSpec(
+            rule_id, severity, family, summary, fix_hint, frozenset(needs), fn
+        )
+        return fn
+
+    return deco
+
+
+def registered_rules() -> list[RuleSpec]:
+    return sorted(_RULES.values(), key=lambda r: r.rule_id)
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    return int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64)) * np.dtype(
+        leaf.dtype
+    ).itemsize
+
+
+def _flat_with_paths(tree: Any, is_leaf: Callable | None = None) -> list[tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    return [(_path_str(p), v) for p, v in flat]
+
+
+def _is_spec(x: Any) -> bool:
+    from jax.sharding import PartitionSpec
+
+    return isinstance(x, PartitionSpec)
+
+
+class LintContext:
+    """Everything the rules may inspect, built lazily and cached."""
+
+    def __init__(
+        self,
+        *,
+        fn: Callable | None = None,
+        args: Sequence[Any] = (),
+        mesh: Any = None,
+        donate_argnums: Sequence[int] = (),
+        static_argnums: Sequence[int] = (),
+        params_shapes: Any = None,
+        opt_shapes: Any = None,
+        param_specs: Any = None,
+        opt_specs: Any = None,
+        strategy: Any = None,
+        alternates: Sequence[Sequence[Any]] = (),
+        options: dict[str, Any] | None = None,
+    ) -> None:
+        unknown = set(options or ()) - set(DEFAULT_OPTIONS)
+        if unknown:
+            raise TypeError(f"Unknown lint option(s): {sorted(unknown)}")
+        self.fn = fn
+        self.args = tuple(args)
+        self.mesh = mesh
+        self.donate_argnums = tuple(donate_argnums)
+        self.static_argnums = tuple(static_argnums)
+        self.params_shapes = params_shapes
+        self.opt_shapes = opt_shapes
+        self.param_specs = param_specs
+        self.opt_specs = opt_specs
+        self.strategy = strategy
+        self.alternates = tuple(tuple(a) for a in alternates)
+        self.options = {**DEFAULT_OPTIONS, **(options or {})}
+        self.spec_warnings: list[ShardingSpecWarning] = []
+        self.lowering_warnings: list[warnings.WarningMessage] = []
+        self._notes: list[Finding] = []
+        self._jitted = _UNSET
+        self._jaxpr = _UNSET
+        self._lowered = _UNSET
+        self._compiled_text = _UNSET
+        self._out_shapes = _UNSET
+        self._resolved_param_specs = _UNSET
+        self._inference_ran = False
+
+    def opt(self, key: str) -> Any:
+        return self.options[key]
+
+    # ------------------------------------------------------------ artifacts
+    def _mesh_ctx(self):
+        return use_mesh(self.mesh) if self.mesh is not None else nullcontext()
+
+    def _note(self, stage: str, err: Exception) -> None:
+        self._notes.append(
+            Finding(
+                "ATX002",
+                Severity.ERROR,
+                stage,
+                f"step failed to {stage} ahead of time: {type(err).__name__}: {err}",
+                "a step that cannot trace/lower/compile abstractly will fail "
+                "the same way on the pod; fix this before launching",
+            )
+        )
+
+    @property
+    def jitted(self) -> Callable | None:
+        """The step as a jit-wrapped callable. A function that already has a
+        ``.lower`` surface (``jax.jit`` product, or the Accelerator's train
+        step) is used as-is — its donation/static config is already baked."""
+        if self._jitted is _UNSET:
+            if self.fn is None:
+                self._jitted = None
+            elif hasattr(self.fn, "lower"):
+                self._jitted = self.fn
+            else:
+                self._jitted = jax.jit(
+                    self.fn,
+                    donate_argnums=self.donate_argnums,
+                    static_argnums=self.static_argnums,
+                )
+        return self._jitted
+
+    def jaxpr(self) -> Any:
+        """ClosedJaxpr of the step traced on the abstract args, or None."""
+        if self._jaxpr is _UNSET:
+            self._jaxpr = None
+            if self.jitted is not None:
+                try:
+                    with self._mesh_ctx():
+                        self._jaxpr = jax.make_jaxpr(
+                            self.jitted, static_argnums=self.static_argnums
+                        )(*self.args)
+                except Exception as e:
+                    self._note("trace", e)
+        return self._jaxpr
+
+    def lowered(self) -> Any:
+        """`Lowered` for the step, with lowering-time warnings captured
+        (jax reports dropped donations as a UserWarning here)."""
+        if self._lowered is _UNSET:
+            self._lowered = None
+            if self.jitted is not None:
+                try:
+                    with warnings.catch_warnings(record=True) as rec:
+                        warnings.simplefilter("always")
+                        with self._mesh_ctx():
+                            self._lowered = self.jitted.lower(*self.args)
+                    self.lowering_warnings = list(rec)
+                except Exception as e:
+                    self._note("lower", e)
+        return self._lowered
+
+    def lowered_text(self) -> str | None:
+        low = self.lowered()
+        if low is None:
+            return None
+        try:
+            return low.as_text()
+        except Exception:
+            return None
+
+    def compiled_text(self) -> str | None:
+        """Optimized HLO text (post-GSPMD: real collectives), or None when
+        compilation isn't possible here (e.g. the mesh spans more devices
+        than this host has)."""
+        if self._compiled_text is _UNSET:
+            self._compiled_text = None
+            low = self.lowered()
+            if low is not None:
+                try:
+                    # Donation of sharded args is resolved here, not at
+                    # lowering — capture the dropped-donation warnings from
+                    # this stage too (rules_donation consumes them).
+                    with warnings.catch_warnings(record=True) as rec:
+                        warnings.simplefilter("always")
+                        with self._mesh_ctx():
+                            self._compiled_text = low.compile().as_text()
+                    self.lowering_warnings.extend(rec)
+                except Exception as e:
+                    self._note("compile", e)
+        return self._compiled_text
+
+    def out_shapes(self) -> Any:
+        if self._out_shapes is _UNSET:
+            self._out_shapes = None
+            if self.jitted is not None:
+                static = dict(zip(self.static_argnums,
+                                  (self.args[i] for i in self.static_argnums)))
+                traced = [a for i, a in enumerate(self.args) if i not in static]
+
+                def closed(*targs):
+                    full, it = [], iter(targs)
+                    for i in range(len(self.args)):
+                        full.append(static[i] if i in static else next(it))
+                    return self.fn(*full)
+
+                try:
+                    with self._mesh_ctx():
+                        self._out_shapes = jax.eval_shape(closed, *traced)
+                except Exception as e:
+                    self._note("trace", e)
+        return self._out_shapes
+
+    # ----------------------------------------------------------- spec logic
+    def resolved_param_specs(self) -> Any:
+        """Explicit param specs, or specs inferred from (strategy, shapes)
+        with `ShardingSpecWarning`s captured for ATX101. None when neither
+        is derivable (or inference raised — ATX102 reports why)."""
+        if self._resolved_param_specs is _UNSET:
+            self._resolved_param_specs = self.param_specs
+            if (
+                self.param_specs is None
+                and self.strategy is not None
+                and self.params_shapes is not None
+                and self.mesh is not None
+            ):
+                self._inference_ran = True
+                try:
+                    with warnings.catch_warnings(record=True) as rec:
+                        warnings.simplefilter("always", ShardingSpecWarning)
+                        self._resolved_param_specs = infer_param_specs(
+                            self.params_shapes, self.mesh, self.strategy
+                        )
+                    self.spec_warnings = [
+                        w.message
+                        for w in rec
+                        if isinstance(w.message, ShardingSpecWarning)
+                    ]
+                except ValueError:
+                    # Unknown-axis specs; ATX102 reports them from the rule
+                    # source, with paths.
+                    self._resolved_param_specs = None
+        return self._resolved_param_specs
+
+    def iter_spec_leaves(self, which: str = "params") -> Iterator[tuple[str, Any, Any]]:
+        """Yield ``(path, shape_leaf, spec)`` joined over the shapes and
+        specs trees; empty when either side is missing or they disagree."""
+        if which == "params":
+            shapes, specs = self.params_shapes, self.resolved_param_specs()
+        else:
+            shapes, specs = self.opt_shapes, self.opt_specs
+        if shapes is None or specs is None:
+            return
+        shape_flat = _flat_with_paths(shapes)
+        spec_flat = _flat_with_paths(specs, is_leaf=_is_spec)
+        if len(shape_flat) != len(spec_flat):
+            return
+        for (path, leaf), (_, spec) in zip(shape_flat, spec_flat):
+            yield path, leaf, spec
+
+    def drain_notes(self) -> list[Finding]:
+        notes, self._notes = self._notes, []
+        # One ATX002 per failed stage is enough.
+        seen: set[str] = set()
+        return [n for n in notes if not (n.path in seen or seen.add(n.path))]
+
+
+def _run(ctx: LintContext, only: Sequence[str] | None, strict: bool, target: str) -> Report:
+    # Rule modules self-register on import; the package __init__ imports
+    # them, but guard against direct-engine use.
+    from . import rules_collectives  # noqa: F401
+    from . import rules_donation  # noqa: F401
+    from . import rules_recompile  # noqa: F401
+    from . import rules_sharding  # noqa: F401
+
+    findings: list[Finding] = []
+    for spec in registered_rules():
+        if only is not None and spec.rule_id not in only:
+            continue
+        if "fn" in spec.needs and ctx.fn is None:
+            continue
+        try:
+            findings.extend(spec.fn(ctx))
+        except Exception as e:
+            if strict:
+                raise
+            findings.append(
+                Finding(
+                    "ATX000",
+                    Severity.WARNING,
+                    spec.rule_id,
+                    f"rule {spec.rule_id} crashed: {type(e).__name__}: {e}",
+                    "this is an analyzer bug, not a model bug — report it",
+                )
+            )
+    # Build-stage failures (trace/lower/compile) are findings too, but an
+    # existing ERROR (e.g. ATX301's unhashable static) already explains a
+    # failed build — don't double-report.
+    notes = ctx.drain_notes()
+    if notes and not any(f.severity >= Severity.ERROR for f in findings):
+        findings.extend(notes)
+    return Report(findings=findings, target=target)
+
+
+def lint_step(
+    fn: Callable,
+    *abstract_args: Any,
+    mesh: Any = None,
+    donate_argnums: Sequence[int] = (),
+    static_argnums: Sequence[int] = (),
+    param_specs: Any = None,
+    opt_specs: Any = None,
+    params_shapes: Any = None,
+    opt_shapes: Any = None,
+    strategy: Any = None,
+    alternates: Sequence[Sequence[Any]] = (),
+    rules: Sequence[str] | None = None,
+    strict: bool = False,
+    target: str = "",
+    **options: Any,
+) -> Report:
+    """Lint a jitted (or jittable) step function ahead of time.
+
+    ``abstract_args`` are pytrees of `jax.ShapeDtypeStruct` (attach
+    ``sharding=`` so GSPMD sees the real input layout) or concrete arrays —
+    nothing is executed either way. ``alternates`` is a list of additional
+    call signatures the step will see at runtime (e.g. the ragged last
+    batch); the recompilation rules diff them against the primary one.
+    ``param_specs``/``opt_specs``/``strategy``/``params_shapes`` feed the
+    sharding rules when linting a training step; omit them for a plain
+    function and only the fn-shaped rules run. Threshold keyword overrides:
+    see `DEFAULT_OPTIONS`.
+    """
+    ctx = LintContext(
+        fn=fn,
+        args=abstract_args,
+        mesh=mesh,
+        donate_argnums=donate_argnums,
+        static_argnums=static_argnums,
+        params_shapes=params_shapes,
+        opt_shapes=opt_shapes,
+        param_specs=param_specs,
+        opt_specs=opt_specs,
+        strategy=strategy,
+        alternates=alternates,
+        options=options or None,
+    )
+    return _run(ctx, rules, strict, target)
+
+
+def lint_specs(
+    params_shapes: Any,
+    mesh: Any,
+    *,
+    strategy: Any = None,
+    param_specs: Any = None,
+    opt_specs: Any = None,
+    opt_shapes: Any = None,
+    rules: Sequence[str] | None = None,
+    strict: bool = False,
+    target: str = "",
+    **options: Any,
+) -> Report:
+    """Sharding-family lint only (no step function): validates the
+    strategy's rule table and the inferred/explicit PartitionSpecs against
+    the mesh. This is what `Accelerator.prepare(lint=...)` runs before any
+    buffer moves."""
+    ctx = LintContext(
+        params_shapes=params_shapes,
+        mesh=mesh,
+        strategy=strategy,
+        param_specs=param_specs,
+        opt_specs=opt_specs,
+        opt_shapes=opt_shapes,
+        options=options or None,
+    )
+    return _run(ctx, rules, strict, target)
+
+
+def lint_training(
+    accelerator: Any,
+    init_fn: Any,
+    tx: Any,
+    loss_fn: Callable,
+    batch: Any,
+    *,
+    has_aux: bool = False,
+    donate: bool = True,
+    batch_alternates: Sequence[Any] = (),
+    rng: Any = None,
+    rules: Sequence[str] | None = None,
+    strict: bool = False,
+    target: str = "",
+    **options: Any,
+) -> Report:
+    """Lint the REAL compiled train step an Accelerator would run — without
+    materializing a single parameter.
+
+    ``init_fn`` is the usual `(rng) -> params` initializer (or a concrete /
+    abstract params pytree), ``batch`` a pytree of arrays or shape structs.
+    Builds the abstract TrainState with the Accelerator's own planned
+    shardings attached, compiles `make_train_step`'s product, and runs every
+    rule family over it.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..accelerator import DynamicLossScale, TrainState
+    from ..parallel.mesh import batch_sharding
+    from ..parallel.sharding import to_named_shardings
+
+    mesh = accelerator.mesh
+    rng = rng if rng is not None else accelerator.rng
+    if callable(init_fn):
+        params_shapes = jax.eval_shape(init_fn, rng)
+    else:
+        params_shapes = jax.eval_shape(lambda: init_fn)
+    param_specs, opt_specs = accelerator._resolve_specs(params_shapes, tx)
+    opt_shapes = jax.eval_shape(tx.init, params_shapes)
+
+    def sds(leaf: Any, sharding: Any) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype, sharding=sharding)
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    params_sds = jax.tree.map(sds, params_shapes, to_named_shardings(param_specs, mesh))
+    opt_sds = jax.tree.map(sds, opt_shapes, to_named_shardings(opt_specs, mesh))
+    loss_scale = None
+    if accelerator.policy.compute_dtype == jnp.float16:
+        loss_scale = jax.tree.map(
+            lambda l: sds(l, replicated), jax.eval_shape(DynamicLossScale.create)
+        )
+    state_sds = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32, sharding=replicated),
+        params=params_sds,
+        opt_state=opt_sds,
+        tx=tx,
+        loss_scale=loss_scale,
+    )
+    bsh = batch_sharding(mesh)
+    to_batch_sds = lambda b: jax.tree.map(lambda x: sds(x, bsh), b)
+
+    step = accelerator.make_train_step(loss_fn, has_aux=has_aux, donate=donate)
+    jitted = accelerator._train_steps[id(step)]
+    return lint_step(
+        jitted,
+        state_sds,
+        to_batch_sds(batch),
+        mesh=mesh,
+        donate_argnums=(0,) if donate else (),
+        param_specs=param_specs,
+        opt_specs=opt_specs,
+        params_shapes=params_shapes,
+        opt_shapes=opt_shapes,
+        strategy=accelerator.strategy,
+        alternates=[(state_sds, to_batch_sds(b)) for b in batch_alternates],
+        rules=rules,
+        strict=strict,
+        target=target,
+        **options,
+    )

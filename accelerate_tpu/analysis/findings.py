@@ -1,0 +1,144 @@
+"""Structured lint results: `Finding` records, severity levels, `Report`.
+
+The analyzer never prints — every rule emits `Finding(rule_id, severity,
+path, message, fix_hint)` records and the three surfaces (library API,
+`Accelerator.prepare(lint=...)`, the `atx lint` CLI) decide how to render
+and when to fail. Severities are an IntEnum so thresholds compare directly
+(`f.severity >= Severity.WARNING`).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Iterable, Sequence
+
+
+class Severity(enum.IntEnum):
+    """Finding severity. ERROR findings gate CI (`atx lint` exits non-zero;
+    `prepare(lint="error")` raises); WARNING is a probable perf/memory bug;
+    INFO is accounting the reader may want (e.g. collective traffic)."""
+
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    @classmethod
+    def parse(cls, value: "Severity | str") -> "Severity":
+        if isinstance(value, Severity):
+            return value
+        try:
+            return cls[str(value).upper()]
+        except KeyError:
+            raise ValueError(
+                f"Unknown severity {value!r}; expected one of "
+                f"{[s.name.lower() for s in cls]}"
+            ) from None
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR", in messages
+        return self.name.lower()
+
+
+class AnalysisWarning(UserWarning):
+    """Category for lint findings surfaced through `warnings.warn` (the
+    `prepare(lint="warn")` path) so callers can filter/promote them."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit, anchored to a pytree path (or arg index) in the step."""
+
+    rule_id: str
+    severity: Severity
+    path: str
+    message: str
+    fix_hint: str = ""
+
+    def format(self) -> str:
+        where = f" {self.path}" if self.path else ""
+        text = f"{self.rule_id} [{self.severity}]{where}: {self.message}"
+        if self.fix_hint:
+            text += f"\n    fix: {self.fix_hint}"
+        return text
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["severity"] = str(self.severity)
+        return d
+
+
+class LintError(RuntimeError):
+    """Raised by `prepare(lint="error")` / `Report.raise_on_errors` when
+    error-severity findings exist. Carries the findings for programmatic
+    inspection (`err.findings`)."""
+
+    def __init__(self, findings: Sequence[Finding]):
+        self.findings = tuple(findings)
+        errors = [f for f in self.findings if f.severity >= Severity.ERROR]
+        summary = "\n".join(f.format() for f in (errors or self.findings))
+        super().__init__(
+            f"step lint found {len(errors)} error-severity finding(s):\n{summary}"
+        )
+
+
+@dataclass
+class Report:
+    """All findings for one lint target, sorted most-severe first."""
+
+    findings: list[Finding] = field(default_factory=list)
+    target: str = ""
+
+    def __post_init__(self) -> None:
+        self.findings = sorted(
+            self.findings, key=lambda f: (-int(f.severity), f.rule_id, f.path)
+        )
+
+    @property
+    def has_errors(self) -> bool:
+        return any(f.severity >= Severity.ERROR for f in self.findings)
+
+    def filter(
+        self,
+        min_severity: Severity | str = Severity.INFO,
+        family: str | None = None,
+    ) -> list[Finding]:
+        """Findings at/above a severity; ``family`` is a rule-id prefix
+        ("ATX1" selects the sharding family)."""
+        min_severity = Severity.parse(min_severity)
+        return [
+            f
+            for f in self.findings
+            if f.severity >= min_severity
+            and (family is None or f.rule_id.startswith(family))
+        ]
+
+    def max_severity(self) -> Severity | None:
+        return max((f.severity for f in self.findings), default=None)
+
+    def format(self, min_severity: Severity | str = Severity.INFO) -> str:
+        shown = self.filter(min_severity)
+        if not shown:
+            return "OK — no findings"
+        return "\n".join(f.format() for f in shown)
+
+    def to_dict(self) -> dict:
+        return {
+            "target": self.target,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, **kwargs) -> str:
+        return json.dumps(self.to_dict(), **kwargs)
+
+    def raise_on_errors(self) -> "Report":
+        if self.has_errors:
+            raise LintError(self.findings)
+        return self
+
+    def extend(self, findings: Iterable[Finding]) -> "Report":
+        self.findings = sorted(
+            [*self.findings, *findings],
+            key=lambda f: (-int(f.severity), f.rule_id, f.path),
+        )
+        return self

@@ -1,0 +1,113 @@
+"""Per-device HBM accounting from shapes + PartitionSpecs (no execution).
+
+The same arithmetic GSPMD applies: a leaf's per-device footprint is its
+byte size divided by the product of the mesh-axis sizes its spec names,
+with indivisible dims rounded up (XLA pads the ragged shard). Used by the
+`atx lint` CLI summary and cross-checked against `commands/estimate.py`'s
+heuristic calculator in tests (they must agree within 5% on the shared
+terms — params, grads, optimizer moments).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import spec_entry_axes
+
+
+def human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PiB"
+
+
+def leaf_device_bytes(shape: tuple[int, ...], dtype: Any, spec: Any, mesh: Any) -> int:
+    """Bytes one device holds for a leaf under ``spec`` (ceil per sharded
+    dim — the padded-shard size XLA actually allocates)."""
+    per_dim = list(shape)
+    for d, entry in enumerate(spec or ()):
+        if d >= len(per_dim):
+            break
+        group = 1
+        for axis in spec_entry_axes(entry):
+            group *= int(mesh.shape[axis])
+        if group > 1:
+            per_dim[d] = math.ceil(per_dim[d] / group)
+    return int(np.prod(per_dim, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def tree_device_bytes(shapes: Any, specs: Any, mesh: Any, dtype: Any | None = None) -> int:
+    """Summed per-device bytes for a shapes pytree under a specs pytree.
+    ``dtype`` overrides every leaf's dtype (e.g. fp32 for gradients)."""
+    from jax.sharding import PartitionSpec
+
+    shape_leaves = jax.tree.leaves(shapes)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    if len(shape_leaves) != len(spec_leaves):
+        raise ValueError(
+            f"shapes tree has {len(shape_leaves)} leaves but specs tree has "
+            f"{len(spec_leaves)}; the trees must mirror each other"
+        )
+    return sum(
+        leaf_device_bytes(
+            tuple(leaf.shape), dtype if dtype is not None else leaf.dtype, spec, mesh
+        )
+        for leaf, spec in zip(shape_leaves, spec_leaves)
+    )
+
+
+@dataclass(frozen=True)
+class HbmBreakdown:
+    """Per-device steady-state training footprint of the sharded state."""
+
+    params_bytes: int
+    grads_bytes: int
+    opt_bytes: int
+
+    @property
+    def total(self) -> int:
+        return self.params_bytes + self.grads_bytes + self.opt_bytes
+
+    def format(self) -> str:
+        return (
+            f"params {human_bytes(self.params_bytes)} + "
+            f"grads {human_bytes(self.grads_bytes)} + "
+            f"opt {human_bytes(self.opt_bytes)} = "
+            f"{human_bytes(self.total)}/device (state only; activations "
+            "and logits are workload-dependent — see `atx estimate`)"
+        )
+
+
+def state_hbm_per_device(
+    params_shapes: Any,
+    param_specs: Any,
+    mesh: Any,
+    *,
+    opt_shapes: Any = None,
+    opt_specs: Any = None,
+    include_grads: bool = True,
+) -> HbmBreakdown:
+    """Account the train state's per-device HBM: params at their own dtype,
+    gradients as fp32 copies sharded like their params (what the compiled
+    step materializes), optimizer state under its own specs."""
+    import jax.numpy as jnp
+
+    params_b = tree_device_bytes(params_shapes, param_specs, mesh)
+    grads_b = (
+        tree_device_bytes(params_shapes, param_specs, mesh, dtype=jnp.float32)
+        if include_grads
+        else 0
+    )
+    opt_b = 0
+    if opt_shapes is not None and opt_specs is not None:
+        opt_b = tree_device_bytes(opt_shapes, opt_specs, mesh)
+    return HbmBreakdown(params_b, grads_b, opt_b)

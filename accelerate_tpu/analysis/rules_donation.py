@@ -1,0 +1,156 @@
+"""ATX2xx — buffer-donation rules.
+
+A train step that doesn't donate its state holds old + new params, moments,
+and loss-scale simultaneously: 2x the state's HBM at peak, which on a
+budgeted pod run is the difference between fitting and OOM. Donation is
+visible statically: jax lowers it to ``tf.aliasing_output`` attributes on
+the StableHLO entry args, and reports donations XLA had to drop (dtype or
+layout mismatch with every output) as a lowering-time warning.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+from .engine import LintContext, _leaf_bytes, rule
+from .findings import Finding, Severity
+from .hbm import human_bytes
+
+_ALIAS_MARKER = "tf.aliasing_output"
+_DROPPED_MARKER = "donated buffers were not usable"
+# With sharded inputs jax defers donation to XLA compile; the compiled
+# module header then carries `input_output_alias={ {0}: (0, {}, may-alias) }`.
+_COMPILED_ALIAS_RE = re.compile(r"input_output_alias=\{\s*\{")
+
+
+def _donation_active(ctx: LintContext) -> bool:
+    lowered_text = ctx.lowered_text()
+    if lowered_text is not None and _ALIAS_MARKER in lowered_text:
+        return True
+    compiled_text = ctx.compiled_text()
+    return compiled_text is not None and bool(_COMPILED_ALIAS_RE.search(compiled_text))
+
+
+def _leaf_signature_counts(tree: Any) -> Counter:
+    """Multiset of (shape, dtype) over array-like leaves."""
+    counts: Counter = Counter()
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        counts[(tuple(shape), np.dtype(dtype).str)] += 1
+    return counts
+
+
+@rule(
+    "ATX201",
+    Severity.WARNING,
+    "donation",
+    "large step input not donated although outputs could reuse its buffers",
+    "donate the state argument (jit donate_argnums / "
+    "make_train_step(donate=True)) and don't touch the old state after "
+    "the call",
+    needs={"fn"},
+)
+def atx201_missing_donation(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.lowered_text() is None:
+        return
+    if _donation_active(ctx):
+        # Donation is active (regardless of how the caller configured it —
+        # a pre-jitted step bakes its own donate_argnums).
+        return
+    out = ctx.out_shapes()
+    if out is None:
+        return
+    out_counts = _leaf_signature_counts(out)
+    threshold = ctx.opt("donation_bytes_threshold")
+    for i, arg in enumerate(ctx.args):
+        if i in ctx.static_argnums:
+            continue
+        arg_counts = _leaf_signature_counts(arg)
+        reusable = sum(
+            min(n, out_counts[sig])
+            * int(np.prod(sig[0], dtype=np.int64))
+            * np.dtype(sig[1]).itemsize
+            for sig, n in arg_counts.items()
+            if sig in out_counts
+        )
+        if reusable >= threshold:
+            arg_total = sum(
+                _leaf_bytes(l)
+                for l in jax.tree.leaves(arg)
+                if hasattr(l, "shape") and hasattr(l, "dtype")
+            )
+            yield Finding(
+                "ATX201",
+                Severity.WARNING,
+                f"args[{i}]",
+                f"{human_bytes(reusable)} of the outputs match this "
+                f"argument's buffers ({human_bytes(arg_total)} total) but "
+                "the argument is not donated — XLA allocates fresh output "
+                "buffers, ~2x transient HBM for the train state",
+                f"pass donate_argnums=({i},) (the Accelerator's "
+                "make_train_step donates the state by default) and don't "
+                "reuse the old value after the call",
+            )
+
+
+@rule(
+    "ATX202",
+    Severity.WARNING,
+    "donation",
+    "donation declared but dropped by XLA (no output can alias the buffer)",
+    "donated buffers must match an output's shape/dtype — check dtype "
+    "casts on the returned state and outputs whose sharding differs from "
+    "the input's",
+    needs={"fn"},
+)
+def atx202_dropped_donation(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.lowered() is None:
+        return
+    compiled_text = ctx.compiled_text()  # sharded-arg donation resolves here
+    fix = (
+        "make the returned state keep the donated leaves' exact "
+        "dtype/shape (a cast like fp32->bf16 on the way out breaks "
+        "aliasing), or stop donating args that don't round-trip"
+    )
+    reported = False
+    for w in ctx.lowering_warnings:
+        msg = str(w.message)
+        if _DROPPED_MARKER in msg.lower():
+            reported = True
+            detail = msg.split(":", 1)[-1].strip().split("\n")[0]
+            yield Finding(
+                "ATX202",
+                Severity.WARNING,
+                "",
+                "donation declared but XLA could not alias the donated "
+                f"buffer(s) to any output — donation dropped for: {detail}. "
+                "The old buffer stays live, so the donation saves nothing",
+                fix,
+            )
+    # jax drops donations of SHARDED args silently (no warning on 0.4.x):
+    # donation was declared, the module compiled, and yet no input-output
+    # alias exists anywhere — the 2x-HBM saving the caller thinks they have
+    # is not there.
+    if (
+        not reported
+        and ctx.donate_argnums
+        and compiled_text is not None
+        and not _donation_active(ctx)
+    ):
+        yield Finding(
+            "ATX202",
+            Severity.WARNING,
+            f"args{list(ctx.donate_argnums)}",
+            "donation declared for these args but the compiled module has "
+            "no input-output alias — XLA dropped every donation silently; "
+            "old and new buffers coexist (~2x state HBM)",
+            fix,
+        )

@@ -1,0 +1,164 @@
+"""ATX3xx — recompilation-hazard rules.
+
+A recompile mid-run stalls every chip in the slice for the full XLA
+compile time (minutes at pod scale). The triggers are all visible in the
+call signature: static args that aren't stable hashables, batch shapes
+that drift call-to-call (the classic `drop_last=False` ragged tail), and
+dtype/weak-type flips from mixing Python scalars with arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+from .engine import LintContext, rule
+from ..parallel.sharding import _path_str
+from .findings import Finding, Severity
+
+
+def _leaf_sigs(args: Any, static_argnums: tuple[int, ...]) -> list[tuple[str, tuple, str, bool]]:
+    """(path, shape, dtype, weak_type) per traced leaf, argv-prefixed."""
+    sigs = []
+    for i, arg in enumerate(args):
+        if i in static_argnums:
+            continue
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for p, leaf in flat:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            sigs.append(
+                (
+                    f"args[{i}]/{_path_str(p)}" if p else f"args[{i}]",
+                    tuple(shape),
+                    np.dtype(dtype).str,
+                    bool(getattr(leaf, "weak_type", False)),
+                )
+            )
+    return sigs
+
+
+@rule(
+    "ATX301",
+    Severity.ERROR,
+    "recompilation",
+    "static argument is unhashable (or recompiles per distinct value)",
+    "make the value a traced argument, or pass a hashable frozen form "
+    "(tuple / frozen dataclass) that is constant across the run",
+    needs={"fn"},
+)
+def atx301_static_args(ctx: LintContext) -> Iterator[Finding]:
+    for i in ctx.static_argnums:
+        if i >= len(ctx.args):
+            continue
+        value = ctx.args[i]
+        try:
+            hash(value)
+        except TypeError:
+            yield Finding(
+                "ATX301",
+                Severity.ERROR,
+                f"args[{i}]",
+                f"static argument of type {type(value).__name__} is "
+                "unhashable — jit raises at call time (and a mutable "
+                "static can never cache correctly)",
+                "pass it as a traced argument, or freeze it "
+                "(tuple / frozen dataclass) if it is genuinely static",
+            )
+            continue
+        if isinstance(value, float) and not isinstance(value, bool):
+            yield Finding(
+                "ATX301",
+                Severity.INFO,
+                f"args[{i}]",
+                f"float static argument ({value!r}) retraces and recompiles "
+                "for every distinct value — a schedule or loss scale passed "
+                "statically compiles once per step",
+                "pass per-step scalars as traced args (or fold schedules "
+                "into the optax chain)",
+            )
+
+
+@rule(
+    "ATX302",
+    Severity.WARNING,
+    "recompilation",
+    "argument shapes differ across the provided sample calls",
+    "pad/bucket inputs to fixed shapes, or set drop_last=True so the "
+    "ragged final batch never reaches the compiled step",
+    needs={"fn"},
+)
+def atx302_shape_drift(ctx: LintContext) -> Iterator[Finding]:
+    base = _leaf_sigs(ctx.args, ctx.static_argnums)
+    for j, alt in enumerate(ctx.alternates):
+        alt_sigs = _leaf_sigs(alt, ctx.static_argnums)
+        if [s[0] for s in alt_sigs] != [s[0] for s in base]:
+            yield Finding(
+                "ATX302",
+                Severity.WARNING,
+                f"alternates[{j}]",
+                "pytree structure differs from the primary call signature — "
+                "every distinct structure compiles its own executable",
+                "keep the batch pytree structure fixed across steps",
+            )
+            continue
+        for (path, shape, _, _), (_, alt_shape, _, _) in zip(base, alt_sigs):
+            if shape != alt_shape:
+                yield Finding(
+                    "ATX302",
+                    Severity.WARNING,
+                    path,
+                    f"shape drifts across calls ({shape} vs {alt_shape}) — "
+                    "each distinct shape triggers a full XLA recompile "
+                    "that stalls every chip in the slice",
+                    "pad/bucket to fixed shapes, or drop_last=True on the "
+                    "loader so the ragged tail batch never compiles",
+                )
+
+
+@rule(
+    "ATX303",
+    Severity.WARNING,
+    "recompilation",
+    "dtype / weak-type flips across the provided sample calls",
+    "canonicalize dtypes at the data boundary (np.asarray(..., dtype=...)); "
+    "never alternate Python scalars with arrays for the same argument",
+    needs={"fn"},
+)
+def atx303_dtype_drift(ctx: LintContext) -> Iterator[Finding]:
+    base = _leaf_sigs(ctx.args, ctx.static_argnums)
+    for j, alt in enumerate(ctx.alternates):
+        alt_sigs = _leaf_sigs(alt, ctx.static_argnums)
+        if [s[0] for s in alt_sigs] != [s[0] for s in base]:
+            continue  # structure drift is ATX302's finding
+        for (path, shape, dtype, weak), (_, alt_shape, alt_dtype, alt_weak) in zip(
+            base, alt_sigs
+        ):
+            if shape != alt_shape:
+                continue  # shape drift is ATX302's finding
+            if dtype != alt_dtype:
+                yield Finding(
+                    "ATX303",
+                    Severity.WARNING,
+                    path,
+                    f"dtype drifts across calls ({dtype} vs {alt_dtype}) — "
+                    "a silent recompile per dtype (and x64 inputs are "
+                    "silently downcast when jax_enable_x64 is off)",
+                    "canonicalize dtypes where data enters the step "
+                    "(np.asarray(..., dtype=np.float32))",
+                )
+            elif weak != alt_weak:
+                yield Finding(
+                    "ATX303",
+                    Severity.WARNING,
+                    path,
+                    "weak-type flips across calls (Python scalar vs array) "
+                    "— weak_type is part of jit's cache key, so the flip "
+                    "recompiles and can change promotion semantics",
+                    "pass the value with an explicit dtype "
+                    "(jnp.asarray(x, jnp.float32)) on every call",
+                )

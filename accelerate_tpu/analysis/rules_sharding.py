@@ -1,0 +1,241 @@
+"""ATX1xx — sharding-spec rules.
+
+The GSPMD contract is that collective placement is fully determined by the
+PartitionSpec annotations, which makes spec mistakes statically checkable —
+and on TPU they MUST be caught statically, because the runtime failure mode
+is silent replication (5-50x slower, 1/N of the memory story), not an error.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from ..parallel.mesh import spec_entry_axes, unknown_spec_axes
+from ..parallel.sharding import (
+    ShardingStrategy,
+    canonicalize_spec,
+    infer_opt_specs,
+)
+from ..utils.dataclasses import ShardingStrategyType
+from .engine import LintContext, _flat_with_paths, _is_spec, _leaf_bytes, rule
+from .findings import Finding, Severity
+from .hbm import human_bytes, state_hbm_per_device
+
+_REPLICATED_KINDS = (
+    ShardingStrategyType.DATA_PARALLEL,
+    ShardingStrategyType.ZERO1,
+    ShardingStrategyType.ZERO2,
+)
+
+
+@rule(
+    "ATX101",
+    Severity.WARNING,
+    "sharding",
+    "PartitionSpec entry dropped: dim not divisible by the mesh axis size",
+    "pad the dim to a multiple of the axis-group size, shard a different "
+    "dim, or pick a mesh whose axis divides it",
+)
+def atx101_indivisible_dims(ctx: LintContext) -> Iterator[Finding]:
+    # Inference path: `infer_param_specs` already emits the structured
+    # ShardingSpecWarning per drop; the context captured them.
+    ctx.resolved_param_specs()
+    for w in ctx.spec_warnings:
+        yield Finding(
+            "ATX101",
+            Severity.WARNING,
+            w.path,
+            f"spec entry {w.entry!r} on dim {w.dim} (size {w.dim_size}) is "
+            f"not divisible by mesh axes {list(w.axes)} (group {w.group}) — "
+            "the dim silently replicates on every device",
+            "pad the dim to a multiple of the axis-group size, shard a "
+            "different dim, or pick a mesh whose axis divides it",
+        )
+    # Explicit-specs path: the caller handed in the spec tree, so check
+    # divisibility directly (inference never ran, no warnings captured).
+    if ctx.param_specs is None or ctx._inference_ran:
+        return
+    for path, leaf, spec in ctx.iter_spec_leaves("params"):
+        shape = tuple(getattr(leaf, "shape", ()))
+        for d, entry in enumerate(spec):
+            axes = spec_entry_axes(entry)
+            if not axes or any(a not in ctx.mesh.shape for a in axes):
+                continue  # unknown axes are ATX102's finding
+            group = int(np.prod([ctx.mesh.shape[a] for a in axes]))
+            if group > 1 and d < len(shape) and shape[d] % group != 0:
+                yield Finding(
+                    "ATX101",
+                    Severity.WARNING,
+                    path,
+                    f"spec entry {entry!r} on dim {d} (size {shape[d]}) is "
+                    f"not divisible by mesh axes {list(axes)} (group "
+                    f"{group}) — XLA pads/replicates instead of sharding",
+                    "pad the dim to a multiple of the axis-group size, "
+                    "shard a different dim, or resize the mesh axis",
+                )
+
+
+@rule(
+    "ATX102",
+    Severity.ERROR,
+    "sharding",
+    "PartitionSpec references an axis name the mesh does not define",
+    "rename the spec axis to one of the mesh axes, or add the axis to "
+    "MeshConfig / ATX_MESH_*",
+)
+def atx102_unknown_axes(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.mesh is None:
+        return
+    mesh_axes = tuple(ctx.mesh.axis_names)
+
+    def check(spec: PartitionSpec, where: str) -> Iterator[Finding]:
+        unknown = unknown_spec_axes(spec, ctx.mesh)
+        if unknown:
+            yield Finding(
+                "ATX102",
+                Severity.ERROR,
+                where,
+                f"spec {spec} references mesh axes {list(unknown)} that do "
+                f"not exist (mesh axes: {mesh_axes}) — NamedSharding "
+                "construction would fail with an opaque KeyError",
+                f"rename the axis to one of {mesh_axes}, or add it to the "
+                "mesh (MeshConfig / ATX_MESH_*)",
+            )
+
+    if ctx.strategy is not None:
+        for pattern, spec in getattr(ctx.strategy, "rules", ()):
+            yield from check(spec, f"rule {pattern!r}")
+    for which in ("params", "opt"):
+        explicit = ctx.param_specs if which == "params" else ctx.opt_specs
+        if explicit is None:
+            continue
+        for path, spec in _flat_with_paths(explicit, is_leaf=_is_spec):
+            yield from check(spec, path)
+
+
+@rule(
+    "ATX103",
+    Severity.WARNING,
+    "sharding",
+    "large param fully replicated while the mesh has free sharding axes",
+    "add a sharding rule for the param, lower FsdpPlugin.min_weight_size, "
+    "or pad its dims so the fsdp axis divides one",
+)
+def atx103_large_replicated(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.mesh is None:
+        return
+    if ctx.strategy is not None and ctx.strategy.kind in _REPLICATED_KINDS:
+        return  # replication is these strategies' contract, not a bug
+    avail = [
+        a for a in ctx.mesh.axis_names if a != "data" and ctx.mesh.shape[a] > 1
+    ]
+    if not avail:
+        return
+    threshold = ctx.opt("replicated_bytes_threshold")
+    for path, leaf, spec in ctx.iter_spec_leaves("params"):
+        nbytes = _leaf_bytes(leaf)
+        if nbytes < threshold:
+            continue
+        try:
+            canonical = canonicalize_spec(spec, ctx.mesh, path)
+        except ValueError:
+            continue  # unknown axes: ATX102 owns it
+        if canonical == PartitionSpec():
+            yield Finding(
+                "ATX103",
+                Severity.WARNING,
+                path,
+                f"{human_bytes(nbytes)} param is fully replicated although "
+                f"mesh axes {avail} are available to shard it — every "
+                "device holds (and all-reduces grads for) a full copy",
+                "add a sharding rule matching this param, lower "
+                "FsdpPlugin.min_weight_size, or pad an indivisible dim",
+            )
+
+
+@rule(
+    "ATX105",
+    Severity.INFO,
+    "sharding",
+    "per-device HBM accounting of the sharded train state",
+)
+def atx105_hbm_accounting(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.params_shapes is None or ctx.mesh is None:
+        return
+    param_specs = ctx.resolved_param_specs()
+    if param_specs is None:
+        return
+    opt_specs = ctx.opt_specs
+    if opt_specs is None and ctx.opt_shapes is not None:
+        # The prepare() path hands in opt shapes only; account them under
+        # the specs the framework would plan for them.
+        strategy = ctx.strategy if ctx.strategy is not None else ShardingStrategy()
+        try:
+            opt_specs = infer_opt_specs(
+                ctx.opt_shapes, ctx.params_shapes, param_specs, ctx.mesh, strategy
+            )
+        except Exception:
+            opt_specs = None
+    try:
+        breakdown = state_hbm_per_device(
+            ctx.params_shapes,
+            param_specs,
+            ctx.mesh,
+            opt_shapes=ctx.opt_shapes,
+            opt_specs=opt_specs,
+        )
+    except Exception:
+        return
+    yield Finding(
+        "ATX105",
+        Severity.INFO,
+        "",
+        f"sharded train-state HBM: {breakdown.format()}",
+        "",
+    )
+
+
+@rule(
+    "ATX104",
+    Severity.WARNING,
+    "sharding",
+    "optimizer-state spec conflicts with the spec planned from its param",
+    "derive optimizer-state specs with infer_opt_specs (or mirror the "
+    "param specs) so moments live where their params live",
+)
+def atx104_param_opt_conflict(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.opt_specs is None or ctx.opt_shapes is None or ctx.params_shapes is None:
+        return
+    param_specs = ctx.resolved_param_specs()
+    if param_specs is None or ctx.mesh is None:
+        return
+    strategy = ctx.strategy if ctx.strategy is not None else ShardingStrategy()
+    try:
+        expected = infer_opt_specs(
+            ctx.opt_shapes, ctx.params_shapes, param_specs, ctx.mesh, strategy
+        )
+    except Exception:
+        return
+    expected_flat = _flat_with_paths(expected, is_leaf=_is_spec)
+    actual_flat = _flat_with_paths(ctx.opt_specs, is_leaf=_is_spec)
+    if len(expected_flat) != len(actual_flat):
+        return
+    for (path, exp), (_, act) in zip(expected_flat, actual_flat):
+        try:
+            if canonicalize_spec(exp, ctx.mesh) == canonicalize_spec(act, ctx.mesh):
+                continue
+        except ValueError:
+            continue  # unknown axes: ATX102 owns it
+        yield Finding(
+            "ATX104",
+            Severity.WARNING,
+            path,
+            f"optimizer-state spec {act} conflicts with the spec planned "
+            f"from its parameter ({exp}) — XLA inserts a reshard of the "
+            "moments on every step's update",
+            "derive optimizer-state specs with infer_opt_specs (or mirror "
+            "the param specs); only ZeRO-1 intentionally diverges",
+        )

@@ -155,7 +155,7 @@ def infer_sharding_plan(
         spec = PartitionSpec()
         for pattern, rule_spec in rules:
             if re.search(pattern, key):
-                spec = _sanitize_spec(rule_spec, shape, mesh)
+                spec = _sanitize_spec(rule_spec, shape, mesh, path=key)
                 break
         specs[key] = spec
 

@@ -1,0 +1,271 @@
+"""`accelerate-tpu lint` / `atx lint` — ahead-of-time step analyzer CLI.
+
+Lints the `examples/` entry points (and any registered scenario) without
+running them: each scenario rebuilds the example's exact training
+configuration — model family/config, strategy, precision, batch shapes —
+abstractly via `analysis.lint_training`, so the REAL compiled train step is
+traced, lowered, and byte-audited with zero parameters materialized and
+zero steps executed. Exit code 1 when any finding at/above ``--severity``
+(default: error) is present — the `make lint-graph` CI gate.
+
+Rule catalogue: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Callable
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "lint",
+        help="Ahead-of-time sharding/donation/recompilation lint for train steps",
+    )
+    p.add_argument(
+        "targets",
+        nargs="*",
+        help="example scripts, directories of them, or scenario names "
+        "(default: every built-in example scenario; see --list)",
+    )
+    p.add_argument(
+        "--severity",
+        default="error",
+        choices=["info", "warning", "error"],
+        help="exit non-zero when a finding at/above this severity exists",
+    )
+    p.add_argument(
+        "--show",
+        default="info",
+        choices=["info", "warning", "error"],
+        help="minimum severity to print",
+    )
+    p.add_argument("--format", dest="fmt", default="text", choices=["text", "json"])
+    p.add_argument("--list", action="store_true", help="list lintable scenarios")
+    p.add_argument(
+        "--rules", action="store_true", help="list the registered rule catalogue"
+    )
+    p.add_argument(
+        "--host_devices",
+        type=int,
+        default=None,
+        help="simulate N host devices (XLA_FLAGS) so sharding/collective "
+        "rules see a real mesh on CPU; must be set before jax initializes",
+    )
+    p.set_defaults(func=run)
+
+
+# --------------------------------------------------------------- scenarios
+# Each scenario mirrors one examples/ entry point's training configuration.
+# Builders return (description, Report).
+
+
+def _fresh_accelerator(**kwargs: Any):
+    from ..accelerator import Accelerator
+    from ..state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    return Accelerator(seed=0, **kwargs)
+
+
+def _scenario_nlp_example(**options: Any):
+    """examples/nlp_example.py: BERT-tiny pair classification, DP, fp32."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis
+    from ..models import bert
+    from ..utils.dataclasses import DataLoaderConfiguration
+
+    acc = _fresh_accelerator(
+        max_grad_norm=1.0,
+        dataloader_config=DataLoaderConfiguration(split_batches=True),
+    )
+    config = bert.BertConfig.tiny(
+        vocab_size=128, max_seq_len=64, d_model=64, d_ff=128
+    )
+    batch_size, seq_len = 64, 64
+    batch = {
+        "input_ids": np.zeros((batch_size, seq_len), np.int32),
+        "token_type_ids": np.zeros((batch_size, seq_len), np.int32),
+        "attention_mask": np.zeros((batch_size, seq_len), np.int32),
+        "labels": np.zeros((batch_size,), np.int32),
+    }
+    report = analysis.lint_training(
+        acc,
+        lambda r: bert.init(r, config),
+        optax.adamw(2e-3, weight_decay=0.01),
+        lambda params, b, rng: bert.loss_fn(params, b, config, rng),
+        batch,
+        target="examples/nlp_example.py",
+        **options,
+    )
+    desc = f"BERT-tiny pair classification, {acc!r}"
+    return desc, report
+
+
+def _scenario_lm_example(**options: Any):
+    """examples/lm_example.py: GPT causal LM, bf16, grad clipping."""
+    import numpy as np
+    import optax
+
+    from .. import analysis
+    from ..models import gpt
+
+    acc = _fresh_accelerator(mixed_precision="bf16", max_grad_norm=1.0)
+    config = gpt.GPTConfig(
+        vocab_size=128, d_model=128, n_layers=4, num_heads=4, d_ff=512,
+        max_seq_len=64,
+    )
+    batch = {"input_ids": np.zeros((8, 64), np.int32)}
+    report = analysis.lint_training(
+        acc,
+        lambda r: gpt.init(r, config),
+        optax.adamw(3e-3),
+        lambda params, b, rng: gpt.loss_fn(params, b, config, rng),
+        batch,
+        target="examples/lm_example.py",
+        **options,
+    )
+    return f"GPT causal LM, {acc!r}", report
+
+
+def _scenario_cv_example(**options: Any):
+    """examples/cv_example.py: inline convnet quadrant classification, DP."""
+    import importlib.util
+
+    import numpy as np
+    import optax
+
+    from .. import analysis
+
+    path = _examples_dir() / "cv_example.py"
+    spec = importlib.util.spec_from_file_location("atx_lint_cv_example", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from ..utils.dataclasses import DataLoaderConfiguration
+
+    acc = _fresh_accelerator(
+        dataloader_config=DataLoaderConfiguration(split_batches=True)
+    )
+    image_size = 32
+    batch = {
+        "image": np.zeros((64, image_size, image_size, 1), np.float32),
+        "label": np.zeros((64,), np.int32),
+    }
+    report = analysis.lint_training(
+        acc,
+        lambda r: mod.init_convnet(r, image_size=image_size),
+        optax.adam(1e-3),
+        mod.loss_fn,
+        batch,
+        target="examples/cv_example.py",
+        **options,
+    )
+    return f"convnet quadrant classifier, {acc!r}", report
+
+
+SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
+    "nlp_example": _scenario_nlp_example,
+    "lm_example": _scenario_lm_example,
+    "cv_example": _scenario_cv_example,
+}
+
+
+def _examples_dir():
+    from pathlib import Path
+
+    return Path(__file__).resolve().parents[2] / "examples"
+
+
+def resolve_targets(targets: list[str]) -> tuple[list[str], list[str]]:
+    """Map CLI targets (scenario names / example files / directories) to
+    scenario names; second element is the unmatched remainder."""
+    if not targets:
+        return list(SCENARIOS), []
+    names: list[str] = []
+    unmatched: list[str] = []
+    for t in targets:
+        stem = os.path.splitext(os.path.basename(t.rstrip("/")))[0]
+        if t in SCENARIOS:
+            names.append(t)
+        elif os.path.isdir(t):
+            found = [
+                os.path.splitext(f)[0]
+                for f in sorted(os.listdir(t))
+                if os.path.splitext(f)[0] in SCENARIOS and f.endswith(".py")
+            ]
+            if found:
+                names.extend(found)
+            else:
+                unmatched.append(t)
+        elif stem in SCENARIOS:
+            names.append(stem)
+        else:
+            unmatched.append(t)
+    # de-dup, keep order
+    seen: set[str] = set()
+    names = [n for n in names if not (n in seen or seen.add(n))]
+    return names, unmatched
+
+
+def run(args: argparse.Namespace) -> int:
+    if args.host_devices and "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.host_devices}"
+            )
+
+    from ..analysis import Severity, registered_rules
+
+    if args.rules:
+        for spec in registered_rules():
+            print(f"{spec.rule_id} [{spec.severity}] ({spec.family}) {spec.summary}")
+            if spec.fix_hint:
+                print(f"    fix: {spec.fix_hint}")
+        return 0
+    if args.list:
+        for name, builder in SCENARIOS.items():
+            print(f"{name}: {builder.__doc__.splitlines()[0]}")
+        return 0
+
+    names, unmatched = resolve_targets(args.targets)
+    if unmatched:
+        print(
+            f"lint: no scenario registered for {unmatched} "
+            f"(known: {', '.join(SCENARIOS)}); register one in "
+            "accelerate_tpu/commands/lint.py:SCENARIOS",
+            file=sys.stderr,
+        )
+        return 2
+
+    gate = Severity.parse(args.severity)
+    show = Severity.parse(args.show)
+    failed = False
+    json_reports = []
+    for name in names:
+        desc, report = SCENARIOS[name]()
+        if report.filter(gate):
+            failed = True
+        if args.fmt == "json":
+            d = report.to_dict()
+            d["scenario"] = name
+            d["description"] = desc
+            json_reports.append(d)
+        else:
+            print(f"== {report.target or name} — {desc}")
+            print(f"   {report.format(show)}".replace("\n", "\n   "))
+    if args.fmt == "json":
+        print(json.dumps({"reports": json_reports}, indent=2))
+    elif failed:
+        print(f"\nlint: findings at/above severity '{gate}' — failing")
+    else:
+        print(f"\nlint: no findings at/above severity '{gate}'")
+    return 1 if failed else 0

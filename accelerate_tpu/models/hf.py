@@ -1193,7 +1193,7 @@ def _make_quantize_override(plan, bits):
             spec = spec_for(plan_key)
             shardings = {
                 name: NamedSharding(
-                    plan.mesh, _sanitize_spec(spec, arr.shape, plan.mesh)
+                    plan.mesh, _sanitize_spec(spec, arr.shape, plan.mesh, path=plan_key)
                 )
                 for name, arr in packed.items()
             }

@@ -129,6 +129,43 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     return Mesh(np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES)
 
 
+def spec_entry_axes(entry: object) -> tuple[str, ...]:
+    """Axis names referenced by one PartitionSpec entry (None/UNCONSTRAINED
+    reference none; an entry is either one axis name or a tuple of them)."""
+    if entry is None or entry is PartitionSpec.UNCONSTRAINED:
+        return ()
+    return (entry,) if isinstance(entry, str) else tuple(entry)
+
+
+def unknown_spec_axes(spec: PartitionSpec, mesh: Mesh) -> tuple[str, ...]:
+    """Axis names a spec references that the mesh does not define, in spec
+    order. The static-analysis (ATX102) and eager-validation entry point:
+    ``mesh.shape[axis]`` on a missing axis raises a bare ``KeyError`` with no
+    param context, and deferring to ``NamedSharding`` construction is worse."""
+    known = set(mesh.axis_names)
+    seen: list[str] = []
+    for entry in spec:
+        for axis in spec_entry_axes(entry):
+            if axis not in known and axis not in seen:
+                seen.append(axis)
+    return tuple(seen)
+
+
+def validate_spec_axes(spec: PartitionSpec, mesh: Mesh, path: str = "") -> None:
+    """Raise eagerly (with the param path) when a spec names mesh axes that
+    don't exist — instead of the opaque ``KeyError: 'model'`` the first
+    ``mesh.shape[...]`` lookup would produce deep inside spec plumbing."""
+    unknown = unknown_spec_axes(spec, mesh)
+    if unknown:
+        where = f" for param {path!r}" if path else ""
+        raise ValueError(
+            f"PartitionSpec {spec}{where} references mesh axes "
+            f"{list(unknown)} that are not in the mesh (axes: "
+            f"{tuple(mesh.axis_names)}). Fix the sharding rule/spec, or add "
+            "the axis to the mesh (MeshConfig / ATX_MESH_*)."
+        )
+
+
 def mesh_axis_size(mesh: Mesh, axis: str | Sequence[str]) -> int:
     if isinstance(axis, str):
         return mesh.shape[axis]

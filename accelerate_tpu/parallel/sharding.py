@@ -31,9 +31,48 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..utils.dataclasses import FsdpPlugin, ShardingStrategyType, TensorParallelPlugin
-from .mesh import BATCH_AXES, FSDP_AXIS, TENSOR_AXIS
+from .mesh import (
+    BATCH_AXES,
+    FSDP_AXIS,
+    TENSOR_AXIS,
+    spec_entry_axes,
+    validate_spec_axes,
+)
 
 Rules = Sequence[tuple[str, PartitionSpec]]
+
+
+class ShardingSpecWarning(UserWarning):
+    """A requested PartitionSpec entry was dropped because the dim is not
+    divisible by the mesh axis-group size, so the dim replicates instead.
+
+    Structured (``path``/``dim``/``entry``/``dim_size``/``group``/``axes``
+    attributes) so tooling can consume it — the static analyzer re-emits it
+    as rule ATX101. On TPU this replication is the silent 5-50x slowdown
+    mode: XLA inserts a full copy per device instead of erroring.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        dim: int,
+        entry: Any,
+        dim_size: int,
+        group: int,
+        axes: tuple[str, ...],
+    ) -> None:
+        self.path = path
+        self.dim = dim
+        self.entry = entry
+        self.dim_size = dim_size
+        self.group = group
+        self.axes = axes
+        super().__init__(
+            f"PartitionSpec entry {entry!r} dropped for "
+            f"{path or '<param>'} dim {dim}: size {dim_size} is not "
+            f"divisible by mesh axes {list(axes)} (group size {group}); "
+            "the dim stays replicated on every device"
+        )
 
 
 @dataclass
@@ -137,21 +176,38 @@ def _shard_largest_dim(
     return PartitionSpec(*spec)
 
 
-def _sanitize_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+def _sanitize_spec(
+    spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh, path: str = ""
+) -> PartitionSpec:
     """Drop sharding on dims the mesh can't divide evenly, replicating them
     instead. This is what makes one plan serve many topologies — e.g. GQA
     kv-head projections replicate when num_kv_heads < tensor-parallel size
-    (the analog of torch TP falling back to replicated DTensor placements)."""
+    (the analog of torch TP falling back to replicated DTensor placements).
+    The drop is never silent: a structured :class:`ShardingSpecWarning`
+    (carrying the param path) fires so the replicated copy is visible before
+    a pod run pays for it, and unknown axis names raise eagerly with the
+    path instead of a bare ``KeyError``."""
+    import warnings
+
+    validate_spec_axes(spec, mesh, path)
     out: list[Any] = []
     for d, entry in enumerate(spec):
         if entry is None:
             out.append(None)
             continue
-        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        axes = spec_entry_axes(entry)
         group = int(np.prod([mesh.shape[a] for a in axes]))
         if group > 1 and shape[d] % group == 0:
             out.append(entry)
         else:
+            if group > 1:
+                # Size-1 axis groups shard nothing by construction (the
+                # canonical form drops them too) — only an indivisible dim
+                # is a real "you asked for sharding, got replication" event.
+                warnings.warn(
+                    ShardingSpecWarning(path, d, entry, shape[d], group, axes),
+                    stacklevel=2,
+                )
             out.append(None)
     return PartitionSpec(*out)
 
@@ -185,7 +241,7 @@ def infer_param_specs(
             return PartitionSpec()
         matched = _apply_rules(path_s, shape, strategy.rules)
         if matched is not None:
-            return _sanitize_spec(matched, shape, mesh)
+            return _sanitize_spec(matched, shape, mesh, path=path_s)
         if kind == ShardingStrategyType.TENSOR_PARALLEL:
             return PartitionSpec()
         # FSDP and HYBRID fall back to sharding the largest divisible dim.
@@ -242,19 +298,24 @@ def infer_opt_specs(
     return jax.tree.map(map_subtree, opt_state_shapes, is_leaf=is_params_like)
 
 
-def canonicalize_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+def canonicalize_spec(spec: PartitionSpec, mesh: Mesh, path: str = "") -> PartitionSpec:
     """Normalize a spec to the form XLA hands back: size-1 mesh axes shard
     nothing (drop them) and trailing ``None`` entries are implicit. Without
     this, a planned ``P(('data','fsdp'), None)`` on an fsdp=1 mesh and the
     ``P('data')`` XLA returns for it compare unequal, so a train step whose
     output constraint uses the planned form recompiles when the state round
-    -trips into the next call."""
+    -trips into the next call.
+
+    Axis names the mesh doesn't define raise HERE, eagerly, with ``path``
+    in the message — not at ``NamedSharding`` construction, whose error
+    names neither the param nor the offending axis."""
+    validate_spec_axes(spec, mesh, path)
     entries: list[Any] = []
     for e in spec:
         if e is None:
             entries.append(None)
             continue
-        axes = (e,) if isinstance(e, str) else tuple(e)
+        axes = spec_entry_axes(e)
         axes = tuple(a for a in axes if mesh.shape[a] > 1)
         entries.append(None if not axes else (axes[0] if len(axes) == 1 else axes))
     while entries and entries[-1] is None:

@@ -1,0 +1,524 @@
+"""Static analyzer (`accelerate_tpu.analysis`, `atx lint`) — every rule
+family fires on a seeded defect and stays quiet on the clean `examples/`
+configurations; the `prepare(lint=...)` and CLI surfaces are exercised end
+to end. Runs on the 8-device CPU simulation (conftest) under jax 0.4.37.
+"""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import accelerate_tpu as atx
+from accelerate_tpu import analysis
+from accelerate_tpu.analysis import LintError, Severity
+from accelerate_tpu.parallel.mesh import MeshConfig, build_mesh
+from accelerate_tpu.parallel.sharding import (
+    ShardingSpecWarning,
+    ShardingStrategy,
+    _sanitize_spec,
+    canonicalize_spec,
+)
+from accelerate_tpu.state import AcceleratorState
+from accelerate_tpu.utils.dataclasses import FsdpPlugin, ShardingStrategyType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def mesh8():
+    return build_mesh(MeshConfig(data=1, fsdp=8))
+
+
+def sds(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def ids(report, min_severity=Severity.INFO):
+    return {f.rule_id for f in report.filter(min_severity)}
+
+
+# --------------------------------------------------------------- satellites
+class TestSanitizeSpecWarning:
+    """Satellite: `_sanitize_spec` must not drop spec axes silently."""
+
+    def test_indivisible_dim_emits_structured_warning(self, mesh8):
+        with pytest.warns(ShardingSpecWarning) as rec:
+            out = _sanitize_spec(P("fsdp"), (513,), mesh8, path="blocks/w")
+        assert out == P(None)
+        w = rec.list[0].message
+        assert (w.path, w.dim, w.dim_size, w.group) == ("blocks/w", 0, 513, 8)
+        assert "blocks/w" in str(w)
+
+    def test_divisible_dim_is_quiet(self, mesh8):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ShardingSpecWarning)
+            assert _sanitize_spec(P("fsdp"), (512,), mesh8, path="w") == P("fsdp")
+
+    def test_size_one_axis_drop_is_quiet(self, mesh8):
+        # Dropping a size-1 axis is canonicalization, not replication.
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ShardingSpecWarning)
+            assert _sanitize_spec(P("tensor"), (513,), mesh8, path="w") == P(None)
+
+
+class TestCanonicalizeEagerValidation:
+    """Satellite: unknown axes raise eagerly with the param path, not at
+    NamedSharding construction with a bare KeyError."""
+
+    def test_unknown_axis_raises_with_path(self, mesh8):
+        with pytest.raises(ValueError, match=r"blocks/wq.*model|model.*blocks/wq"):
+            canonicalize_spec(P("model"), mesh8, path="blocks/wq")
+
+    def test_error_names_available_axes(self, mesh8):
+        with pytest.raises(ValueError, match="data"):
+            canonicalize_spec(P("model"), mesh8)
+
+    def test_known_axes_still_canonicalize(self, mesh8):
+        # fsdp=8 stays; data=1 and trailing None drop (existing contract).
+        assert canonicalize_spec(P(("data", "fsdp"), None), mesh8) == P("fsdp")
+
+    def test_sanitize_spec_unknown_axis_also_eager(self, mesh8):
+        with pytest.raises(ValueError, match="w1"):
+            _sanitize_spec(P("model"), (64,), mesh8, path="w1")
+
+
+# ------------------------------------------------------------ ATX1xx rules
+class TestShardingRules:
+    def test_atx101_fires_on_indivisible_rule(self, mesh8):
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.TENSOR_PARALLEL, rules=((r"w1", P("fsdp")),)
+        )
+        report = analysis.lint_specs({"w1": sds(513, 64)}, mesh8, strategy=strategy)
+        (f,) = report.filter(family="ATX101")
+        assert f.severity == Severity.WARNING and f.path == "w1"
+        assert "513" in f.message
+
+    def test_atx101_fires_on_explicit_specs(self, mesh8):
+        report = analysis.lint_specs(
+            {"w1": sds(513, 64)}, mesh8, param_specs={"w1": P("fsdp")}
+        )
+        assert ids(report) >= {"ATX101"}
+
+    def test_atx102_fires_on_unknown_axis(self, mesh8):
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.TENSOR_PARALLEL, rules=((r".*", P("model")),)
+        )
+        report = analysis.lint_specs({"w1": sds(64, 64)}, mesh8, strategy=strategy)
+        (f,) = report.filter(family="ATX102")
+        assert f.severity == Severity.ERROR and report.has_errors
+        assert "model" in f.message
+
+    def test_atx103_fires_on_large_replicated_param(self, mesh8):
+        # FSDP intends sharding, but both dims are indivisible by 8 so the
+        # fallback replicates a >1 MiB param.
+        strategy = ShardingStrategy(kind=ShardingStrategyType.FSDP)
+        report = analysis.lint_specs({"big": sds(513, 513)}, mesh8, strategy=strategy)
+        (f,) = report.filter(family="ATX103")
+        assert "replicated" in f.message
+
+    def test_atx103_gated_off_for_data_parallel(self, mesh8):
+        # Replication is DATA_PARALLEL's contract, not a bug.
+        strategy = ShardingStrategy(kind=ShardingStrategyType.DATA_PARALLEL)
+        report = analysis.lint_specs({"big": sds(513, 513)}, mesh8, strategy=strategy)
+        assert not report.filter(family="ATX103")
+
+    def test_atx104_fires_on_conflicting_opt_specs(self, mesh8):
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.FSDP, fsdp=FsdpPlugin(min_weight_size=0)
+        )
+        params = {"w": sds(512, 512)}
+        tx = optax.adam(1e-3)  # mu/nu moments mirror the params pytree
+        opt_shapes = jax.eval_shape(tx.init, params)
+        report = analysis.lint_specs(
+            params,
+            mesh8,
+            strategy=strategy,
+            opt_shapes=opt_shapes,
+            opt_specs=jax.tree.map(
+                lambda _: P(), opt_shapes, is_leaf=lambda x: x is None
+            ),
+        )
+        assert ids(report) >= {"ATX104"}
+
+    def test_atx104_quiet_when_specs_mirror(self, mesh8):
+        from accelerate_tpu.parallel.sharding import (
+            infer_opt_specs,
+            infer_param_specs,
+        )
+
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.FSDP, fsdp=FsdpPlugin(min_weight_size=0)
+        )
+        params = {"w": sds(512, 512)}
+        tx = optax.adam(1e-3)
+        opt_shapes = jax.eval_shape(tx.init, params)
+        pspecs = infer_param_specs(params, mesh8, strategy)
+        ospecs = infer_opt_specs(opt_shapes, params, pspecs, mesh8, strategy)
+        report = analysis.lint_specs(
+            params, mesh8, strategy=strategy, opt_shapes=opt_shapes, opt_specs=ospecs
+        )
+        assert not report.filter(family="ATX104")
+
+    def test_atx105_reports_hbm_accounting(self, mesh8):
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.FSDP, fsdp=FsdpPlugin(min_weight_size=0)
+        )
+        report = analysis.lint_specs({"w": sds(512, 512)}, mesh8, strategy=strategy)
+        (f,) = report.filter(family="ATX105")
+        # 512*512*4/8 params + same again fp32 grads = 256 KiB.
+        assert "params 128.00 KiB" in f.message and "grads 128.00 KiB" in f.message
+
+
+# ------------------------------------------------------------ ATX2xx rules
+def _grad_step(state, batch):
+    g = jax.grad(lambda w, x: jnp.tanh(x @ w).sum())(state["w"], batch)
+    return {"w": state["w"] - 0.1 * g}, g.mean()
+
+
+class TestDonationRules:
+    @pytest.fixture
+    def fsdp_args(self, mesh8):
+        w = jax.ShapeDtypeStruct(
+            (512, 512), jnp.float32, sharding=NamedSharding(mesh8, P("fsdp"))
+        )
+        b = jax.ShapeDtypeStruct(
+            (16, 512), jnp.float32, sharding=NamedSharding(mesh8, P())
+        )
+        return {"w": w}, b
+
+    def test_atx201_fires_without_donation(self, mesh8, fsdp_args):
+        state, batch = fsdp_args
+        report = analysis.lint_step(
+            _grad_step, state, batch, mesh=mesh8, params_shapes=state
+        )
+        (f,) = report.filter(family="ATX201")
+        assert "args[0]" == f.path and "2x" in f.message
+
+    def test_atx201_quiet_when_donated(self, mesh8, fsdp_args):
+        state, batch = fsdp_args
+        report = analysis.lint_step(
+            _grad_step, state, batch, mesh=mesh8, donate_argnums=(0,),
+            params_shapes=state,
+        )
+        assert not report.filter(family="ATX2")
+
+    def test_atx202_fires_when_xla_drops_donation(self, mesh8, fsdp_args):
+        # The returned state casts to bf16, so no output can alias the
+        # donated fp32 buffer — jax 0.4.x drops SHARDED-arg donations
+        # silently, which is exactly why a static rule must catch it.
+        def cast_step(state, batch):
+            g = jax.grad(lambda w, x: jnp.tanh(x @ w).sum())(state["w"], batch)
+            return {"w": (state["w"] - 0.1 * g).astype(jnp.bfloat16)}
+
+        state, batch = fsdp_args
+        report = analysis.lint_step(
+            cast_step, state, batch, mesh=mesh8, donate_argnums=(0,),
+            params_shapes=state,
+        )
+        (f,) = report.filter(family="ATX202")
+        assert "donation" in f.message
+
+    def test_atx202_fires_on_unsharded_dropped_donation(self):
+        def cast_step(state):
+            return {"w": state["w"].astype(jnp.bfloat16)}
+
+        report = analysis.lint_step(
+            cast_step, {"w": sds(512, 512)}, donate_argnums=(0,)
+        )
+        assert ids(report) >= {"ATX202"}
+
+
+# ------------------------------------------------------------ ATX3xx rules
+class TestRecompilationRules:
+    def test_atx301_unhashable_static_is_error(self):
+        report = analysis.lint_step(
+            lambda x, cfg: x * cfg[0], sds(16, 8), [1, 2], static_argnums=(1,)
+        )
+        (f,) = report.filter(family="ATX301")
+        assert f.severity == Severity.ERROR and report.has_errors
+
+    def test_atx301_float_static_is_info(self):
+        report = analysis.lint_step(
+            lambda x, lr: x * lr, sds(16, 8), 0.1, static_argnums=(1,)
+        )
+        (f,) = report.filter(family="ATX301")
+        assert f.severity == Severity.INFO and "recompile" in f.message
+
+    def test_atx302_fires_on_shape_drift(self):
+        report = analysis.lint_step(
+            lambda x: x.sum(), sds(16, 8), alternates=[(sds(12, 8),)]
+        )
+        (f,) = report.filter(family="ATX302")
+        assert "(16, 8)" in f.message and "(12, 8)" in f.message
+
+    def test_atx303_fires_on_dtype_drift(self):
+        report = analysis.lint_step(
+            lambda x: x.sum(),
+            sds(16, 8),
+            alternates=[(sds(16, 8, dtype=jnp.float64),)],
+        )
+        assert ids(report) >= {"ATX303"}
+        assert not report.filter(family="ATX302")
+
+    def test_atx303_fires_on_weak_type_flip(self):
+        strong = jnp.zeros((), jnp.float32)
+        weak = jnp.asarray(1.0)  # weak-typed f32 (Python-scalar style)
+        assert weak.weak_type and not strong.weak_type
+        report = analysis.lint_step(
+            lambda x: x * 2, strong, alternates=[(weak,)]
+        )
+        (f,) = report.filter(family="ATX303")
+        assert "weak" in f.message
+
+    def test_quiet_when_signatures_match(self):
+        report = analysis.lint_step(
+            lambda x: x.sum(), sds(16, 8), alternates=[(sds(16, 8),)]
+        )
+        assert not report.filter(family="ATX3")
+
+
+# ------------------------------------------------------------ ATX4xx rules
+class TestHostSyncAndCollectiveRules:
+    def test_atx401_fires_on_pure_callback(self):
+        def step(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a), jax.ShapeDtypeStruct((), jnp.float32), x.sum()
+            )
+            return x + y
+
+        report = analysis.lint_step(step, sds(16, 8))
+        (f,) = report.filter(family="ATX401")
+        assert "pure_callback" in f.message
+
+    def test_atx402_fires_on_debug_print(self):
+        def step(x):
+            jax.debug.print("loss={v}", v=x.sum())
+            return x * 2
+
+        report = analysis.lint_step(step, sds(16, 8))
+        assert ids(report) >= {"ATX402"}
+
+    def test_atx403_fires_on_full_param_gather(self, mesh8):
+        w = jax.ShapeDtypeStruct(
+            (512, 512), jnp.float32, sharding=NamedSharding(mesh8, P("fsdp"))
+        )
+        b = jax.ShapeDtypeStruct(
+            (16, 512), jnp.float32, sharding=NamedSharding(mesh8, P())
+        )
+
+        def step(state, batch):
+            # Constraining the sharded param to replicated forces GSPMD to
+            # all-gather the full parameter every step — the accidental
+            # replication this rule exists for.
+            full = jax.lax.with_sharding_constraint(
+                state["w"], NamedSharding(mesh8, P())
+            )
+            return (batch @ full).sum()
+
+        report = analysis.lint_step(
+            step,
+            {"w": w},
+            b,
+            mesh=mesh8,
+            params_shapes={"w": w},
+            gather_bytes_threshold=1 << 10,
+        )
+        (f,) = report.filter(family="ATX403")
+        assert "all-gather" in f.message and "1.00 MiB" in f.message
+
+    def test_atx404_summarizes_collective_traffic(self, mesh8):
+        w = jax.ShapeDtypeStruct(
+            (512, 512), jnp.float32, sharding=NamedSharding(mesh8, P("fsdp"))
+        )
+        b = jax.ShapeDtypeStruct(
+            (16, 512), jnp.float32, sharding=NamedSharding(mesh8, P())
+        )
+        report = analysis.lint_step(_grad_step, {"w": w}, b, mesh=mesh8)
+        (f,) = report.filter(family="ATX404")
+        assert "all-reduce" in f.message
+
+    def test_quiet_on_collective_free_step(self):
+        report = analysis.lint_step(lambda x: (x @ x.T).sum(), sds(16, 16))
+        assert not report.filter(family="ATX4", min_severity=Severity.WARNING)
+
+    def test_hlo_shape_parser(self):
+        from accelerate_tpu.analysis.rules_collectives import parse_collectives
+
+        hlo = """
+        %ag = f32[512,512]{1,0} all-gather(f32[64,512]{1,0} %p), dimensions={0}
+        %ar = (bf16[8,4]{1,0}, bf16[8,4]{1,0}) all-reduce(...)
+        %cp = u8[16]{0} collective-permute-start(u8[16]{0} %x)
+        %done = f32[4] all-reduce-done(f32[4] %ar2)
+        """
+        parsed = parse_collectives(hlo)
+        assert ("all-gather", 512 * 512 * 4) in parsed
+        assert ("all-reduce", 2 * 8 * 4 * 2) in parsed
+        assert ("collective-permute", 16) in parsed
+        # -done ops are the completion half of -start; not double-counted.
+        assert len(parsed) == 3
+
+
+# ------------------------------------------------- clean example configs
+@pytest.fixture(scope="module")
+def nlp_clean_report():
+    """One shared lint of the real nlp_example training step (the compile
+    is the expensive part; every family's clean-config assertion reads it)."""
+    from accelerate_tpu.commands.lint import SCENARIOS
+
+    AcceleratorState._reset_state()
+    try:
+        _, report = SCENARIOS["nlp_example"]()
+    finally:
+        AcceleratorState._reset_state()
+    return report
+
+
+class TestCleanOnExamples:
+    @pytest.mark.parametrize("family", ["ATX1", "ATX2", "ATX3", "ATX4"])
+    def test_family_quiet_on_clean_example(self, nlp_clean_report, family):
+        findings = nlp_clean_report.filter(
+            min_severity=Severity.WARNING, family=family
+        )
+        assert not findings, [f.format() for f in findings]
+
+    def test_clean_report_still_carries_accounting(self, nlp_clean_report):
+        assert ids(nlp_clean_report) >= {"ATX105"}
+
+
+# ------------------------------------------------------ prepare integration
+class TestPrepareIntegration:
+    def _bad_axis_accelerator(self):
+        AcceleratorState._reset_state()
+        return atx.Accelerator(
+            seed=0,
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=[(".*", P("model"))],
+            mesh_config=MeshConfig(data=1, tensor=8),
+        )
+
+    def test_prepare_lint_error_raises_on_missing_axis(self):
+        acc = self._bad_axis_accelerator()
+        state = atx.TrainState.create(
+            params={"w": jnp.zeros((64, 64))}, tx=optax.sgd(1e-2)
+        )
+        with pytest.raises(LintError, match="ATX102"):
+            acc.prepare(state, lint="error")
+
+    def test_prepare_lint_warn_surfaces_and_proceeds(self):
+        AcceleratorState._reset_state()
+        acc = atx.Accelerator(
+            seed=0,
+            strategy="TENSOR_PARALLEL",
+            sharding_rules=[("w", P("tensor"))],
+            mesh_config=MeshConfig(data=1, tensor=8),
+        )
+        state = atx.TrainState.create(
+            params={"w": jnp.zeros((63, 63))}, tx=optax.sgd(1e-2)
+        )
+        with pytest.warns(analysis.AnalysisWarning, match="ATX101"):
+            prepared = acc.prepare(state, lint="warn")
+        # Indivisible dim replicates (the sanitize fallback) but training
+        # proceeds — warn mode never blocks.
+        assert prepared.params["w"].sharding.spec == P()
+
+    def test_prepare_lint_env_default(self, monkeypatch):
+        monkeypatch.setenv("ATX_LINT", "error")
+        acc = self._bad_axis_accelerator()
+        state = atx.TrainState.create(
+            params={"w": jnp.zeros((64, 64))}, tx=optax.sgd(1e-2)
+        )
+        with pytest.raises(LintError):
+            acc.prepare(state)
+
+    def test_prepare_rejects_bogus_mode(self):
+        AcceleratorState._reset_state()
+        acc = atx.Accelerator(seed=0)
+        with pytest.raises(ValueError, match="lint"):
+            acc.prepare(lint="loud")
+
+
+# ------------------------------------------------------------------- CLI
+class TestLintCli:
+    def test_rules_flag_lists_catalogue(self, capsys):
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", "--rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ("ATX101", "ATX102", "ATX201", "ATX301", "ATX403"):
+            assert rule_id in out
+
+    def test_list_flag_names_scenarios(self, capsys):
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", "--list"]) == 0
+        out = capsys.readouterr().out
+        assert "nlp_example" in out and "lm_example" in out
+
+    def test_unknown_target_exits_2(self, capsys):
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", "no_such_example.py"]) == 2
+        assert "no scenario registered" in capsys.readouterr().err
+
+    def test_target_resolution(self):
+        from accelerate_tpu.commands.lint import resolve_targets
+
+        names, unmatched = resolve_targets(
+            [os.path.join(REPO, "examples", "nlp_example.py"), "lm_example"]
+        )
+        assert names == ["nlp_example", "lm_example"] and not unmatched
+        names, unmatched = resolve_targets([os.path.join(REPO, "examples")])
+        assert set(names) == {"nlp_example", "lm_example", "cv_example"}
+
+    def test_lint_examples_exits_zero(self, capsys):
+        """Acceptance: `atx lint examples/` exits 0 on the shipped examples."""
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", os.path.join(REPO, "examples")]) == 0
+        out = capsys.readouterr().out
+        assert "nlp_example" in out and "lm_example" in out and "cv_example" in out
+
+
+# -------------------------------------- estimate vs analyzer cross-check
+class TestEstimateCrossCheck:
+    def test_estimate_agrees_with_analyzer_within_5pct(self, mesh8):
+        """`atx estimate`'s heuristic params+grads+moments arithmetic and
+        the analyzer's spec-aware per-device accounting must agree on a
+        reference model (bert-base, fp32, adamw, 8-way FSDP)."""
+        from accelerate_tpu.analysis.hbm import state_hbm_per_device
+        from accelerate_tpu.commands.estimate import estimate
+        from accelerate_tpu.models import bert
+        from accelerate_tpu.parallel.sharding import (
+            infer_opt_specs,
+            infer_param_specs,
+        )
+
+        r = estimate(
+            "bert-base", batch_size=8, seq_len=128, precision="no",
+            optimizer="adamw", shards=8, remat=False,
+        )
+        est_state_bytes = r["params"] + r["grads"] + r["optimizer"]
+
+        strategy = ShardingStrategy(
+            kind=ShardingStrategyType.FSDP, fsdp=FsdpPlugin(min_weight_size=0)
+        )
+        shapes = jax.eval_shape(
+            lambda rng: bert.init(rng, r["config"]), jax.random.PRNGKey(0)
+        )
+        pspecs = infer_param_specs(shapes, mesh8, strategy)
+        tx = optax.adamw(1e-3)
+        opt_shapes = jax.eval_shape(tx.init, shapes)
+        ospecs = infer_opt_specs(opt_shapes, shapes, pspecs, mesh8, strategy)
+        acct = state_hbm_per_device(
+            shapes, pspecs, mesh8, opt_shapes=opt_shapes, opt_specs=ospecs
+        )
+        assert abs(acct.total - est_state_bytes) / est_state_bytes < 0.05, (
+            acct.format(),
+            est_state_bytes,
+        )
