@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer lint-graph
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve lint-graph
 
 test:
 	python -m pytest tests/ -q
@@ -17,6 +17,15 @@ test:
 # the engine unit tests plus the disk-offload overlap/sentinel integration.
 smoke-transfer:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_transfer.py tests/test_disk_offload.py -q -m 'not slow'
+
+# CPU smoke for the continuous-batching serving engine (docs/serving.md):
+# tiny model, a 16-request Poisson trace that must fully complete with
+# outputs bit-identical to solo generate (tests/test_serving.py), plus
+# `atx lint` over the engine's real decode step — error-severity findings
+# fail the lane.
+smoke-serve:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_generation.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint serving --severity error
 
 # Ahead-of-time step lint over the examples/ entry points (no training, no
 # weights): fails on any error-severity finding (docs/static_analysis.md).
@@ -29,5 +38,5 @@ lint-graph:
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph
+test-all: lint-graph smoke-serve
 	python -m pytest tests/ -q --heavy

@@ -23,6 +23,7 @@ from .big_modeling import (
 from .data import ArrayDataset, DataLoader, prepare_data_loader, skip_first_batches
 from .generation import GenerationConfig, Generator, generate
 from .speculative import SpeculativeGenerator, generate_speculative
+from . import serving
 from .models.hf import from_hf_config, load_pretrained, save_pretrained
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import (

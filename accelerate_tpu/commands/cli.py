@@ -21,7 +21,10 @@ def main(argv: list[str] | None = None) -> int:
 
     import importlib
 
-    for name in ("env", "config", "launch", "estimate", "lint", "test", "merge", "tpu"):
+    for name in (
+        "env", "config", "launch", "estimate", "lint", "serve", "test",
+        "merge", "tpu",
+    ):
         try:
             module = importlib.import_module(f".{name}", package=__package__)
         except ImportError as e:

@@ -170,10 +170,45 @@ def _scenario_cv_example(**options: Any):
     return f"convnet quadrant classifier, {acc!r}", report
 
 
+def _scenario_serving(**options: Any):
+    """serving.Engine decode step: the hot path of the continuous-batching
+    engine (docs/serving.md). Lints the REAL slot-batched decode function
+    with the engine's own abstract call signature — donation of the slot
+    cache, no host syncs/callbacks in the compiled step, stable shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import analysis
+    from ..generation import GenerationConfig
+    from ..models import llama
+    from ..serving import Engine
+
+    config = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
+    params = llama.init(jax.random.PRNGKey(0), config)
+    engine = Engine(
+        lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+        lambda b, m: llama.init_cache(config, b, m),
+        params,
+        GenerationConfig(eos_token_id=0),
+        slots=4,
+        buckets=(16, 32),
+        max_len=96,
+    )
+    report = analysis.lint_step(
+        engine._decode_fn,
+        *engine.abstract_decode_args(),
+        donate_argnums=(3,),
+        target="serving.Engine.decode",
+        **options,
+    )
+    return f"serving decode step, {engine.n_slots} slots", report
+
+
 SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "nlp_example": _scenario_nlp_example,
     "lm_example": _scenario_lm_example,
     "cv_example": _scenario_cv_example,
+    "serving": _scenario_serving,
 }
 
 

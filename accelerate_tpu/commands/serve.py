@@ -1,0 +1,207 @@
+"""`accelerate-tpu serve` / `atx serve` — continuous-batching micro-server.
+
+A benchmarking driver for `serving.Engine` (docs/serving.md): builds a
+model-zoo preset with random weights (or loads a local HF repo), replays a
+Poisson arrival trace of mixed-length requests through the engine, and
+prints one JSON line of serving metrics (`serve_tokens_per_sec`,
+`serve_p50_ms`, `serve_p99_ms`, occupancy) — the same fields bench.py's
+serve phase reports, runnable standalone on any host:
+
+    atx serve --model llama-tiny --slots 8 --requests 64 --rate 16
+
+``--compare-b1`` additionally runs the same request set sequentially
+through batch-1 `generate()` and reports the speedup (the ISSUE-3
+acceptance bar is >= 3x on a real chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "serve",
+        help="Continuous-batching serving benchmark (Poisson request trace)",
+    )
+    p.add_argument(
+        "--model",
+        default="llama-tiny",
+        help="model preset (see `atx estimate --list`) or a local HF repo path",
+    )
+    p.add_argument("--slots", type=int, default=None, help="KV slot pool size (ATX_SERVE_SLOTS)")
+    p.add_argument(
+        "--buckets",
+        default=None,
+        help="comma-separated prefill bucket lengths (ATX_SERVE_BUCKETS)",
+    )
+    p.add_argument("--max-len", type=int, default=None, help="per-slot KV capacity")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--rate", type=float, default=16.0, help="Poisson arrivals/sec")
+    p.add_argument("--prompt-lens", default="8:96", help="min:max prompt length")
+    p.add_argument("--new-tokens", default="8:48", help="min:max tokens per request")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--do-sample", action="store_true")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument(
+        "--realtime",
+        action="store_true",
+        help="honour arrival times on the wall clock (latency mode); "
+        "default replays the trace as fast as the engine drains it",
+    )
+    p.add_argument(
+        "--compare-b1",
+        action="store_true",
+        help="also run the request set sequentially through batch-1 "
+        "generate() and report the speedup",
+    )
+    p.set_defaults(func=run)
+
+
+def _span(text: str) -> tuple[int, int]:
+    lo, _, hi = text.partition(":")
+    return int(lo), int(hi or lo)
+
+
+def _build_model(name: str):
+    """(apply_fn, init_cache_fn, params, vocab_size) for a preset or local
+    HF repo. Presets initialize random bf16 weights — throughput is
+    weight-agnostic."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.path.isdir(name):
+        import accelerate_tpu as atx
+        from accelerate_tpu.models import llama
+
+        loaded = atx.load_pretrained(name, dtype=jnp.bfloat16)
+        cfg = loaded.config
+        return (
+            lambda p, t, c: llama.forward_with_cache(p, t, c, cfg),
+            lambda b, m: llama.init_cache(cfg, b, m),
+            loaded.params,
+            cfg.vocab_size,
+        )
+    from .estimate import _MODEL_PRESETS
+
+    if name not in _MODEL_PRESETS:
+        raise SystemExit(
+            f"unknown model {name!r}; pick from `atx estimate --list` or "
+            "pass a local HF repo path"
+        )
+    family_name, preset = _MODEL_PRESETS[name]
+    import importlib
+
+    family = importlib.import_module(f"accelerate_tpu.models.{family_name}")
+    if not hasattr(family, "forward_with_cache"):
+        raise SystemExit(
+            f"{name} is a {family_name} model — no decode cache path; pick "
+            "a decoder preset (llama-*, gpt*)"
+        )
+    config_cls = {"llama": "LlamaConfig", "gpt": "GPTConfig"}[family_name]
+    cfg = getattr(getattr(family, config_cls), preset)()
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        family.init(jax.random.PRNGKey(0), cfg),
+    )
+    return (
+        lambda p, t, c: family.forward_with_cache(p, t, c, cfg),
+        lambda b, m: family.init_cache(cfg, b, m),
+        params,
+        cfg.vocab_size,
+    )
+
+
+def run(args: argparse.Namespace) -> int:
+    import numpy as np
+
+    from ..generation import GenerationConfig, Generator
+    from ..serving import Engine, poisson_trace
+
+    apply_fn, init_cache_fn, params, vocab = _build_model(args.model)
+    prompt_lens = _span(args.prompt_lens)
+    new_tokens = _span(args.new_tokens)
+    buckets = (
+        tuple(int(b) for b in args.buckets.split(",")) if args.buckets else None
+    )
+    config = GenerationConfig(
+        do_sample=args.do_sample, temperature=args.temperature
+    )
+    max_len = args.max_len
+    if max_len is None:
+        # Fit the worst-case request: prompt rounded up to a bucket + budget.
+        from ..serving import default_buckets
+
+        bs = buckets or default_buckets()
+        rounded = min((b for b in bs if b >= prompt_lens[1]), default=None)
+        top = rounded if rounded is not None else -(-prompt_lens[1] // bs[-1]) * bs[-1]
+        max_len = top + new_tokens[1]
+    engine = Engine(
+        apply_fn,
+        init_cache_fn,
+        params,
+        config,
+        slots=args.slots,
+        buckets=buckets,
+        max_len=max_len,
+    )
+    trace = poisson_trace(
+        args.requests,
+        args.rate,
+        vocab_size=vocab,
+        prompt_lens=prompt_lens,
+        new_tokens=new_tokens,
+        seed=args.seed,
+    )
+    t0 = time.perf_counter()
+    completions = engine.serve(trace, realtime=args.realtime)
+    wall = time.perf_counter() - t0
+
+    total_new = sum(c.n_new for c in completions)
+    lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in completions)
+    ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in completions)
+    pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]
+    result = {
+        "serve_requests": len(completions),
+        "serve_tokens_per_sec": round(total_new / max(wall, 1e-9), 1),
+        "serve_wall_s": round(wall, 2),
+        "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
+        "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
+        "serve_ttft_p50_ms": round(pick(ttft_ms, 0.50), 1),
+        "serve_slots": engine.n_slots,
+        "serve_buckets": list(engine.buckets),
+        "serve_prefill_compiles": engine._prefill._cache_size(),
+        "serve_decode_compiles": engine._decode._cache_size(),
+        "serve_occupancy": round(
+            engine.stats["decode_slot_steps"]
+            / max(engine.stats["decode_steps"] * engine.n_slots, 1),
+            3,
+        ),
+    }
+    if args.compare_b1:
+        gens: dict[int, Generator] = {}
+        t0 = time.perf_counter()
+        for r in trace:
+            g = gens.setdefault(
+                r.max_new_tokens,
+                Generator(
+                    apply_fn,
+                    init_cache_fn,
+                    GenerationConfig(
+                        max_new_tokens=r.max_new_tokens,
+                        do_sample=args.do_sample,
+                        temperature=args.temperature,
+                    ),
+                ),
+            )
+            out = g(params, np.asarray(r.prompt)[None])
+            int(np.asarray(out[0, -1]))  # fetch barrier
+        b1_wall = time.perf_counter() - t0
+        result["serve_b1_sequential_s"] = round(b1_wall, 2)
+        result["serve_vs_b1_speedup"] = round(b1_wall / max(wall, 1e-9), 2)
+    print(json.dumps(result))
+    return 0

@@ -16,7 +16,10 @@ because the performant shape is specific to XLA:
   per token for a 450M model on v5e — the per-call overhead, amortized away
   at real batch sizes;
 - EOS handling uses a carried `done` flag + `where` (no data-dependent
-  control flow under jit); finished rows emit ``pad_token_id``;
+  control flow under jit); finished rows emit ``pad_token_id``. The host
+  loop polls the carried mask every ``eos_check_every`` steps and exits
+  once every row is done, so short completions don't pay the full
+  ``max_new_tokens`` of decode steps;
 - sampling (greedy/temperature/top-k/top-p) is pure `jax.random` given the
   carried PRNG key, so generations are reproducible by seed.
 
@@ -33,6 +36,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["GenerationConfig", "Generator", "sample_tokens", "warp_logits", "generate"]
 
@@ -109,9 +113,26 @@ class Generator:
         config: GenerationConfig | None = None,
         *,
         jit_loop: bool = True,
+        eos_check_every: int = 8,
     ) -> None:
         self.config = config or GenerationConfig()
         self.init_cache_fn = init_cache_fn
+        # With an eos configured, the host loop syncs the carried `done`
+        # mask every `eos_check_every` dispatched steps and stops early once
+        # every row has finished — shorter completions cost fewer decode
+        # steps instead of always paying max_new_tokens. The chunking keeps
+        # the early exit from serializing every step on a device->host
+        # round trip (the same amortization speculative.py's host loop
+        # uses); the skipped tail is pure pad by the done/where discipline,
+        # so outputs are bit-identical with the exit on or off (tested).
+        # `lax.while_loop`/`lax.cond` variants were rejected deliberately:
+        # an end-to-end on-device loop explodes compile time over a
+        # scan-over-layers model (module docstring), and a cond-guarded
+        # step risks silently breaking the cache donation aliasing.
+        self.eos_check_every = max(1, eos_check_every)
+        # Forward passes (prefill + decode) the last __call__ dispatched —
+        # observability for the early-exit tests and bench.
+        self.last_steps = 0
         config_ = self.config
 
         def first_token(params, prompt, cache, rng):
@@ -154,9 +175,34 @@ class Generator:
         cache = self.init_cache_fn(B, S_prompt + self.config.max_new_tokens)
         token, cache, rng, done = self._first_token(params, prompt, cache, rng)
         tokens = [token]
-        for _ in range(self.config.max_new_tokens - 1):
-            token, cache, rng, done = self._decode_step(params, token, cache, rng, done)
-            tokens.append(token)
+        n_rest = self.config.max_new_tokens - 1
+        ran = 0
+        if self.config.eos_token_id is None:
+            # No eos -> `done` never flips; dispatch the whole loop with no
+            # host syncs (the original fire-and-forget pipeline).
+            for _ in range(n_rest):
+                token, cache, rng, done = self._decode_step(
+                    params, token, cache, rng, done
+                )
+                tokens.append(token)
+            ran = n_rest
+        else:
+            while ran < n_rest:
+                if bool(np.all(jax.device_get(done))):
+                    break
+                for _ in range(min(self.eos_check_every, n_rest - ran)):
+                    token, cache, rng, done = self._decode_step(
+                        params, token, cache, rng, done
+                    )
+                    tokens.append(token)
+                    ran += 1
+            if ran < n_rest:
+                # Every row is done: the skipped steps would each emit pure
+                # pad (decode_step's where(done, pad, .) discipline), so
+                # fill without running them.
+                pad = jnp.full((B,), self.config.pad_token_id, jnp.int32)
+                tokens.extend([pad] * (n_rest - ran))
+        self.last_steps = 1 + ran
         return jnp.concatenate([prompt] + [t[:, None] for t in tokens], axis=1)
 
 

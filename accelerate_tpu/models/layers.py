@@ -143,6 +143,34 @@ def cache_write_stacked(
     return all_buf, layer
 
 
+def cache_slot_view(kv: Any, slot: jax.Array) -> Any:
+    """Slice one slot row (batch axis 1) out of every layer-stacked KV leaf.
+
+    ``kv`` is a family cache dict WITHOUT its ``length`` cursor (leaves are
+    (L, B, T, ...) layer-stacked buffers — k/v and, for int8 caches, their
+    scales). ``slot`` is a traced int32 index, so one jitted caller serves
+    every slot without recompiling. The result is a batch-1 cache view the
+    family ``forward_with_cache`` runs on directly; pair with
+    `cache_slot_write` to fold the updated row back. This is the primitive
+    the serving engine's bucketed prefill rides: prefill computes on a
+    single slot's row while the other slots' entries stay untouched."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1), kv
+    )
+
+
+def cache_slot_write(kv: Any, row: Any, slot: jax.Array) -> Any:
+    """Write a batch-1 cache view (from `cache_slot_view`, after a forward
+    updated it) back into slot ``slot`` of the full slot-batched cache."""
+    return jax.tree.map(
+        lambda a, r: jax.lax.dynamic_update_slice_in_dim(
+            a, r.astype(a.dtype), slot, axis=1
+        ),
+        kv,
+        row,
+    )
+
+
 # ---------------------------------------------------------------------- rope
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:

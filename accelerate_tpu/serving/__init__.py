@@ -1,0 +1,7 @@
+"""Continuous-batching serving: slot-paged KV cache, bucketed chunked
+prefill, iteration-level scheduling. See `serving/engine.py` and
+docs/serving.md."""
+
+from .engine import Completion, Engine, Request, default_buckets, poisson_trace
+
+__all__ = ["Engine", "Request", "Completion", "poisson_trace", "default_buckets"]

@@ -1,0 +1,528 @@
+"""Iteration-level continuous-batching serving engine.
+
+The fixed-batch `generation.Generator` serves OFFLINE workloads well (one
+batch in, one batch out) but wastes the chip under traffic: every request
+pads to the longest prompt in its batch, the batch decodes until its LAST
+row finishes, and new arrivals wait for the whole batch to drain. BENCH_r05
+quantifies the lever: `decode_b1_tokens_per_sec 421.7` vs batch-8
+`decode_tokens_per_sec 3736.5` — keeping the decode batch full is ~8x.
+
+This engine applies the Orca iteration-level-scheduling idea in its
+XLA-native form (the vLLM slot/page design reduced to what a TPU actually
+needs — static shapes):
+
+- the KV cache is a fixed pool of ``slots`` (batch rows of one
+  slot-batched family cache); requests are admitted into free slots and
+  evicted on EOS / token budget, so the compiled decode step never sees a
+  shape change as traffic comes and goes;
+- per-slot length cursors ride the family cache contract
+  (``cache['length']`` as a (B,) vector, `models/layers.py:cache_write`) —
+  the cursors live on the HOST (the scheduler knows them deterministically)
+  and are shipped as a tiny (N,) int32 each step, which keeps the device
+  step pure and the whole engine replayable;
+- prefill is **bucketed and chunked**: prompts are split into chunks, each
+  padded to one of a small static set of bucket lengths, and each chunk is
+  computed on a single slot's cache ROW (`models/layers.py:cache_slot_view`
+  / `cache_slot_write`, slot index traced) — so prefill compiles at most
+  once per bucket (validated by the ATX302 drift checker in tests) and a
+  long prompt never stalls in-flight decodes: chunks interleave with decode
+  steps at a configurable ratio;
+- one jitted decode step runs over the FULL slot batch every time (free
+  slots compute garbage that is never read — the price of static shapes);
+  greedy outputs are bit-identical to solo `generate()` per request
+  (tested), because masked-out cache positions contribute exactly zero to
+  the fp32 softmax.
+
+Knobs: ``ATX_SERVE_SLOTS`` / ``ATX_SERVE_BUCKETS`` (comma-separated bucket
+lengths) set the defaults; see docs/serving.md for sizing guidance and when
+the plain `Generator` is still the right tool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import GenerationConfig, warp_logits
+from ..models.layers import cache_slot_view, cache_slot_write
+from ..utils.environment import get_int_from_env, get_str_from_env
+
+__all__ = ["Engine", "Request", "Completion", "poisson_trace", "default_buckets"]
+
+ApplyFn = Callable[[Any, jax.Array, Any], tuple[jax.Array, Any]]
+
+_DEFAULT_BUCKETS = (32, 64, 128, 256)
+
+
+def default_buckets() -> tuple[int, ...]:
+    """Prefill bucket lengths from ``ATX_SERVE_BUCKETS`` (comma-separated,
+    e.g. ``"16,64,256"``), else the built-in (32, 64, 128, 256)."""
+    raw = get_str_from_env(("ATX_SERVE_BUCKETS",), "")
+    if not raw:
+        return _DEFAULT_BUCKETS
+    try:
+        buckets = tuple(sorted({int(x) for x in raw.split(",") if x.strip()}))
+    except ValueError:
+        raise ValueError(
+            f"ATX_SERVE_BUCKETS={raw!r}: expected comma-separated ints"
+        ) from None
+    if not buckets or buckets[0] <= 0:
+        raise ValueError(f"ATX_SERVE_BUCKETS={raw!r}: buckets must be positive")
+    return buckets
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival`` is seconds relative to the trace
+    start (used by `Engine.serve(realtime=True)` and the bench); ``seed``
+    drives the per-request sampling stream, so a request's tokens don't
+    depend on which other requests share the batch."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rid: int = -1
+    seed: int = 0
+    arrival: float | None = None
+    stream: Callable[[int, int, str | None], None] | None = None
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request. ``tokens`` is (max_new_tokens,) int32 padded with
+    ``pad_token_id`` after EOS — the exact layout solo `generate()` emits
+    for the generated region, so bit-identity checks are a slice compare.
+    Timestamps are absolute `time.perf_counter()` values."""
+
+    rid: int
+    prompt: np.ndarray
+    tokens: np.ndarray
+    n_new: int
+    text: str | None
+    submitted_at: float
+    first_token_at: float
+    finished_at: float
+
+
+class _Slot:
+    __slots__ = (
+        "req", "chunks", "cursor", "n_new", "last_token", "out",
+        "first_token_at", "decoding",
+    )
+
+    def __init__(self, req: Request, chunks: list, pad: int) -> None:
+        self.req = req
+        self.chunks = chunks  # [(padded (1, bucket) np.int32, real_len), ...]
+        self.cursor = 0  # KV positions written & committed so far
+        self.n_new = 0
+        self.last_token = 0
+        self.out = np.full((req.max_new_tokens,), pad, np.int32)
+        self.first_token_at = 0.0
+        self.decoding = False
+
+
+class Engine:
+    """Continuous-batching engine over a family cached forward.
+
+    ``apply_fn(params, tokens, cache) -> (logits, cache)`` and
+    ``init_cache_fn(batch, max_len) -> cache`` follow the model-family
+    cache contract (e.g. `models/llama.py:forward_with_cache` /
+    ``init_cache``); every family cache whose non-``length`` leaves are
+    layer-stacked ``(L, B, T, ...)`` buffers works (bf16/fp32/int8).
+
+    ``max_len`` is the per-slot KV capacity (prompt + new tokens must fit);
+    defaults to ``2 * max(buckets)``. ``prefill_interleave`` is the number
+    of decode steps granted between two prefill chunks while both kinds of
+    work are pending (1 = strict alternation; 0 = prefill-first, which
+    stalls in-flight decodes for the whole prompt — the fixed-batch
+    behaviour this engine exists to avoid).
+    """
+
+    def __init__(
+        self,
+        apply_fn: ApplyFn,
+        init_cache_fn: Callable[[int, int], Any],
+        params: Any,
+        config: GenerationConfig | None = None,
+        *,
+        slots: int | None = None,
+        buckets: Sequence[int] | None = None,
+        max_len: int | None = None,
+        prefill_interleave: int = 1,
+        decode_block: int = 1,
+        detokenize: Callable[[Sequence[int]], str] | None = None,
+    ) -> None:
+        self.config = config or GenerationConfig()
+        self.n_slots = (
+            slots if slots is not None else get_int_from_env(("ATX_SERVE_SLOTS",), 8)
+        )
+        if self.n_slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.n_slots}")
+        self.buckets = tuple(sorted(set(buckets))) if buckets else default_buckets()
+        if self.buckets[0] <= 0:
+            raise ValueError(f"buckets must be positive, got {self.buckets}")
+        self.max_len = max_len if max_len is not None else 2 * self.buckets[-1]
+        self.prefill_interleave = prefill_interleave
+        # Decode steps dispatched per host sync. 1 = fetch every token
+        # (lowest admission/eviction latency); >1 chains steps on device and
+        # fetches their tokens in one device_get — the per-step round trip
+        # amortizes away (the speculative.py host-loop design). A slot that
+        # hits EOS mid-block zombie-decodes to the block end; its post-EOS
+        # tokens are discarded, so outputs still match solo generate()'s
+        # truncation exactly (tested).
+        self.decode_block = max(1, decode_block)
+        self.detokenize = detokenize
+        self.params = params
+        cache = init_cache_fn(self.n_slots, self.max_len)
+        kv = {k: v for k, v in cache.items() if k != "length"}
+        # Commit the slot pool (and remember its device): every decode /
+        # prefill output inherits this placement, so the jit signatures
+        # (which key on argument committedness) stay IDENTICAL from the
+        # first call on — one compile for decode, one per prefill bucket.
+        try:
+            self._device = sorted(
+                next(iter(jax.tree.leaves(kv))).devices(), key=str
+            )[0]
+        except Exception:
+            self._device = jax.devices()[0]
+        self._kv = jax.device_put(kv, self._device)
+        config_ = self.config
+        eos, pad = config_.eos_token_id, config_.pad_token_id
+
+        def _sample(logits, seed, n):
+            # Token n of a request draws from fold_in(PRNGKey(seed), n):
+            # stateless, so the stream is reproducible regardless of batch
+            # composition (solo replay gives the same tokens).
+            if not config_.do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), n)
+            return jax.random.categorical(key, warp_logits(logits, config_)).astype(
+                jnp.int32
+            )
+
+        def decode_fn(params, tokens, lengths, kv, seeds, steps):
+            """One token for every slot. Free/mid-prefill slots compute too
+            (static shapes) — their write lands at their cursor, a position
+            the next prefill chunk fully overwrites, and their output is
+            dropped by the host scheduler."""
+            logits, new = apply_fn(params, tokens[:, None], dict(kv, length=lengths))
+            nxt = jax.vmap(_sample)(logits[:, -1, :], seeds, steps)
+            return nxt, {k: new[k] for k in kv}
+
+        def prefill_fn(params, tokens, kv, slot, cursor, sample_pos, seed):
+            """One bucket-padded prompt chunk into slot row ``slot`` at
+            ``cursor``. Pad-tail KV lands at positions >= the row's real
+            cursor — never attended before decode overwrites it. The
+            returned token (sampled at ``sample_pos``, the chunk's last
+            REAL position) is only meaningful on a prompt's final chunk."""
+            row = cache_slot_view(kv, slot)
+            logits, new = apply_fn(params, tokens, dict(row, length=cursor))
+            kv = cache_slot_write(kv, {k: new[k] for k in row}, slot)
+            last = jnp.take_along_axis(logits[0], sample_pos[None, None], axis=0)[0]
+            tok = _sample(last, seed, jnp.zeros((), jnp.int32))
+            return tok, kv
+
+        self._decode_fn = decode_fn
+        self._prefill_fn = prefill_fn
+        self._decode = jax.jit(decode_fn, donate_argnums=(3,))
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+
+        self._queue: deque[Request] = deque()
+        self._slots: list[_Slot | None] = [None] * self.n_slots
+        self._free: deque[int] = deque(range(self.n_slots))
+        self._prefill_order: deque[int] = deque()  # slots with pending chunks
+        self._decode_credit = 0
+        self._next_rid = 0
+        self.prefill_signatures: list[int] = []  # bucket length per issued chunk
+        self.stats = {
+            "admitted": 0,
+            "completed": 0,
+            "prefill_chunks": 0,
+            "decode_steps": 0,
+            "decode_slot_steps": 0,  # active rows summed over decode steps
+        }
+        self.actions: list[str] = []  # "prefill" / "decode", for tests/traces
+
+    # ------------------------------------------------------------- submit
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int | None = None,
+        *,
+        seed: int = 0,
+        stream: Callable[[int, int, str | None], None] | None = None,
+        arrival: float | None = None,
+    ) -> int:
+        """Queue one request; returns its request id. ``stream`` is called
+        as ``stream(rid, token_id, text)`` for every generated token (text
+        is the detokenized piece when the engine has a detokenizer)."""
+        req = Request(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=(
+                max_new_tokens
+                if max_new_tokens is not None
+                else self.config.max_new_tokens
+            ),
+            seed=seed,
+            arrival=arrival,
+            stream=stream,
+        )
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> int:
+        if req.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        S = int(req.prompt.shape[0])
+        if S < 1:
+            raise ValueError("empty prompt")
+        if S + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"the engine's per-slot KV capacity max_len={self.max_len}"
+            )
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid) + 1
+        req.submitted_at = time.perf_counter()  # type: ignore[attr-defined]
+        self._queue.append(req)
+        return req.rid
+
+    # ---------------------------------------------------------- scheduler
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def _chunk_plan(self, prompt: np.ndarray) -> list[tuple[np.ndarray, int]]:
+        chunks = []
+        pos, S = 0, len(prompt)
+        while pos < S:
+            rem = S - pos
+            if rem > self.buckets[-1]:
+                bucket = self.buckets[-1]
+            else:
+                bucket = min(b for b in self.buckets if b >= rem)
+            real = min(rem, bucket)
+            buf = np.full((1, bucket), self.config.pad_token_id, np.int32)
+            buf[0, :real] = prompt[pos : pos + real]
+            chunks.append((buf, real))
+            pos += real
+        return chunks
+
+    def _admit(self) -> None:
+        while self._queue and self._free:
+            req = self._queue.popleft()
+            slot_id = self._free.popleft()
+            self._slots[slot_id] = _Slot(
+                req, self._chunk_plan(req.prompt), self.config.pad_token_id
+            )
+            self._prefill_order.append(slot_id)
+            self.stats["admitted"] += 1
+
+    def step(self) -> list[Completion]:
+        """One scheduler iteration: admit what fits, then run EITHER one
+        prefill chunk OR one decode step over the slot batch (prefill and
+        decode alternate per ``prefill_interleave`` when both are pending).
+        Returns the requests that finished this iteration."""
+        self._admit()
+        decoding = [i for i, s in enumerate(self._slots) if s is not None and s.decoding]
+        if self._prefill_order and (not decoding or self._decode_credit <= 0):
+            self._decode_credit = self.prefill_interleave
+            self.actions.append("prefill")
+            return self._prefill_step()
+        if decoding:
+            self._decode_credit -= 1
+            self.actions.append("decode")
+            return self._decode_step(decoding)
+        return []
+
+    def run_until_idle(self) -> list[Completion]:
+        out: list[Completion] = []
+        while self.busy:
+            out.extend(self.step())
+        return out
+
+    def serve(
+        self, requests: Iterable[Request], *, realtime: bool = False
+    ) -> list[Completion]:
+        """Drive a whole trace. ``realtime=True`` honours each request's
+        ``arrival`` offset on the wall clock (idle gaps are slept through)
+        — the latency-measuring mode; otherwise requests are submitted in
+        arrival order as fast as the engine drains them."""
+        reqs = sorted(requests, key=lambda r: (r.arrival or 0.0))
+        out: list[Completion] = []
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(reqs) or self.busy:
+            if i < len(reqs):
+                now = time.perf_counter() - t0
+                while i < len(reqs) and (
+                    not realtime or (reqs[i].arrival or 0.0) <= now
+                ):
+                    self.submit_request(reqs[i])
+                    i += 1
+                if realtime and not self.busy and i < len(reqs):
+                    time.sleep(
+                        max((reqs[i].arrival or 0.0) - (time.perf_counter() - t0), 0.0)
+                    )
+                    continue
+            out.extend(self.step())
+        return out
+
+    # ------------------------------------------------------------ actions
+    def _prefill_step(self) -> list[Completion]:
+        slot_id = self._prefill_order[0]
+        slot = self._slots[slot_id]
+        buf, real = slot.chunks.pop(0)
+        tok, self._kv = self._prefill(
+            self.params,
+            buf,
+            self._kv,
+            np.int32(slot_id),
+            np.int32(slot.cursor),
+            np.int32(real - 1),
+            np.uint32(slot.req.seed),
+        )
+        slot.cursor += real
+        self.stats["prefill_chunks"] += 1
+        self.prefill_signatures.append(buf.shape[1])
+        if slot.chunks:
+            return []  # more prompt to go; tok was a throwaway
+        self._prefill_order.popleft()
+        slot.first_token_at = time.perf_counter()
+        slot.decoding = True
+        return self._emit(slot_id, int(tok))
+
+    def _decode_step(self, decoding: list[int]) -> list[Completion]:
+        lengths = np.zeros((self.n_slots,), np.int32)
+        seeds = np.zeros((self.n_slots,), np.uint32)
+        steps = np.zeros((self.n_slots,), np.int32)
+        tokens: Any = np.zeros((self.n_slots,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue  # free slot: garbage write at 0, overwritten by the
+                # next admission's first prefill chunk
+            # Mid-prefill slots ride along too: their cursor points at the
+            # next chunk's start, so the row's garbage write lands exactly
+            # where that chunk will overwrite it — never on committed KV.
+            tokens[i] = s.last_token
+            lengths[i] = s.cursor
+            seeds[i] = s.req.seed
+            steps[i] = s.n_new
+        # Block dispatch: chain up to decode_block steps on device, bounded
+        # by the smallest remaining budget (so no step past a known budget
+        # eviction), then fetch all their tokens in ONE sync. Interleave
+        # granularity wins while prefill work is pending: block = 1.
+        block = min(self.decode_block, *(
+            self._slots[i].req.max_new_tokens - self._slots[i].n_new
+            for i in decoding
+        ))
+        if self._prefill_order:
+            block = 1
+        fetched = []
+        # Commit the seed tokens to the cache's device so the chained calls
+        # (whose token input is the previous step's committed OUTPUT) share
+        # one jit signature with the first — otherwise the decode step
+        # silently compiles twice (committed vs uncommitted int32 (N,)).
+        tokens = jax.device_put(tokens, self._device)
+        for _ in range(block):
+            tokens, self._kv = self._decode(
+                self.params, tokens, lengths, self._kv, seeds, steps
+            )
+            fetched.append(tokens)
+            lengths[decoding] += 1
+            steps[decoding] += 1
+        host_tokens = [np.asarray(t) for t in jax.device_get(fetched)]
+        self.stats["decode_steps"] += block
+        self.stats["decode_slot_steps"] += block * len(decoding)
+        out: list[Completion] = []
+        for nxt in host_tokens:
+            for i in decoding:
+                slot = self._slots[i]
+                if slot is None or not slot.decoding:
+                    continue  # finished mid-block: later tokens are zombies
+                slot.cursor += 1
+                out.extend(self._emit(i, int(nxt[i])))
+        return out
+
+    def _emit(self, slot_id: int, tok: int) -> list[Completion]:
+        """Record one generated token for a slot; finish/evict on EOS or
+        budget exhaustion."""
+        slot = self._slots[slot_id]
+        req = slot.req
+        slot.out[slot.n_new] = tok
+        slot.n_new += 1
+        slot.last_token = tok
+        if req.stream is not None:
+            piece = self.detokenize([tok]) if self.detokenize else None
+            req.stream(req.rid, tok, piece)
+        eos_hit = (
+            self.config.eos_token_id is not None and tok == self.config.eos_token_id
+        )
+        if not eos_hit and slot.n_new < req.max_new_tokens:
+            return []
+        completion = Completion(
+            rid=req.rid,
+            prompt=req.prompt,
+            tokens=slot.out,
+            n_new=slot.n_new,
+            text=self.detokenize(slot.out[: slot.n_new].tolist())
+            if self.detokenize
+            else None,
+            submitted_at=getattr(req, "submitted_at", 0.0),
+            first_token_at=slot.first_token_at,
+            finished_at=time.perf_counter(),
+        )
+        self._slots[slot_id] = None  # evict: the slot is immediately reusable
+        self._free.append(slot_id)
+        self.stats["completed"] += 1
+        return [completion]
+
+    # --------------------------------------------------------------- lint
+    def abstract_decode_args(self) -> tuple:
+        """ShapeDtypeStructs matching one decode-step call — feed to
+        `analysis.lint_step(engine._decode_fn, *engine.abstract_decode_args(),
+        donate_argnums=(3,))` (the `atx lint serving` scenario and the
+        smoke-serve lane gate on its error findings)."""
+        sds = lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        vec = lambda dt: jax.ShapeDtypeStruct((self.n_slots,), dt)
+        return (
+            jax.tree.map(sds, self.params),
+            vec(np.int32),
+            vec(np.int32),
+            jax.tree.map(sds, self._kv),
+            vec(np.uint32),
+            vec(np.int32),
+        )
+
+
+def poisson_trace(
+    n: int,
+    rate: float,
+    *,
+    vocab_size: int,
+    prompt_lens: tuple[int, int] = (8, 96),
+    new_tokens: tuple[int, int] = (8, 48),
+    seed: int = 0,
+) -> list[Request]:
+    """Synthetic mixed-length request trace with Poisson arrivals at
+    ``rate`` requests/sec — the bench.py / `atx serve` workload shape."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    reqs = []
+    for i in range(n):
+        S = int(rng.randint(prompt_lens[0], prompt_lens[1] + 1))
+        reqs.append(
+            Request(
+                prompt=rng.randint(0, vocab_size, (S,)).astype(np.int32),
+                max_new_tokens=int(rng.randint(new_tokens[0], new_tokens[1] + 1)),
+                rid=i,
+                seed=i,
+                arrival=float(arrivals[i]),
+            )
+        )
+    return reqs

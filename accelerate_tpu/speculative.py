@@ -31,6 +31,18 @@ batch to the minimum. Rows that hit EOS or their token budget freeze
 stops as soon as every row is frozen — no wasted target forwards after
 early termination.
 
+Acceptance diagnostics: BENCH_r05's ``specdecode_accept_rate 0.0`` with a
+layer-prefix draft was investigated as a suspected logit/position
+misalignment in the accept comparison and CLEARED: at K=1 the engine's
+accept rate equals the teacher-forced draft/target argmax-agreement rate,
+and draft == target through the external-draft path accepts everything
+(tests/test_speculative.py::TestAcceptRateRegression pins both). The 0.0
+was draft QUALITY — a 2-layer prefix of random weights shares no
+distribution with its 24-layer target — so bench.py now trains a
+correlated draft/target pair on a synthetic task before measuring
+(`_train_affine_lm`), making the accept rate a property of the mechanism
+again.
+
 Guarantees (both tested):
 - greedy (``do_sample=False``): output is bit-identical to target-only
   greedy decoding, for ANY draft model;

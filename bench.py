@@ -153,6 +153,7 @@ def main() -> None:
         extra_benches = [
             ("longctx", _bench_long_context),
             ("generate", lambda: _bench_generate(config)),
+            ("serve", lambda: _bench_serve(config)),
             ("specdecode", lambda: _bench_specdecode(config)),
             ("int8kv", lambda: _bench_int8_kv(config)),
             ("int8mm", _bench_int8_matmul),
@@ -474,18 +475,181 @@ def _bench_int8_kv(config) -> dict:
     return out
 
 
+def _bench_serve(config) -> dict:
+    """Continuous-batching serving engine (`serving.Engine`,
+    docs/serving.md) on the headline decode model: a trace of 48
+    mixed-length requests (prompts 32/64/128, budgets 24/48) served through
+    the slot pool, vs the same request set run SEQUENTIALLY through batch-1
+    `generate()` — the fixed-batch workflow the engine replaces. The
+    ISSUE-3 acceptance bar is `serve_vs_b1_speedup >= 3`. Then a second
+    pass replays Poisson arrivals at ~70% of the measured capacity on the
+    wall clock for honest p50/p99 request latency."""
+    import dataclasses
+
+    from accelerate_tpu import serving
+    from accelerate_tpu.generation import GenerationConfig, Generator
+    from accelerate_tpu.models import llama
+
+    gen_config = dataclasses.replace(
+        config, remat=False, attention_impl="dot", max_seq_len=512
+    )
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        llama.init(jax.random.PRNGKey(3), gen_config),
+    )
+    apply_fn = lambda p, t, c: llama.forward_with_cache(p, t, c, gen_config)
+    init_cache_fn = lambda b, m: llama.init_cache(gen_config, b, m)
+
+    # Request mix from a small set of (prompt, budget) pairs so the b1
+    # BASELINE compiles a bounded number of (shape, cache) specializations;
+    # the engine itself needs no such care (that is the point: one decode
+    # compile + one prefill compile per bucket, whatever the mix).
+    prompt_lens, budgets, buckets = (32, 64, 128), (24, 48), (32, 64, 128)
+    n_requests = 48
+    rng = np.random.RandomState(7)
+    arrivals = np.cumsum(rng.exponential(1.0, n_requests))  # rescaled later
+    trace = [
+        serving.Request(
+            prompt=rng.randint(0, gen_config.vocab_size, (int(rng.choice(prompt_lens)),)).astype(np.int32),
+            max_new_tokens=int(rng.choice(budgets)),
+            rid=i,
+            seed=i,
+            arrival=float(arrivals[i]),
+        )
+        for i in range(n_requests)
+    ]
+
+    def fresh_engine():
+        return serving.Engine(
+            apply_fn,
+            init_cache_fn,
+            params,
+            GenerationConfig(),
+            buckets=buckets,
+            max_len=max(prompt_lens) + max(budgets),
+            decode_block=8,
+        )
+
+    engine = fresh_engine()
+    # Warm every compile the trace will hit: one request per bucket.
+    engine.serve(
+        serving.Request(
+            prompt=rng.randint(0, gen_config.vocab_size, (S,)).astype(np.int32),
+            max_new_tokens=2,
+            rid=1000 + S,
+        )
+        for S in prompt_lens
+    )
+    t0 = time.perf_counter()
+    completions = engine.serve(trace)
+    serve_wall = max(time.perf_counter() - t0, 1e-9)
+    total_new = sum(c.n_new for c in completions)
+    serve_tps = total_new / serve_wall
+
+    # Sequential b1 baseline over a 12-request subset covering every
+    # (prompt, budget) pair; first pass compiles, second is timed.
+    subset = trace[:12]
+    gens: dict[int, Generator] = {}
+    for timed in (False, True):
+        t0 = time.perf_counter()
+        for r in subset:
+            g = gens.setdefault(
+                r.max_new_tokens, Generator(
+                    apply_fn, init_cache_fn,
+                    GenerationConfig(max_new_tokens=r.max_new_tokens),
+                )
+            )
+            out = g(params, jnp.asarray(r.prompt[None]))
+            int(out[0, -1])  # fetch barrier
+        if timed:
+            b1_wall = max(time.perf_counter() - t0, 1e-9)
+    b1_tps = sum(r.max_new_tokens for r in subset) / b1_wall
+
+    # Latency pass: Poisson arrivals at ~70% of measured capacity, wall
+    # clock honoured, so p50/p99 include real queueing.
+    rate = 0.7 * n_requests / serve_wall
+    lat_engine = fresh_engine()
+    lat_trace = [
+        dataclasses.replace(r, arrival=float(a / arrivals[-1] * n_requests / rate))
+        for r, a in zip(trace, arrivals)
+    ]
+    lat = lat_engine.serve(lat_trace, realtime=True)
+    lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in lat)
+    pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]
+    return {
+        "serve_requests": n_requests,
+        "serve_tokens_per_sec": round(serve_tps, 1),
+        "serve_b1_tokens_per_sec": round(b1_tps, 1),
+        "serve_vs_b1_speedup": round(serve_tps / b1_tps, 2),
+        "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
+        "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
+        "serve_slots": engine.n_slots,
+        "serve_occupancy": round(
+            engine.stats["decode_slot_steps"]
+            / max(engine.stats["decode_steps"] * engine.n_slots, 1),
+            3,
+        ),
+        "serve_prefill_compiles": engine._prefill._cache_size(),
+        "serve_decode_compiles": engine._decode._cache_size(),
+    }
+
+
+def _train_affine_lm(params, cfg, steps, *, task_vocab=256, lr=1e-3, seed=0):
+    """Briefly train an LM on a fixed affine next-token chain
+    (x_{t+1} = (3x_t + 7) mod task_vocab): a memorizable synthetic task
+    both the spec-decode target and its small draft learn in O(100) tiny
+    steps, so their argmax streams CORRELATE — the fix for the meaningless
+    `specdecode_accept_rate 0.0` that random weights produced (VERDICT r5
+    #2: a layer-prefix of random weights shares no distribution with its
+    target; the accept MATH was verified aligned, see
+    tests/test_speculative.py::TestAcceptRateRegression)."""
+    import optax
+
+    from accelerate_tpu.models import llama
+
+    tx = optax.adamw(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt, batch):
+        loss, g = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, {"input_ids": batch}, cfg)
+        )(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        params, opt, loss = train_step(
+            params, opt, jnp.asarray(_affine_chain(rng, 16, 64, task_vocab))
+        )
+    return params, float(loss)
+
+
+def _affine_chain(rng, B, S, task_vocab=256):
+    x = rng.randint(0, task_vocab, (B, 1))
+    xs = [x]
+    for _ in range(S - 1):
+        xs.append((3 * xs[-1] + 7) % task_vocab)
+    return np.concatenate(xs, axis=1).astype(np.int32)
+
+
 def _bench_specdecode(config) -> dict:
     """Speculative decoding at B=1 (the latency regime the reference's
     big-model tables report, `benchmarks/big_model_inference/README.md`):
-    target = the headline decode model, draft = its first-2-layers prefix
-    (sharing embed/norm/head). Greedy, so the output is bit-identical to
-    vanilla decoding by construction (tests/test_speculative.py).
+    target = the headline decode model, draft = a separately-initialized
+    2-layer model. Both are briefly trained on the same synthetic affine
+    chain (`_train_affine_lm`) so their greedy streams CORRELATE and the
+    accept rate measures the mechanism rather than the entropy of random
+    weights — BENCH_r05's `specdecode_accept_rate 0.0` was the latter
+    (VERDICT r5 #2); the accept comparison itself was verified aligned
+    (tests/test_speculative.py::TestAcceptRateRegression). Greedy, so the
+    output is bit-identical to vanilla decoding by construction.
 
-    Reports the honestly-measured layer-prefix draft throughput + accept
-    rate, and the self-draft run (accept == 1 by construction) as the
-    mechanism ceiling — with random bench weights a 2-layer prefix is a
-    poor predictor, so the first number is a floor, not the story."""
+    Also reports the self-draft run (accept == 1 by construction) as the
+    mechanism ceiling."""
     import dataclasses
+    import os
 
     from accelerate_tpu.generation import GenerationConfig, Generator
     from accelerate_tpu.models import llama
@@ -493,13 +657,19 @@ def _bench_specdecode(config) -> dict:
 
     tcfg = dataclasses.replace(config, remat=False, attention_impl="dot")
     dcfg = dataclasses.replace(tcfg, n_layers=2)
-    params = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16), llama.init(jax.random.PRNGKey(3), tcfg)
+    train_steps = int(os.environ.get("ATX_BENCH_SPEC_TRAIN_STEPS", "150"))
+    t0 = time.perf_counter()
+    tparams_f32, t_loss = _train_affine_lm(
+        llama.init(jax.random.PRNGKey(3), tcfg), tcfg, train_steps
     )
-    draft_params = dict(params, blocks=jax.tree.map(lambda x: x[:2], params["blocks"]))
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(4), (1, 128), 0, tcfg.vocab_size, jnp.int32
+    dparams_f32, d_loss = _train_affine_lm(
+        llama.init(jax.random.PRNGKey(5), dcfg), dcfg, train_steps
     )
+    train_s = time.perf_counter() - t0
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), tparams_f32)
+    draft_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), dparams_f32)
+    del tparams_f32, dparams_f32
+    prompt = jnp.asarray(_affine_chain(np.random.RandomState(4), 1, 128))
     short, long = 16, 80
     n_tokens = long - short
 
@@ -518,7 +688,11 @@ def _bench_specdecode(config) -> dict:
         int(out[0, -1])
         return time.perf_counter() - t0
 
-    out = {}
+    out = {
+        "specdecode_train_s": round(train_s, 1),
+        "specdecode_task_loss": round(t_loss, 4),
+        "specdecode_draft_task_loss": round(d_loss, 4),
+    }
     # Vanilla B=1 decode as the speedup denominator (the B=8 headline
     # number amortizes per-step overhead differently).
     van_s = Generator(ta, tc, GenerationConfig(max_new_tokens=short))

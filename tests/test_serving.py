@@ -1,0 +1,295 @@
+"""Continuous-batching serving engine (`accelerate_tpu/serving/`).
+
+The invariants that make iteration-level scheduling safe to put in front
+of traffic:
+
+- slot lifecycle (admit -> chunked prefill -> decode -> EOS/budget evict ->
+  slot REUSE) produces greedy outputs BIT-IDENTICAL to running each request
+  alone through `generate()`;
+- the decode step compiles exactly once and bucketed prefill compiles at
+  most once per bucket, whatever request mix arrives (the ATX302 drift
+  checker sees the bucket set as the only shape drift);
+- long prompts are chunked and interleaved with decode steps, so a new
+  arrival never stalls in-flight decodes for its whole prompt;
+- per-request sampling is stateless in (seed, step): a request's sampled
+  tokens don't depend on which other requests share the batch.
+
+The Poisson smoke test here is the `make smoke-serve` contract: 16
+mixed-length requests, all complete, all match solo generate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import serving
+from accelerate_tpu.generation import GenerationConfig, Generator
+from accelerate_tpu.models import gpt, llama
+from accelerate_tpu.utils.environment import patch_environment
+
+CFG = llama.LlamaConfig.tiny(vocab_size=61, max_seq_len=256, num_heads=4, num_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init(jax.random.PRNGKey(1), CFG)
+
+
+def _apply(p, t, c):
+    return llama.forward_with_cache(p, t, c, CFG)
+
+
+def _init_cache(b, m):
+    return llama.init_cache(CFG, b, m)
+
+
+def _engine(params, config=None, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("buckets", (8, 16))
+    kw.setdefault("max_len", 96)
+    return serving.Engine(_apply, _init_cache, params, config or GenerationConfig(), **kw)
+
+
+def _solo(params, prompt, max_new, config=None):
+    config = config or GenerationConfig(max_new_tokens=max_new)
+    gen = Generator(_apply, _init_cache, config)
+    out = np.asarray(gen(params, jnp.asarray(np.asarray(prompt)[None])))
+    return out[0, len(prompt):]
+
+
+def _mixed_requests(n, *, seed=0, max_prompt=40, budgets=(4, 12)):
+    rng = np.random.RandomState(seed)
+    return [
+        serving.Request(
+            prompt=rng.randint(0, 61, (int(rng.randint(3, max_prompt + 1)),)).astype(np.int32),
+            max_new_tokens=int(rng.choice(budgets)),
+            rid=i,
+            seed=i,
+        )
+        for i in range(n)
+    ]
+
+
+class TestBitIdentity:
+    def test_single_request_matches_generate(self, params):
+        eng = _engine(params)
+        prompt = np.arange(13, dtype=np.int32) % 61
+        rid = eng.submit(prompt, 9)
+        (c,) = eng.run_until_idle()
+        assert c.rid == rid and c.n_new == 9
+        np.testing.assert_array_equal(c.tokens, _solo(params, prompt, 9))
+
+    @pytest.mark.parametrize("decode_block", [1, 3])
+    def test_slot_lifecycle_reuse_bit_identical(self, params, decode_block):
+        """More requests than slots: admit -> decode -> evict -> REUSE every
+        slot several times; each request's greedy stream must equal its solo
+        `generate()` run exactly."""
+        eng = _engine(params, decode_block=decode_block, slots=2)
+        reqs = _mixed_requests(8)
+        outs = {c.rid: c for c in eng.serve(reqs)}
+        assert eng.stats["admitted"] == 8 > eng.n_slots  # slots were recycled
+        assert eng.stats["completed"] == 8
+        for r in reqs:
+            np.testing.assert_array_equal(
+                outs[r.rid].tokens, _solo(params, r.prompt, r.max_new_tokens)
+            )
+
+    def test_eos_eviction_matches_generate_and_frees_slot(self, params):
+        """A request that hits EOS mid-budget is evicted early (n_new <
+        max_new_tokens), its output matches solo generate's eos+pad layout,
+        and its slot is reused by a queued request."""
+        prompt = np.arange(5, dtype=np.int32) % 61
+        free_run = _solo(params, prompt, 16)
+        eos = int(free_run[3])
+        config = GenerationConfig(max_new_tokens=16, eos_token_id=eos, pad_token_id=0)
+        eng = _engine(params, config, slots=1)
+        for i in range(3):  # one slot, three requests: forced reuse
+            eng.submit(prompt, 16, seed=i)
+        outs = eng.run_until_idle()
+        assert len(outs) == 3 and eng.stats["admitted"] == 3
+        want = _solo(params, prompt, 16, config)
+        for c in outs:
+            assert c.n_new == 4  # 3 tokens + the eos
+            np.testing.assert_array_equal(c.tokens, want)
+
+    def test_sampled_stream_independent_of_batchmates(self, params):
+        """Sampling is fold_in(seed, step)-stateless: the same request gets
+        the same tokens whether it runs alone or with companions."""
+        config = GenerationConfig(max_new_tokens=8, do_sample=True, temperature=0.9)
+        prompt = np.arange(11, dtype=np.int32) % 61
+        solo_eng = _engine(params, config, slots=1)
+        solo_eng.submit(prompt, 8, seed=123)
+        (solo,) = solo_eng.run_until_idle()
+        busy_eng = _engine(params, config, slots=3)
+        rid = busy_eng.submit(prompt, 8, seed=123)
+        for r in _mixed_requests(4, seed=5, budgets=(8,)):
+            r.rid += 100  # keep clear of the auto-assigned rid above
+            busy_eng.submit_request(r)
+        busy = {c.rid: c for c in busy_eng.run_until_idle()}
+        np.testing.assert_array_equal(solo.tokens, busy[rid].tokens)
+
+
+class TestScheduler:
+    def test_long_prompt_interleaves_with_decode(self, params):
+        """While a multi-chunk prompt prefills, in-flight decodes keep
+        stepping between its chunks (the no-stall property)."""
+        eng = _engine(params, slots=2, prefill_interleave=1)
+        eng.submit(np.arange(5, dtype=np.int32) % 61, 12)  # starts decoding
+        while not any(s is not None and s.decoding for s in eng._slots):
+            eng.step()
+        eng.actions.clear()
+        eng.submit(np.arange(48, dtype=np.int32) % 61, 4)  # 3 chunks of 16
+        eng.run_until_idle()
+        first_prefill = eng.actions.index("prefill")
+        last_prefill = len(eng.actions) - 1 - eng.actions[::-1].index("prefill")
+        between = eng.actions[first_prefill:last_prefill]
+        assert "decode" in between, (
+            f"no decode step between prefill chunks: {eng.actions}"
+        )
+
+    def test_prefill_interleave_zero_stalls_decodes(self, params):
+        """prefill_interleave=0 is the fixed-batch behaviour: the whole
+        prompt prefills back-to-back (documented as the anti-pattern)."""
+        eng = _engine(params, slots=2, prefill_interleave=0)
+        eng.submit(np.arange(5, dtype=np.int32) % 61, 12)
+        while not any(s is not None and s.decoding for s in eng._slots):
+            eng.step()
+        eng.actions.clear()
+        eng.submit(np.arange(48, dtype=np.int32) % 61, 4)
+        eng.run_until_idle()
+        first = eng.actions.index("prefill")
+        assert eng.actions[first : first + 3] == ["prefill"] * 3
+
+    def test_streaming_callback_and_detokenize(self, params):
+        got = []
+        eng = serving.Engine(
+            _apply, _init_cache, params, GenerationConfig(),
+            slots=1, buckets=(8,), max_len=64,
+            detokenize=lambda ids: "".join(chr(65 + i % 26) for i in ids),
+        )
+        eng.submit(np.arange(6, dtype=np.int32) % 61, 5,
+                   stream=lambda rid, tok, text: got.append((rid, tok, text)))
+        (c,) = eng.run_until_idle()
+        assert [t for _, t, _ in got] == c.tokens.tolist()
+        assert all(isinstance(text, str) and len(text) == 1 for _, _, text in got)
+        assert c.text == "".join(text for _, _, text in got)
+
+    def test_submit_validation(self, params):
+        eng = _engine(params, max_len=32)
+        with pytest.raises(ValueError, match="capacity"):
+            eng.submit(np.zeros((20,), np.int32), 20)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(np.zeros((4,), np.int32), 0)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.zeros((0,), np.int32), 4)
+
+
+class TestCompileDiscipline:
+    def test_one_decode_compile_and_one_prefill_compile_per_bucket(self, params):
+        """The serving promise: whatever mix of prompt lengths and budgets
+        arrives, the decode step compiles ONCE and prefill compiles at most
+        once per bucket."""
+        eng = _engine(params, slots=3, buckets=(8, 16), decode_block=2)
+        eng.serve(_mixed_requests(10, max_prompt=40))
+        assert eng._decode._cache_size() == 1
+        assert eng._prefill._cache_size() == len(set(eng.prefill_signatures)) == 2
+        assert set(eng.prefill_signatures) == {8, 16}
+
+    def test_atx302_sees_buckets_as_the_only_drift(self, params):
+        """Reuse the ATX302 drift checker on the engine's REAL prefill fn:
+        across buckets it must flag exactly the tokens argument (that drift
+        is the bounded, by-design compile set); within one bucket there is
+        no drift at all."""
+        from accelerate_tpu import analysis
+
+        eng = _engine(params)
+        sds = lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        scalar = lambda dt: jax.ShapeDtypeStruct((), dt)
+
+        def args_for(bucket):
+            return (
+                jax.tree.map(sds, params),
+                jax.ShapeDtypeStruct((1, bucket), np.int32),
+                jax.tree.map(sds, eng._kv),
+                scalar(np.int32),
+                scalar(np.int32),
+                scalar(np.int32),
+                scalar(np.uint32),
+            )
+
+        report = analysis.lint_step(
+            eng._prefill_fn, *args_for(8),
+            alternates=[args_for(16)], rules=["ATX302"],
+        )
+        (f,) = report.filter(family="ATX302")
+        assert "args[1]" in f.path  # the bucketed tokens arg, nothing else
+        clean = analysis.lint_step(
+            eng._prefill_fn, *args_for(8),
+            alternates=[args_for(8)], rules=["ATX302"],
+        )
+        assert not clean.findings
+
+    def test_lint_decode_step_no_errors(self, params):
+        """The smoke-serve lane gate: error-severity findings on the
+        serving decode step fail the build (`atx lint serving`)."""
+        from accelerate_tpu import analysis
+
+        eng = _engine(params)
+        report = analysis.lint_step(
+            eng._decode_fn, *eng.abstract_decode_args(), donate_argnums=(3,)
+        )
+        assert not report.has_errors, [str(f) for f in report.findings]
+
+
+class TestPoissonSmoke:
+    def test_poisson_16_requests_all_complete_and_match_solo(self, params):
+        """The `make smoke-serve` contract: a 16-request Poisson trace of
+        mixed prompt/output lengths fully completes and every request is
+        bit-identical to its solo `generate()` run."""
+        eng = _engine(params, slots=4, decode_block=2)
+        trace = serving.poisson_trace(
+            16, rate=200.0, vocab_size=61, prompt_lens=(3, 40),
+            new_tokens=(4, 12), seed=0,
+        )
+        outs = {c.rid: c for c in eng.serve(trace)}
+        assert len(outs) == 16 and eng.stats["completed"] == 16
+        for r in trace:
+            np.testing.assert_array_equal(
+                outs[r.rid].tokens, _solo(params, r.prompt, r.max_new_tokens)
+            )
+
+
+class TestKnobsAndFamilies:
+    def test_env_knobs(self, params):
+        with patch_environment(ATX_SERVE_SLOTS="5", ATX_SERVE_BUCKETS="8,32"):
+            eng = serving.Engine(
+                _apply, _init_cache, params, GenerationConfig(), max_len=64
+            )
+            assert eng.n_slots == 5
+            assert eng.buckets == (8, 32)
+        with patch_environment(ATX_SERVE_BUCKETS="nope"):
+            with pytest.raises(ValueError, match="ATX_SERVE_BUCKETS"):
+                serving.default_buckets()
+
+    def test_gpt_family_contract(self):
+        """The engine is family-agnostic: any cache whose non-length leaves
+        are (L, B, T, ...) layer-stacked buffers works — here a GPT-2-style
+        learned-positional model."""
+        cfg = gpt.GPTConfig.tiny(vocab_size=61, max_seq_len=128)
+        gparams = gpt.init(jax.random.PRNGKey(2), cfg)
+        apply_fn = lambda p, t, c: gpt.forward_with_cache(p, t, c, cfg)
+        init_fn = lambda b, m: gpt.init_cache(cfg, b, m)
+        eng = serving.Engine(
+            apply_fn, init_fn, gparams, GenerationConfig(),
+            slots=2, buckets=(8,), max_len=48,
+        )
+        prompt = np.arange(7, dtype=np.int32) % 61
+        eng.submit(prompt, 6)
+        (c,) = eng.run_until_idle()
+        want = np.asarray(
+            Generator(apply_fn, init_fn, GenerationConfig(max_new_tokens=6))(
+                gparams, jnp.asarray(prompt[None])
+            )
+        )[0, 7:]
+        np.testing.assert_array_equal(c.tokens, want)

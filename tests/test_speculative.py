@@ -137,6 +137,70 @@ class TestPerRowCommit:
         assert spec.last_iterations <= first_dispatch
 
 
+class TestAcceptRateRegression:
+    """BENCH_r05 reported `specdecode_accept_rate 0.0` with a real draft
+    model; the suspected accept-comparison misalignment was diagnosed and
+    CLEARED (speculative.py module docstring). These tests pin the two
+    facts that diagnosis rests on, so a future positional regression in
+    the draft or verify path cannot hide behind 'the draft is just bad'."""
+
+    def test_external_draft_equal_params_accepts_everything(self, models):
+        """draft == target THROUGH THE EXTERNAL-DRAFT PATH (separate apply
+        fns and separately-built caches, bf16 params, GQA): accept rate
+        must be ~1.0. A position misalignment anywhere in the draft scan,
+        verify forward, or rollback bookkeeping would reject drafts every
+        iteration and drop this toward 0."""
+        cfg = llama.LlamaConfig.tiny(
+            vocab_size=61, max_seq_len=256, num_heads=4, num_kv_heads=2
+        )
+        tp = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), llama.init(jax.random.PRNGKey(7), cfg)
+        )
+        ta, tc = _llama_pair(cfg)
+        da, dc = _llama_pair(cfg)  # distinct closures: the external-draft path
+        config = GenerationConfig(max_new_tokens=24)
+        spec = SpeculativeGenerator(ta, tc, da, dc, config, draft_tokens=4)
+        prompt = jnp.asarray(np.arange(12, dtype=np.int32).reshape(2, 6) % 61)
+        got = spec(tp, tp, prompt)
+        want = Generator(ta, tc, config)(tp, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert spec.last_accept_rate == pytest.approx(1.0)
+
+    def test_k1_accept_rate_equals_teacher_forced_agreement(self, models):
+        """At K=1 every iteration proposes exactly one draft token, so the
+        engine's accept rate must equal the fraction of positions (on the
+        target's own greedy stream) where draft argmax == target argmax —
+        computed here independently with fresh full-prefill forwards. An
+        off-by-one in the accept comparison would send the engine's rate
+        to ~1/vocab while the teacher-forced rate stays high."""
+        # A layer-prefix draft (first 2 of 4 layers, shared embed/head)
+        # keeps teacher-forced agreement well off the floor — random
+        # unrelated drafts would make both rates ~1/vocab and the check
+        # vacuous.
+        tcfg = llama.LlamaConfig.tiny(vocab_size=61, max_seq_len=256, n_layers=4)
+        dcfg = llama.LlamaConfig.tiny(vocab_size=61, max_seq_len=256, n_layers=2)
+        tp = llama.init(jax.random.PRNGKey(1), tcfg)
+        dp = dict(tp, blocks=jax.tree.map(lambda x: x[:2], tp["blocks"]))
+        N = 48
+        config = GenerationConfig(max_new_tokens=N)
+        prompt = jnp.asarray(np.arange(7, dtype=np.int32)[None] % 61)
+        spec = _spec(config, 1, tcfg=tcfg, dcfg=dcfg)
+        spec(tp, dp, prompt)
+        engine_rate = spec.last_accept_rate
+        stream = np.asarray(_vanilla(config, tp, prompt, cfg=tcfg))[0]
+        agree = total = 0
+        for i in range(prompt.shape[1], len(stream) - 1):
+            ctx = jnp.asarray(stream[None, :i])
+            tl, _ = llama.forward_with_cache(tp, ctx, llama.init_cache(tcfg, 1, i), tcfg)
+            dl, _ = llama.forward_with_cache(dp, ctx, llama.init_cache(dcfg, 1, i), dcfg)
+            agree += int(jnp.argmax(tl[0, -1]) == jnp.argmax(dl[0, -1]))
+            total += 1
+        # The engine proposes on the same greedy stream; rates match up to
+        # the boundary effect of the final (budget-capped) iterations.
+        assert engine_rate == pytest.approx(agree / total, abs=0.15)
+        assert engine_rate > 0.2  # and is far from the ~1/61 misalignment floor
+
+
 class TestEos:
     def test_eos_truncates_like_vanilla(self, models):
         tp, dp = models
