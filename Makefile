@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve lint-graph
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-resilience lint-graph
 
 test:
 	python -m pytest tests/ -q
@@ -35,8 +35,15 @@ lint-graph:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m accelerate_tpu.commands.cli lint examples --severity error
 
+# CPU resilience lane (docs/fault_tolerance.md): fault-injected save/load
+# roundtrips (truncate / bit-flip / kill-9 mid-save must never lose the last
+# committed checkpoint), the SIGTERM-resume bit-identity subprocess smoke,
+# and the hang-watchdog abort smoke.
+smoke-resilience:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -m 'not slow'
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph smoke-serve
+test-all: lint-graph smoke-serve smoke-resilience
 	python -m pytest tests/ -q --heavy

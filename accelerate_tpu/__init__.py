@@ -24,6 +24,14 @@ from .data import ArrayDataset, DataLoader, prepare_data_loader, skip_first_batc
 from .generation import GenerationConfig, Generator, generate
 from .speculative import SpeculativeGenerator, generate_speculative
 from . import serving
+from . import resilience
+from .resilience import (
+    PREEMPTION_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    Watchdog,
+    install_preemption_handler,
+    preemption_requested,
+)
 from .models.hf import from_hf_config, load_pretrained, save_pretrained
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import (

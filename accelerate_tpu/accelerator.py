@@ -240,6 +240,18 @@ class Accelerator:
                 t.strip() for t in os.environ["ATX_LOG_WITH"].split(",") if t.strip()
             ]
         self.log_with = log_with
+        # Preemption safety (resilience/preemption.py): trap SIGTERM so a
+        # spot reclaim / maintenance notice becomes an emergency checkpoint
+        # at the next step boundary instead of lost work. Opt out with
+        # ATX_PREEMPTION_HANDLER=0 (the handler is main-thread-only and
+        # idempotent, so repeated Accelerator constructions are fine).
+        from .utils.environment import parse_flag_from_env
+
+        if parse_flag_from_env("ATX_PREEMPTION_HANDLER", True):
+            from . import resilience
+
+            resilience.install_preemption_handler()
+        self._preemption_exit_started = False
         self._flag_tensor: jax.Array | None = None
         self._checkpoint_registry: list[Any] = []
         self._param_specs: Any = None
@@ -1023,13 +1035,30 @@ class Accelerator:
             return new_state, metrics
 
         def run_step(state: TrainState, batch: Any):
+            from . import resilience
             from .parallel.disk_offload import DiskOffloadedAdamW
 
+            # Preemption boundary check at ENTRY, before any compute: the
+            # input state is exactly the last completed step's output (whose
+            # metrics the caller already has), so the emergency checkpoint
+            # loses nothing and the resumed trajectory is bit-identical.
+            self._maybe_emergency_exit(state)
+            # Hang watchdog (ATX_WATCHDOG_SECS): heartbeat semantics — each
+            # step ENTRY re-arms the countdown and it stays armed across the
+            # call, because jax dispatches the compiled step asynchronously
+            # (the call can return before the device work runs; a disarm
+            # here would miss a wedged collective entirely). A wedge is
+            # caught when the loop blocks fetching the step's metrics — or
+            # wherever the process stalls — and no next step entry arrives
+            # within the deadline. `end_training()` disarms.
+            wd = resilience.watchdog_from_env()
+            if wd is not None:
+                wd.arm()
             if isinstance(state.tx, DiskOffloadedAdamW):
                 return run_disk_step(state, batch)
             # Trace (and run) under the ambient mesh so the model's
-            # activation constraints (parallel.mesh.constrain_batch) bind to
-            # this Accelerator's axes.
+            # activation constraints (parallel.mesh.constrain_batch) bind
+            # to this Accelerator's axes.
             with use_mesh(self.mesh):
                 return jitted(state, batch)
 
@@ -1160,13 +1189,18 @@ class Accelerator:
             tracker.log(host_values, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self) -> None:
-        """Flush/close all trackers (reference `accelerator.py:2912`) and
-        join any in-flight async checkpoint writer."""
+        """Flush/close all trackers (reference `accelerator.py:2912`), join
+        any in-flight async checkpoint writer, and stand down the hang
+        watchdog (its heartbeat expects a steady stream of steps; post-
+        training eval/export must not trip it)."""
         for tracker in self.trackers:
             tracker.finish()
         self.trackers = []
-        from . import checkpointing
+        from . import checkpointing, resilience
 
+        wd = resilience.watchdog_from_env()
+        if wd is not None:
+            wd.stop()
         checkpointing.wait_for_checkpoint()
 
     # -------------------------------------------------------------- triggers
@@ -1193,6 +1227,55 @@ class Accelerator:
         jax.clear_caches()
         return objects
 
+    # ------------------------------------------------------------ resilience
+    def preemption_requested(self) -> bool:
+        """Has a SIGTERM / maintenance notice arrived? (The handler only
+        sets a flag; poll this at step boundaries and checkpoint + exit with
+        ``resilience.PREEMPTION_EXIT_CODE`` — or rely on the automatic hook
+        in the step helper when ``automatic_checkpoint_naming`` is on.)"""
+        from . import resilience
+
+        return resilience.preemption_requested()
+
+    def _maybe_emergency_exit(self, state: "TrainState") -> None:
+        """The step helper's automatic preemption hook: on a pending
+        preemption notice, write a committed emergency checkpoint and raise
+        ``SystemExit(PREEMPTION_EXIT_CODE)`` — the exit code the elastic
+        loop in `commands/launch.py` resumes immediately without burning a
+        ``--max_restarts`` attempt. Only fires under
+        ``automatic_checkpoint_naming`` (otherwise there is no agreed place
+        to save; the loop polls `preemption_requested` itself)."""
+        from . import resilience
+
+        if not resilience.preemption_requested():
+            return
+        if not self.project_config.automatic_checkpoint_naming:
+            return
+        if self._preemption_exit_started:  # re-entry (e.g. user caught it)
+            raise SystemExit(resilience.PREEMPTION_EXIT_CODE)
+        self._preemption_exit_started = True
+        # The emergency save may legitimately exceed the per-step deadline;
+        # the watchdog must not shoot it down mid-commit.
+        wd = resilience.watchdog_from_env()
+        if wd is not None:
+            wd.stop()
+        import sys as _sys
+
+        _sys.stderr.write(
+            "[accelerate_tpu] preemption requested: writing emergency "
+            "checkpoint before exiting\n"
+        )
+        from . import checkpointing
+
+        path = checkpointing.save_state(self, None, state, async_save=False)
+        _sys.stderr.write(
+            f"[accelerate_tpu] emergency checkpoint committed at {path}; "
+            f"exiting with code {resilience.PREEMPTION_EXIT_CODE} (elastic "
+            "launchers resume without consuming a restart attempt)\n"
+        )
+        _sys.stderr.flush()
+        raise SystemExit(resilience.PREEMPTION_EXIT_CODE)
+
     # ------------------------------------------------------------ checkpoint
     def register_for_checkpointing(self, *objects: Any) -> None:
         """Attach arbitrary stateful objects (must expose state_dict /
@@ -1211,7 +1294,13 @@ class Accelerator:
 
         return checkpointing.save_state(self, output_dir, state, **kwargs)
 
-    def load_state(self, input_dir: str, state: TrainState, **kwargs: Any) -> TrainState:
+    def load_state(
+        self, input_dir: str | None, state: TrainState, **kwargs: Any
+    ) -> TrainState:
+        """Restore a checkpoint. ``load_state(None, state, resume="latest")``
+        discovers the newest *committed* checkpoint under the automatic-
+        naming root, verifies its manifest, and falls back to the previous
+        committed one on corruption (docs/fault_tolerance.md)."""
         from . import checkpointing
 
         return checkpointing.load_state(self, input_dir, state, **kwargs)

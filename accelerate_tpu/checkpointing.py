@@ -22,17 +22,27 @@ TPU-native redesign of the reference checkpoint stack (`accelerator.py:3106`
   (`ProjectConfiguration`, reference `utils/dataclasses.py:857-917`).
 - Async save: device->host transfer happens synchronously (cheap, HBM->RAM),
   file writing on a background thread (the orbax async-checkpoint pattern).
+- **Atomic commit protocol** (`resilience/commit.py`, docs/fault_tolerance.md):
+  `save_state` writes into `<dir>.tmp/`, hashes every file into a per-process
+  SHA-256 manifest, barriers, then process 0 renames to final and writes a
+  `COMMIT` marker last; rotation deletes old checkpoints only AFTER the new
+  commit lands, and `load_state(resume="latest")` only ever trusts a
+  committed, manifest-verified checkpoint (falling back to the previous one
+  on corruption). A kill -9 at any instant is recoverable.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
+import logging
 import os
 import pickle
 import random as _py_random
 import re
 import shutil
 import threading
+import warnings
 from typing import TYPE_CHECKING, Any, Iterable
 
 import jax
@@ -40,8 +50,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .resilience import commit as _commit
+from .resilience.commit import CheckpointIntegrityWarning, fault_point as _fault_point
+from .utils.environment import get_int_from_env
+
 if TYPE_CHECKING:  # pragma: no cover
     from .accelerator import Accelerator, TrainState
+
+logger = logging.getLogger(__name__)
 
 MODEL_DIR = "train_state"
 SHARDS_FILE = "shards_{proc}.npz"
@@ -337,13 +353,6 @@ def _per_proc_pattern(template: str) -> str:
 _SHARD_FILE_PATTERN = re.compile(
     "^(" + "|".join(_per_proc_pattern(t) for t in (INDEX_FILE, SHARDS_FILE)) + ")$"
 )
-_STATE_FILE_PATTERN = re.compile(
-    "^("
-    + "|".join(
-        [_per_proc_pattern(RNG_FILE), re.escape(CUSTOM_FILE).replace(re.escape("{i}"), r"\d+")]
-    )
-    + ")$"
-)
 
 
 def _clear_stale_files(directory: str, pattern: re.Pattern) -> None:
@@ -449,23 +458,54 @@ def _checkpoint_dirs(root: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+def checkpoint_root(accelerator: "Accelerator") -> str:
+    """The automatic-naming checkpoints directory for this project."""
+    return os.path.join(accelerator.project_config.project_dir or ".", "checkpoints")
+
+
 def _resolve_save_dir(accelerator: "Accelerator", output_dir: str | None) -> str:
+    """Pick the FINAL directory name for this save. Deliberately does NOT
+    delete anything: rotation happens in `_rotate_after_commit`, only after
+    the new checkpoint's COMMIT marker lands — deleting first meant a crash
+    mid-save with ``total_limit=1`` lost both the old and new checkpoint."""
     cfg = accelerator.project_config
     if cfg.automatic_checkpoint_naming:
-        root = os.path.join(cfg.project_dir or ".", "checkpoints")
+        root = checkpoint_root(accelerator)
         existing = _checkpoint_dirs(root)
         iteration = cfg.iteration
         if existing:
             iteration = max(iteration, existing[-1][0] + 1)
         save_dir = os.path.join(root, f"checkpoint_{iteration}")
         cfg.iteration = iteration + 1
-        if cfg.total_limit is not None:
-            for _, old in existing[: max(0, len(existing) + 1 - cfg.total_limit)]:
-                shutil.rmtree(old, ignore_errors=True)
         return save_dir
     if output_dir is None:
         raise ValueError("output_dir is required unless automatic_checkpoint_naming is set")
     return output_dir
+
+
+def _rotate_after_commit(accelerator: "Accelerator", final_dir: str) -> None:
+    """Post-commit cleanup (process 0 / the committing process only):
+    delete committed checkpoints beyond ``total_limit``, crashed saves'
+    ``.tmp`` dirs, and rename-without-marker debris — never the checkpoint
+    that just committed, and never before it is durable."""
+    cfg = accelerator.project_config
+    if not cfg.automatic_checkpoint_naming:
+        return
+    root = os.path.dirname(final_dir)
+    _commit.remove_stale_tmp(root)
+    final_abs = os.path.abspath(final_dir)
+    committed = _commit.committed_checkpoints(root)
+    if cfg.total_limit is not None:
+        for _, old in committed[: max(0, len(committed) - cfg.total_limit)]:
+            if os.path.abspath(old) != final_abs:
+                shutil.rmtree(old, ignore_errors=True)
+    # Uncommitted checkpoint_<n> dirs are crash debris (the rename landed,
+    # the marker didn't); resume ignores them, so reclaim the disk.
+    committed_paths = {os.path.abspath(p) for _, p in _commit.committed_checkpoints(root)}
+    for n, path in _checkpoint_dirs(root):
+        ap = os.path.abspath(path)
+        if ap != final_abs and ap not in committed_paths:
+            shutil.rmtree(path, ignore_errors=True)
 
 
 # --------------------------------------------------------------- async writing
@@ -491,7 +531,11 @@ class _AsyncSaver:
         def run() -> None:
             try:
                 fn(*args)
-            except BaseException as e:  # surfaced on next wait()
+            except BaseException as e:  # re-raised on next wait()
+                # Log NOW: the next wait() may be many steps away (or never
+                # come), and a background save that silently failed is
+                # exactly the data loss this layer exists to prevent.
+                logger.exception("async checkpoint save failed: %s", e)
                 self._error.append(e)
 
         self._thread = threading.Thread(target=run, daemon=False)
@@ -506,6 +550,19 @@ def wait_for_checkpoint() -> None:
     _ASYNC_SAVER.wait()
 
 
+def _wait_for_checkpoint_at_exit() -> None:
+    # A clean interpreter exit must never truncate an in-flight async save:
+    # join it (and surface its error as a log, not a raise — atexit is no
+    # place for a traceback fight).
+    try:
+        _ASYNC_SAVER.wait()
+    except BaseException:
+        logger.exception("async checkpoint save failed during interpreter exit")
+
+
+atexit.register(_wait_for_checkpoint_at_exit)
+
+
 # ---------------------------------------------------------------- entry points
 def save_state(
     accelerator: "Accelerator",
@@ -517,83 +574,164 @@ def save_state(
 ) -> str:
     """Full training-state checkpoint (reference `save_state`,
     `accelerator.py:3106`): TrainState pytree (sharded), RNG bundle, step,
-    dataloader iterator states, registered custom objects."""
-    # Join any in-flight async save first: rotation must never delete a
-    # directory a background writer is still filling. The local join is not
-    # enough on multi-host — process 0 must not rmtree an old checkpoint while
-    # ANOTHER host's previous async writer is still filling it — so barrier
-    # after every host has joined its own writer.
+    dataloader iterator states, registered custom objects.
+
+    Crash-safe: every file goes into ``<dir>.tmp/``, each process writes a
+    SHA-256 manifest over its files, and only after a multi-host barrier
+    does process 0 rename to the final name and write the ``COMMIT`` marker
+    (`resilience/commit.py`). Rotation deletes old checkpoints strictly
+    AFTER the new commit lands. The async path runs the same
+    write → manifest → commit sequence from the background thread.
+    """
+    # Join any in-flight async save first: a new save (or its rotation) must
+    # never touch a directory a background writer is still filling. The
+    # local join is not enough on multi-host — barrier after every host has
+    # joined its own writer.
     wait_for_checkpoint()
     if jax.process_count() > 1:
         accelerator.process_state.wait_for_everyone()
     proc = jax.process_index()
-    if proc == 0 or accelerator.project_config.save_on_each_node:
+    each_node = accelerator.project_config.save_on_each_node
+    if proc == 0 or each_node:
         # save_on_each_node: every process has its own filesystem, so each
         # resolves (and later writes) locally; with automatic naming the
         # broadcast below still forces process 0's choice everywhere.
-        save_dir = _resolve_save_dir(accelerator, output_dir)
+        final_dir = _resolve_save_dir(accelerator, output_dir)
     else:
-        save_dir = None
+        final_dir = None
     if jax.process_count() > 1:
         # All hosts must agree on the directory (independent filesystem
         # listings race under automatic_checkpoint_naming).
         from .ops.collectives import broadcast_object_list
 
-        save_dir = broadcast_object_list([save_dir])[0]
-    os.makedirs(save_dir, exist_ok=True)
-    # Same shrink-hosts staleness applies to per-process RNG files and
-    # per-index custom-object pickles: a 2-host save followed by a 1-host
-    # re-save must not leave rng_state_1.json for a later 2-host load.
-    if proc == 0 or accelerator.project_config.save_on_each_node:
-        # Per-node filesystems: each process clears its own local dir. On a
-        # shared FS this is redundant but safe: ALL clears complete before
-        # ANY write — _clear_stale_shard_files below ends in a barrier.
-        _clear_stale_files(save_dir, _STATE_FILE_PATTERN)
-    _clear_stale_shard_files(os.path.join(save_dir, MODEL_DIR), accelerator.process_state)
+        final_dir = broadcast_object_list([final_dir])[0]
+    tmp_dir = final_dir + _commit.TMP_SUFFIX
+    if proc == 0 or each_node:
+        # A previous save into this name may have crashed mid-write; the tmp
+        # dir is ours now. Writing into a FRESH tmp dir also retires the old
+        # shrink-hosts staleness problem (stale index_1/shards_1/rng_state_1
+        # from a larger process count can't exist in a new directory).
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    if jax.process_count() > 1:
+        accelerator.process_state.wait_for_everyone()
+    os.makedirs(os.path.join(tmp_dir, MODEL_DIR), exist_ok=True)
 
     saveable = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
     if state.loss_scale is not None:
         saveable["loss_scale"] = state.loss_scale
+    step_value = int(jax.device_get(state.step))
 
-    if async_save:
-        # Synchronously snapshot device data to host, write files off-thread
-        # through the same writer as the sync path (one on-disk format).
-        host_tree = jax.tree.map(
-            lambda x: _HostShardSnapshot(x) if isinstance(x, jax.Array) else x, saveable
-        )
-        _ASYNC_SAVER.submit(
-            save_pytree, host_tree, os.path.join(save_dir, MODEL_DIR)
-        )
-    else:
-        save_pytree(saveable, os.path.join(save_dir, MODEL_DIR))
-
-    with open(os.path.join(save_dir, RNG_FILE.format(proc=proc)), "w") as f:
+    # Small host-side files first (both paths): the manifest must cover
+    # every file this process writes, and on the async path it is written
+    # by the background thread after the (slow) shard write finishes.
+    written: list[str] = []
+    with open(os.path.join(tmp_dir, RNG_FILE.format(proc=proc)), "w") as f:
         json.dump(_rng_state_bundle(accelerator), f)
+    written.append(RNG_FILE.format(proc=proc))
 
     # On a shared filesystem only process 0 writes the process-agnostic
     # artifacts (metadata, dataloader states, custom objects); with
     # save_on_each_node every process writes them so each node's local
     # directory is self-contained (reference `ProjectConfiguration.
     # save_on_each_node`, consumed at `accelerator.py:2979,3129`).
-    if proc == 0 or accelerator.project_config.save_on_each_node:
+    if proc == 0 or each_node:
         dls = list(dataloaders) if dataloaders is not None else accelerator._dataloaders
         dl_states = [dl.state_dict() for dl in dls]
-        with open(os.path.join(save_dir, DATALOADER_FILE), "w") as f:
+        with open(os.path.join(tmp_dir, DATALOADER_FILE), "w") as f:
             json.dump(dl_states, f)
+        written.append(DATALOADER_FILE)
         for i, obj in enumerate(accelerator._checkpoint_registry):
-            with open(os.path.join(save_dir, CUSTOM_FILE.format(i=i)), "wb") as f:
+            with open(os.path.join(tmp_dir, CUSTOM_FILE.format(i=i)), "wb") as f:
                 pickle.dump(obj.state_dict(), f)
-        with open(os.path.join(save_dir, METADATA_FILE), "w") as f:
+            written.append(CUSTOM_FILE.format(i=i))
+        with open(os.path.join(tmp_dir, METADATA_FILE), "w") as f:
             json.dump(
                 {
-                    "step": int(jax.device_get(state.step)),
+                    "step": step_value,
                     "mesh": dict(accelerator.mesh.shape),
                     "num_processes": jax.process_count(),
                     "version": 1,
                 },
                 f,
             )
-    return save_dir
+        written.append(METADATA_FILE)
+
+    def _write_shards_and_manifest(model_tree: Any) -> None:
+        save_pytree(model_tree, os.path.join(tmp_dir, MODEL_DIR), process_index=proc)
+        _fault_point("save.files_written")
+        files = written + [
+            os.path.join(MODEL_DIR, SHARDS_FILE.format(proc=proc)),
+            os.path.join(MODEL_DIR, INDEX_FILE.format(proc=proc)),
+        ]
+        _commit.write_manifest(tmp_dir, proc, files)
+        _fault_point("save.manifest_written")
+
+    if async_save:
+        # Synchronously snapshot device data to host, write files off-thread
+        # through the same writer as the sync path (one on-disk format); the
+        # background job finishes with manifest + commit so a checkpoint is
+        # never discoverable before it is whole.
+        host_tree = jax.tree.map(
+            lambda x: _HostShardSnapshot(x) if isinstance(x, jax.Array) else x, saveable
+        )
+
+        def _async_job() -> None:
+            _write_shards_and_manifest(host_tree)
+            _barrier_and_commit(
+                accelerator, tmp_dir, final_dir, step_value, file_barrier=True
+            )
+
+        _ASYNC_SAVER.submit(_async_job)
+    else:
+        _write_shards_and_manifest(saveable)
+        _barrier_and_commit(
+            accelerator, tmp_dir, final_dir, step_value, file_barrier=False
+        )
+    return final_dir
+
+
+def _barrier_and_commit(
+    accelerator: "Accelerator",
+    tmp_dir: str,
+    final_dir: str,
+    step_value: int,
+    *,
+    file_barrier: bool,
+) -> None:
+    """Every process's files are on disk → barrier → the committing process
+    renames tmp → final, writes COMMIT last, then rotates.
+
+    The sync path barriers with the real collective; the async path runs on
+    a background thread, which must not issue collectives the main thread
+    may also be using, so it barriers through ``.precommit_<proc>`` marker
+    files on the shared filesystem instead. With ``save_on_each_node`` each
+    process owns (and commits) its node-local directory.
+    """
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    meta = {"step": step_value, "num_processes": nproc}
+    if accelerator.project_config.save_on_each_node:
+        _commit.commit_dir(tmp_dir, final_dir, meta)
+        _rotate_after_commit(accelerator, final_dir)
+        return
+    if nproc > 1:
+        if file_barrier:
+            _commit.mark_precommit(tmp_dir, proc)
+            if proc == 0:
+                _commit.wait_for_precommit(
+                    tmp_dir,
+                    nproc,
+                    timeout_secs=get_int_from_env(("ATX_COMMIT_BARRIER_SECS",), 600),
+                )
+        else:
+            accelerator.process_state.wait_for_everyone()
+    if proc == 0:
+        _commit.commit_dir(tmp_dir, final_dir, meta)
+        _rotate_after_commit(accelerator, final_dir)
+    if nproc > 1 and not file_barrier:
+        # Sync saves return only once the committed dir is visible to every
+        # rank (callers immediately load/inspect the returned path).
+        accelerator.process_state.wait_for_everyone()
 
 
 class _HostShardSnapshot:
@@ -622,14 +760,81 @@ class _HostShardSnapshot:
 
 def load_state(
     accelerator: "Accelerator",
+    input_dir: str | None,
+    state: "TrainState",
+    *,
+    dataloaders: Iterable[Any] | None = None,
+    resume: str | None = None,
+) -> "TrainState":
+    """Restore a `save_state` checkpoint into ``state``'s shardings
+    (reference `load_state`, `accelerator.py:3272`).
+
+    ``resume="latest"`` treats ``input_dir`` as a checkpoints ROOT (default:
+    ``<project_dir>/checkpoints``, the automatic-naming layout) and restores
+    the newest *committed* checkpoint whose SHA-256 manifest verifies —
+    skipping uncommitted crash debris entirely and, when the newest
+    committed checkpoint is corrupt (truncated/bit-flipped/partially
+    deleted), warning and falling back to the previous committed one
+    instead of crashing or training on garbage.
+
+    An explicit ``input_dir`` (no ``resume``) is verified too when it
+    carries a manifest; corruption raises (the caller named THIS
+    checkpoint, silently substituting another would be worse). Pre-manifest
+    legacy checkpoints load as before.
+    """
+    wait_for_checkpoint()
+    if resume is not None:
+        if resume != "latest":
+            raise ValueError(f"resume={resume!r}: the only supported policy is 'latest'")
+        root = input_dir if input_dir is not None else checkpoint_root(accelerator)
+        candidates = _commit.committed_checkpoints(root)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {root!r} (directories without "
+                f"a {_commit.COMMIT_MARKER} marker are incomplete saves and "
+                "are never resumed from)"
+            )
+        failures: list[str] = []
+        for _, candidate in reversed(candidates):
+            errors = _commit.verify_checkpoint(candidate)
+            if errors:
+                warnings.warn(
+                    f"committed checkpoint {candidate} failed integrity "
+                    f"verification ({'; '.join(errors[:3])}); falling back to "
+                    "the previous committed checkpoint",
+                    CheckpointIntegrityWarning,
+                    stacklevel=2,
+                )
+                failures.append(f"{candidate}: {'; '.join(errors[:3])}")
+                continue
+            logger.info("resuming from committed checkpoint %s", candidate)
+            return _load_state_dir(
+                accelerator, candidate, state, dataloaders=dataloaders
+            )
+        raise ValueError(
+            f"every committed checkpoint under {root!r} failed integrity "
+            f"verification: {failures}"
+        )
+    if input_dir is None:
+        raise ValueError("input_dir is required unless resume='latest' is passed")
+    errors = _commit.verify_checkpoint(input_dir)
+    if errors:
+        raise ValueError(
+            f"checkpoint at {input_dir!r} failed integrity verification: "
+            f"{'; '.join(errors)} — restore from another checkpoint (or use "
+            "load_state(..., resume='latest') on the checkpoints root to "
+            "fall back automatically)"
+        )
+    return _load_state_dir(accelerator, input_dir, state, dataloaders=dataloaders)
+
+
+def _load_state_dir(
+    accelerator: "Accelerator",
     input_dir: str,
     state: "TrainState",
     *,
     dataloaders: Iterable[Any] | None = None,
 ) -> "TrainState":
-    """Restore a `save_state` checkpoint into ``state``'s shardings
-    (reference `load_state`, `accelerator.py:3272`)."""
-    wait_for_checkpoint()
     model_dir = os.path.join(input_dir, MODEL_DIR)
     target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
     if state.loss_scale is not None and _index_has_prefix(model_dir, "loss_scale"):

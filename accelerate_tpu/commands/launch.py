@@ -31,7 +31,26 @@ import subprocess
 import sys
 import time
 
+from ..resilience.preemption import PREEMPTION_EXIT_CODE
 from .config import LaunchConfig, load_default_config
+
+
+def _term_grace_secs() -> float:
+    """How long group teardown waits between SIGTERM and SIGKILL. Children
+    trap SIGTERM for emergency checkpoints (resilience/preemption.py), so a
+    teardown TERM no longer guarantees death — the grace window lets the
+    emergency save commit before escalation."""
+    try:
+        return float(os.environ.get("ATX_TERM_GRACE_SECS", "") or 30.0)
+    except ValueError:
+        return 30.0
+
+
+def _max_preemption_resumes() -> int:
+    try:
+        return int(os.environ.get("ATX_MAX_PREEMPTION_RESUMES", "") or 100)
+    except ValueError:
+        return 100
 
 
 def register(subparsers: argparse._SubParsersAction) -> None:
@@ -212,6 +231,7 @@ def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
             env = build_child_env(cfg, i, host_devices=args.host_devices)
             procs.append(subprocess.Popen(cmd, env=env))
         exit_code = 0
+        term_deadline = None
         while procs:
             for p in list(procs):
                 ret = p.poll()
@@ -222,10 +242,21 @@ def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
                     # Keep the FIRST failure's code: the peers reaped after
                     # the teardown die with -SIGTERM, which would mask the
                     # root cause in the restart log and the final status.
+                    # (A preempted worker's PREEMPTION_EXIT_CODE survives
+                    # the same way — its SIGTERMed peers write their own
+                    # emergency checkpoints and exit with the same code.)
                     exit_code = ret
                     for q in procs:
                         q.send_signal(signal.SIGTERM)
+                    term_deadline = time.time() + _term_grace_secs()
             if procs:
+                if term_deadline is not None and time.time() > term_deadline:
+                    # Peers trapped the TERM (emergency save wedged, or a
+                    # hung collective): escalate so the group actually dies
+                    # and the restart policy can run.
+                    for q in procs:
+                        q.kill()
+                    term_deadline = None
                 time.sleep(0.2)
         return exit_code
     finally:
@@ -258,6 +289,7 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     rendezvous_retries = 3
     first_group = True
     attempt = 0
+    preemption_resumes = 0
     while attempt <= cfg.max_restarts:
         if pinned_address:
             cfg.coordinator_address = pinned_address
@@ -269,6 +301,26 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
         exit_code = _run_worker_group(cfg, cmd, args)
         if exit_code == 0:
             return 0
+        if (
+            exit_code == PREEMPTION_EXIT_CODE
+            and preemption_resumes < _max_preemption_resumes()
+        ):
+            # Exit-code contract (resilience/preemption.py): the group was
+            # preempted AFTER committing an emergency checkpoint — this is
+            # not a failure, so resume immediately on a fresh port without
+            # consuming a --max_restarts attempt. Bounded by
+            # ATX_MAX_PREEMPTION_RESUMES against a pathological script that
+            # always exits preempted.
+            preemption_resumes += 1
+            print(
+                "[accelerate-tpu launch] worker group preempted (exit "
+                f"{PREEMPTION_EXIT_CODE}, emergency checkpoint committed); "
+                f"resuming immediately (resume {preemption_resumes}, not "
+                "counted against --max_restarts)",
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
         # Only launcher-chosen addresses are "127.0.0.1:<port>"; a pinned
         # address may have no numeric port, so parse under the guard.
         if not pinned_address and rendezvous_retries > 0 and _port_stolen(
@@ -331,19 +383,37 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in gcloud))
         return 0
-    exit_code = 0
-    for attempt in range(cfg.max_restarts + 1):
+    attempt = 0
+    preemption_resumes = 0
+    while True:
         exit_code = subprocess.call(gcloud)
         if exit_code == 0:
             return 0
-        if attempt < cfg.max_restarts:
+        if (
+            exit_code == PREEMPTION_EXIT_CODE
+            and preemption_resumes < _max_preemption_resumes()
+        ):
+            # Same exit-code contract as the local group path: a preempted
+            # pod committed its emergency checkpoint, so the re-run is a
+            # resume, not a burned restart attempt.
+            preemption_resumes += 1
             print(
-                f"[accelerate-tpu launch] pod run failed (exit {exit_code}); "
-                f"restarting ({attempt + 1}/{cfg.max_restarts})",
+                "[accelerate-tpu launch] pod run preempted (exit "
+                f"{PREEMPTION_EXIT_CODE}); resuming immediately (resume "
+                f"{preemption_resumes}, not counted against --max_restarts)",
                 file=sys.stderr,
                 flush=True,
             )
-    return exit_code
+            continue
+        if attempt >= cfg.max_restarts:
+            return exit_code
+        print(
+            f"[accelerate-tpu launch] pod run failed (exit {exit_code}); "
+            f"restarting ({attempt + 1}/{cfg.max_restarts})",
+            file=sys.stderr,
+            flush=True,
+        )
+        attempt += 1
 
 
 def _fp8_speedup_for_local_devices() -> float | None:

@@ -320,6 +320,9 @@ def disk_streamed_update(
     # the loop below leaves it set, and resume/retry refuse loudly instead
     # of re-applying the update to already-written leaves.
     tx.store.begin_update(count)
+    from ..resilience.commit import fault_point
+
+    fault_point("disk.after_sentinel")
     # One host float per step: a schedule returns a jax scalar, and letting
     # it into the numpy slice math would silently promote every slice to a
     # device op (round-tripping each layer through the slow link twice —

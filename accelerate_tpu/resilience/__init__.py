@@ -1,0 +1,75 @@
+"""Preemption-safe resilience layer.
+
+TPU pods are preemptible by design: maintenance events and spot reclaims
+kill whole worker groups with short notice, and a wedged collective can
+park a pod forever. The reference Accelerate leans on torch-elastic's
+restart semantics (PAPER.md §launcher) and assumes the bytes on disk are
+sane; this layer makes a kill -9 at any instant, a SIGTERM preemption
+notice, or a hung step a *recoverable* event:
+
+- :mod:`~accelerate_tpu.resilience.commit` — the atomic checkpoint commit
+  protocol (tmp-dir writes, per-file SHA-256 manifests, rename + ``COMMIT``
+  marker last) plus committed-checkpoint discovery and verification.
+  `checkpointing.save_state`/`load_state(resume="latest")` are built on it.
+- :mod:`~accelerate_tpu.resilience.preemption` — SIGTERM/maintenance-notice
+  handling: the handler only sets a flag; the training loop (or the step
+  helper's automatic hook) polls it via ``accelerator.preemption_requested()``
+  and turns it into an emergency checkpoint + ``PREEMPTION_EXIT_CODE`` at
+  the next step boundary. The elastic loop in ``commands/launch.py`` treats
+  that exit code as "resume immediately, don't burn a --max_restarts
+  attempt".
+- :mod:`~accelerate_tpu.resilience.watchdog` — an opt-in per-step deadline
+  (``ATX_WATCHDOG_SECS``) on a heartbeat thread: when a step/collective
+  wedges, it dumps every Python thread's stack and aborts the process with
+  ``WATCHDOG_EXIT_CODE`` so the elastic restart fires instead of the pod
+  hanging forever.
+
+Fault-injection hooks (`commit.fault_point`) are no-ops unless one of the
+``ATX_FAULT_{KILL,RAISE}_AT`` env vars is set; the test harness that drives
+them lives in `test_utils/faults.py`. See docs/fault_tolerance.md.
+"""
+
+from .commit import (
+    COMMIT_MARKER,
+    TMP_SUFFIX,
+    CheckpointIntegrityWarning,
+    commit_dir,
+    committed_checkpoints,
+    fault_point,
+    is_committed,
+    latest_committed,
+    remove_stale_tmp,
+    verify_checkpoint,
+    write_manifest,
+)
+from .preemption import (
+    PREEMPTION_EXIT_CODE,
+    clear_preemption,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+)
+from .watchdog import WATCHDOG_EXIT_CODE, Watchdog, dump_all_stacks, watchdog_from_env
+
+__all__ = [
+    "COMMIT_MARKER",
+    "TMP_SUFFIX",
+    "CheckpointIntegrityWarning",
+    "PREEMPTION_EXIT_CODE",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
+    "clear_preemption",
+    "commit_dir",
+    "committed_checkpoints",
+    "dump_all_stacks",
+    "fault_point",
+    "install_preemption_handler",
+    "is_committed",
+    "latest_committed",
+    "preemption_requested",
+    "remove_stale_tmp",
+    "request_preemption",
+    "verify_checkpoint",
+    "watchdog_from_env",
+    "write_manifest",
+]

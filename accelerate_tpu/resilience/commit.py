@@ -1,0 +1,279 @@
+"""Atomic checkpoint commit protocol.
+
+A checkpoint is *committed* when — and only when — its directory contains a
+``COMMIT`` marker. The writer's contract (`checkpointing.save_state`):
+
+1. every file is written into a sibling ``<final>.tmp/`` directory, never
+   into the final path;
+2. each process writes a ``manifest_<proc>.json`` of SHA-256 + size for the
+   files it wrote, AFTER all of them are on disk;
+3. a multi-host barrier (collective on the sync path, ``.precommit_<proc>``
+   marker files on the async path — a background thread must not run
+   collectives the main thread may also be issuing);
+4. process 0 renames ``<final>.tmp`` → ``<final>`` and writes the ``COMMIT``
+   marker last (tempfile + ``os.replace`` + fsync of file and parent dir);
+5. rotation (``total_limit``) deletes old checkpoints only AFTER the new
+   commit lands.
+
+A crash at ANY instant therefore leaves either (a) a stale ``.tmp`` dir, or
+(b) a renamed dir with no ``COMMIT`` — both invisible to
+``load_state(resume="latest")``, which only considers committed directories
+and verifies their manifests before trusting a byte (falling back to the
+previous committed checkpoint on corruption).
+
+This module is dependency-free (no jax) so the launcher and tests can import
+it cheaply.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import time
+from typing import Any
+
+COMMIT_MARKER = "COMMIT"
+TMP_SUFFIX = ".tmp"
+MANIFEST_FILE = "manifest_{proc}.json"
+PRECOMMIT_FILE = ".precommit_{proc}"
+_MANIFEST_PATTERN = re.compile(r"^manifest_(\d+)\.json$")
+_CKPT_PATTERN = re.compile(r"^checkpoint_(\d+)$")
+
+
+class CheckpointIntegrityWarning(UserWarning):
+    """A committed checkpoint failed manifest verification and was skipped
+    (resume fell back to the previous committed checkpoint)."""
+
+
+def fault_point(name: str) -> None:
+    """Fault-injection hook. No-op (one dict lookup) unless the test harness
+    set ``ATX_FAULT_KILL_AT`` (simulated kill -9 via ``os._exit``) or
+    ``ATX_FAULT_RAISE_AT`` (in-process `FaultInjected`) — see
+    `test_utils/faults.py` for the points the save/commit path exposes."""
+    if "ATX_FAULT_KILL_AT" in os.environ or "ATX_FAULT_RAISE_AT" in os.environ:
+        from ..test_utils.faults import crash_point
+
+        crash_point(name)
+
+
+# ------------------------------------------------------------------ manifests
+def file_sha256(path: str, chunk_bytes: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_manifest(directory: str, proc: int, files: list[str]) -> str:
+    """Hash ``files`` (paths relative to ``directory``) into
+    ``manifest_<proc>.json``. Called after every listed file is fully
+    written; the manifest itself is replaced atomically so a crash mid-write
+    can never leave a parseable-but-partial manifest."""
+    entries: dict[str, Any] = {}
+    for rel in files:
+        path = os.path.join(directory, rel)
+        entries[rel] = {"sha256": file_sha256(path), "size": os.path.getsize(path)}
+    out = os.path.join(directory, MANIFEST_FILE.format(proc=proc))
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "process": proc, "files": entries}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+def _manifest_paths(directory: str) -> list[str]:
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, name)
+        for name in os.listdir(directory)
+        if _MANIFEST_PATTERN.match(name)
+    )
+
+
+def verify_checkpoint(directory: str) -> list[str]:
+    """Check every manifest-listed file's existence, size, and SHA-256.
+
+    Returns a list of human-readable errors (empty = verified). A directory
+    with no manifest and no ``COMMIT`` marker is treated as a pre-manifest
+    legacy checkpoint and passes vacuously; a *committed* directory with no
+    manifest is an error (the protocol writes manifests before the marker).
+    """
+    if not os.path.isdir(directory):
+        return [f"{directory} is not a directory"]
+    manifests = _manifest_paths(directory)
+    if not manifests:
+        if is_committed(directory):
+            return [f"committed checkpoint {directory} has no manifest files"]
+        return []
+    errors: list[str] = []
+    for mpath in manifests:
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            entries = manifest["files"]
+        except (ValueError, KeyError) as e:
+            errors.append(f"unreadable manifest {os.path.basename(mpath)}: {e}")
+            continue
+        for rel, info in entries.items():
+            path = os.path.join(directory, rel)
+            if not os.path.exists(path):
+                errors.append(f"missing file {rel}")
+                continue
+            size = os.path.getsize(path)
+            if size != info["size"]:
+                errors.append(
+                    f"size mismatch for {rel}: {size} bytes on disk, "
+                    f"{info['size']} in manifest"
+                )
+                continue
+            if file_sha256(path) != info["sha256"]:
+                errors.append(f"sha256 mismatch for {rel}")
+    return errors
+
+
+# ------------------------------------------------------------------- markers
+def is_committed(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, COMMIT_MARKER))
+
+
+def read_commit_marker(directory: str) -> dict[str, Any]:
+    with open(os.path.join(directory, COMMIT_MARKER)) as f:
+        return json.load(f)
+
+
+def _fsync_dir(path: str) -> None:
+    # Directory fsync makes the rename/marker durable on POSIX; best-effort
+    # (not every filesystem supports opening a directory).
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic fs
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def commit_dir(tmp_dir: str, final_dir: str, meta: dict[str, Any] | None = None) -> None:
+    """Publish ``tmp_dir`` as the committed checkpoint ``final_dir``:
+    rename, then write the ``COMMIT`` marker last.
+
+    If ``final_dir`` already exists (an explicit-output-dir re-save), it is
+    moved aside first and deleted after the new directory is committed —
+    under ``automatic_checkpoint_naming`` (the crash-safe workflow) the
+    final name is always fresh and this path never runs.
+    """
+    fault_point("commit.before_rename")
+    aside = None
+    if os.path.isdir(final_dir):
+        aside = final_dir + ".replaced"
+        shutil.rmtree(aside, ignore_errors=True)
+        os.rename(final_dir, aside)
+    os.rename(tmp_dir, final_dir)
+    fault_point("commit.before_marker")
+    marker = os.path.join(final_dir, COMMIT_MARKER)
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "committed_at": time.time(), **(meta or {})}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker)
+    _fsync_dir(final_dir)
+    _fsync_dir(os.path.dirname(os.path.abspath(final_dir)))
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+
+
+# --------------------------------------------------------- async-path barrier
+def mark_precommit(tmp_dir: str, proc: int) -> None:
+    """File-based barrier half for the async-save path: each process drops a
+    marker once its files + manifest are fully written."""
+    path = os.path.join(tmp_dir, PRECOMMIT_FILE.format(proc=proc))
+    with open(path, "w") as f:
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def wait_for_precommit(tmp_dir: str, num_processes: int, timeout_secs: float) -> None:
+    """Process 0's half of the file barrier: poll until every process's
+    marker exists (shared filesystem), then remove the markers so they never
+    appear in the committed directory."""
+    deadline = time.monotonic() + timeout_secs
+    paths = [
+        os.path.join(tmp_dir, PRECOMMIT_FILE.format(proc=p))
+        for p in range(num_processes)
+    ]
+    while True:
+        missing = [p for p in paths if not os.path.exists(p)]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"async checkpoint commit timed out after {timeout_secs:.0f}s "
+                f"waiting for {len(missing)} process(es) to finish writing "
+                f"{tmp_dir} (first missing: {os.path.basename(missing[0])}); "
+                "raise ATX_COMMIT_BARRIER_SECS if the write is legitimately "
+                "slow"
+            )
+        time.sleep(0.05)
+    for p in paths:
+        try:
+            os.remove(p)
+        except FileNotFoundError:  # pragma: no cover - racing cleaner
+            pass
+
+
+# ----------------------------------------------------------------- discovery
+def checkpoint_iteration(name: str) -> int | None:
+    m = _CKPT_PATTERN.match(name)
+    return int(m.group(1)) if m else None
+
+
+def committed_checkpoints(root: str) -> list[tuple[int, str]]:
+    """``(iteration, path)`` for every *committed* ``checkpoint_<n>`` under
+    ``root``, sorted oldest → newest. Uncommitted dirs (crash debris) and
+    ``.tmp`` dirs are excluded by construction."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        n = checkpoint_iteration(name)
+        if n is None:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(path) and is_committed(path):
+            out.append((n, path))
+    return sorted(out)
+
+
+def latest_committed(root: str) -> str | None:
+    found = committed_checkpoints(root)
+    return found[-1][1] if found else None
+
+
+def remove_stale_tmp(root: str) -> list[str]:
+    """Delete leftover ``checkpoint_*.tmp`` dirs (crashed saves). Safe to
+    call only while no save is in flight — `save_state` runs it during
+    post-commit rotation, which the async saver serializes."""
+    removed = []
+    if not os.path.isdir(root):
+        return removed
+    for name in os.listdir(root):
+        if name.endswith(TMP_SUFFIX) and checkpoint_iteration(name[: -len(TMP_SUFFIX)]) is not None:
+            path = os.path.join(root, name)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+    return removed

@@ -1,0 +1,108 @@
+"""SIGTERM / maintenance-notice preemption handling.
+
+TPU preemptions (spot reclaim, maintenance events) deliver SIGTERM with a
+short grace window. The handler here does the *minimum* a signal handler
+safely can — set a flag — and the training loop turns the flag into an
+emergency checkpoint at the next step boundary:
+
+- ``Accelerator.make_train_step``'s returned step checks the flag at entry
+  (before any compute, so every completed step's metrics were already
+  returned) and, when ``automatic_checkpoint_naming`` gives it a place to
+  save, writes a committed emergency checkpoint and raises
+  ``SystemExit(PREEMPTION_EXIT_CODE)``;
+- loops without automatic naming poll ``accelerator.preemption_requested()``
+  themselves and save wherever they choose.
+
+``PREEMPTION_EXIT_CODE`` (75, BSD ``EX_TEMPFAIL``) is the exit-code
+contract with the elastic loop in ``commands/launch.py``: a worker group
+that dies with it is resumed immediately WITHOUT burning a
+``--max_restarts`` attempt — the checkpoint is known-good, so the restart
+is not a failure.
+
+A second SIGTERM while the flag is already set restores the default
+disposition and re-delivers the signal, so an impatient supervisor (or the
+launcher's own group teardown) can still terminate a process that never
+reaches a step boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Iterable
+
+PREEMPTION_EXIT_CODE = 75  # EX_TEMPFAIL: transient failure, retry == resume
+
+_flag = threading.Event()
+_installed_signals: dict[int, object] = {}
+
+
+def install_preemption_handler(
+    signals: Iterable[int] = (signal.SIGTERM,),
+) -> bool:
+    """Install the flag-setting handler for ``signals`` (idempotent).
+
+    Returns False (and installs nothing) off the main thread or when the
+    interpreter refuses (e.g. an embedded runtime) — signal handlers can
+    only be registered from the main thread. ``Accelerator.__init__`` calls
+    this automatically unless ``ATX_PREEMPTION_HANDLER=0``.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        for sig in signals:
+            if sig in _installed_signals:
+                continue
+            _installed_signals[sig] = signal.signal(sig, _handler)
+    except (ValueError, OSError):  # pragma: no cover - non-main interpreter
+        return False
+    return True
+
+
+def _handler(signum: int, frame) -> None:
+    if _flag.is_set():
+        # Second notice: the escalation path. Restore the default disposition
+        # and re-deliver so the process actually dies (the launcher's
+        # teardown, or a supervisor that ran out of patience).
+        signal.signal(signum, _installed_signals.get(signum) or signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+        return
+    _flag.set()
+    sys.stderr.write(
+        f"[accelerate_tpu] received signal {signum}: preemption requested — "
+        "an emergency checkpoint will be written at the next step boundary "
+        f"(exit code {PREEMPTION_EXIT_CODE})\n"
+    )
+    sys.stderr.flush()
+    prev = _installed_signals.get(signum)
+    if callable(prev) and prev is not _handler:
+        prev(signum, frame)  # chain a user handler we displaced
+
+
+def preemption_requested() -> bool:
+    """Has a preemption notice (SIGTERM / `request_preemption`) arrived?"""
+    return _flag.is_set()
+
+
+def request_preemption() -> None:
+    """Set the preemption flag programmatically — for maintenance-notice
+    pollers (e.g. a thread watching the GCE metadata server) and tests."""
+    _flag.set()
+
+
+def clear_preemption() -> None:
+    """Reset the flag (tests / a loop that chose to keep training)."""
+    _flag.clear()
+
+
+def _reset_for_tests() -> None:
+    """Restore the original signal dispositions and clear all state."""
+    for sig, prev in list(_installed_signals.items()):
+        try:
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+    _installed_signals.clear()
+    _flag.clear()

@@ -1,0 +1,198 @@
+"""Hang watchdog: a per-step deadline on a heartbeat thread.
+
+A wedged collective (one host died mid-all-reduce, a deadlocked rendezvous)
+parks a TPU pod silently — the process never exits, so the elastic restart
+in ``commands/launch.py`` never fires and the pod burns until a human
+notices. The watchdog converts the hang into a crash the launcher can
+handle: a daemon thread checks an armed deadline; when a step exceeds it,
+every Python thread's stack is dumped to stderr (so the wedge site is in
+the log) and the process aborts with ``WATCHDOG_EXIT_CODE``.
+
+Opt-in via ``ATX_WATCHDOG_SECS=<per-step deadline>``; the step helper
+returned by ``Accelerator.make_train_step`` re-arms the countdown at every
+step ENTRY and leaves it armed across the call — heartbeat semantics. jax
+dispatches compiled steps *asynchronously* (the Python call can return
+before the device work runs), so a disarm-on-return would miss a wedged
+collective entirely; instead the deadline bounds the gap between
+consecutive step entries, which catches the wedge wherever the process
+actually stalls (blocking on the step's metrics, the next dispatch, or
+interpreter exit). The FIRST armed step of a process gets
+``ATX_WATCHDOG_FIRST_STEP_SECS`` (default 10x the deadline) to absorb XLA
+compilation; ``Accelerator.end_training()`` stands the watchdog down so
+post-training work is never shot.
+
+Direct use for custom loops::
+
+    wd = Watchdog(deadline_secs=120)
+    for batch in loader:
+        wd.arm()                                 # re-arms every iteration
+        state, metrics = my_step(state, batch)
+        print(float(metrics["loss"]))            # wedge -> no next arm -> abort
+    wd.stop()
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+WATCHDOG_EXIT_CODE = 114
+
+
+def dump_all_stacks(out: Any) -> None:
+    """Write every live Python thread's stack to ``out`` (pure Python via
+    ``sys._current_frames`` so it works on any file-like object; the frames
+    of a thread blocked in a C call show the last Python frame — the
+    jitted-step dispatch site — which is exactly the wedge evidence)."""
+    frames = sys._current_frames()
+    for thread in threading.enumerate():
+        out.write(
+            f"\n--- thread {thread.name!r} (ident={thread.ident}, "
+            f"daemon={thread.daemon}) ---\n"
+        )
+        frame = frames.get(thread.ident)
+        if frame is None:
+            out.write("  <no frame>\n")
+            continue
+        out.write("".join(traceback.format_stack(frame)))
+    out.flush()
+
+
+class Watchdog:
+    """Heartbeat-thread deadline. `arm()` starts the countdown, `disarm()`
+    stops it, `beat()` restarts it without counting a new step (for long
+    host-side loops between device steps)."""
+
+    def __init__(
+        self,
+        deadline_secs: float,
+        *,
+        first_deadline_secs: float | None = None,
+        out: Any = None,
+        abort: Callable[[], None] | None = None,
+    ) -> None:
+        self.deadline = float(deadline_secs)
+        if self.deadline <= 0:
+            raise ValueError(f"deadline_secs must be > 0, got {deadline_secs}")
+        self.first_deadline = (
+            max(float(first_deadline_secs), self.deadline)
+            if first_deadline_secs is not None
+            else self.deadline
+        )
+        self._out = out
+        self._abort = abort  # test seam: called instead of os._exit
+        self._lock = threading.Lock()
+        self._armed_at: float | None = None
+        self._armed_deadline = self.deadline
+        self._arms = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.fired = threading.Event()
+
+    def arm(self, deadline_secs: float | None = None) -> None:
+        """Start the countdown for one step. The first arm of this watchdog
+        uses the (longer) first-step deadline — compilation headroom."""
+        with self._lock:
+            if deadline_secs is not None:
+                d = float(deadline_secs)
+            elif self._arms == 0:
+                d = self.first_deadline
+            else:
+                d = self.deadline
+            self._arms += 1
+            self._armed_deadline = d
+            self._armed_at = time.monotonic()
+            self._ensure_thread_locked()
+
+    def beat(self) -> None:
+        with self._lock:
+            if self._armed_at is not None:
+                self._armed_at = time.monotonic()
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed_at = None
+
+    def stop(self) -> None:
+        """Shut the heartbeat thread down (tests / end of training)."""
+        self.disarm()
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+        self._thread = None
+
+    # ------------------------------------------------------------- internals
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="atx-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def _poll_interval(self) -> float:
+        return min(max(self.deadline / 4.0, 0.02), 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_interval()):
+            with self._lock:
+                armed_at = self._armed_at
+                deadline = self._armed_deadline
+            if armed_at is not None and time.monotonic() - armed_at > deadline:
+                self._fire(deadline)
+                return
+
+    def _fire(self, deadline: float) -> None:
+        out = self._out if self._out is not None else sys.stderr
+        try:
+            out.write(
+                f"\n[atx watchdog] step exceeded its {deadline:.1f}s deadline "
+                "(ATX_WATCHDOG_SECS): a step or collective appears wedged. "
+                "Dumping all thread stacks, then aborting with exit code "
+                f"{WATCHDOG_EXIT_CODE} so an elastic launcher (--max_restarts) "
+                "can restart the group instead of hanging forever.\n"
+            )
+            dump_all_stacks(out)
+        except Exception:  # pragma: no cover - never block the abort
+            pass
+        if self._abort is not None:
+            self._abort()
+            self.fired.set()  # set AFTER the abort ran (test ordering seam)
+            return
+        self.fired.set()
+        os._exit(WATCHDOG_EXIT_CODE)  # pragma: no cover - kills the process
+
+
+_ENV_WATCHDOG: Watchdog | None = None
+
+
+def watchdog_from_env() -> Watchdog | None:
+    """The process-wide watchdog configured by ``ATX_WATCHDOG_SECS`` (None
+    when unset/invalid/<=0). One instance per deadline value, shared by
+    every train step in the process."""
+    raw = os.environ.get("ATX_WATCHDOG_SECS")
+    if not raw:
+        return None
+    try:
+        deadline = float(raw)
+    except ValueError:
+        return None
+    if deadline <= 0:
+        return None
+    global _ENV_WATCHDOG
+    if _ENV_WATCHDOG is not None and _ENV_WATCHDOG.deadline != deadline:
+        _ENV_WATCHDOG.stop()  # a reconfigured deadline must not leave the
+        _ENV_WATCHDOG = None  # old armed thread behind to fire later
+    if _ENV_WATCHDOG is None or _ENV_WATCHDOG.deadline != deadline:
+        first_raw = os.environ.get("ATX_WATCHDOG_FIRST_STEP_SECS")
+        try:
+            first = float(first_raw) if first_raw else deadline * 10.0
+        except ValueError:
+            first = deadline * 10.0
+        _ENV_WATCHDOG = Watchdog(deadline, first_deadline_secs=first)
+    return _ENV_WATCHDOG

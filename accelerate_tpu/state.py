@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import logging
 import os
+import random as _random
 import threading
+import time as _time
 from contextlib import contextmanager
 from typing import Any, Callable, Iterator
 
@@ -72,15 +74,62 @@ def maybe_initialize_jax_distributed() -> None:
         num_processes = get_int_from_env(("ATX_NUM_PROCESSES", "JAX_NUM_PROCESSES"), 0)
         process_id = get_int_from_env(("ATX_PROCESS_ID", "JAX_PROCESS_ID"), -1)
         if coordinator and num_processes > 1:
-            jax.distributed.initialize(
+            _initialize_distributed_with_retries(
                 coordinator_address=coordinator,
                 num_processes=num_processes,
                 process_id=process_id if process_id >= 0 else None,
             )
             _jax_distributed_initialized = True
         elif parse_flag_from_env("ATX_MULTIHOST"):
-            jax.distributed.initialize()
+            _initialize_distributed_with_retries()
             _jax_distributed_initialized = True
+
+
+def _initialize_distributed_with_retries(**kwargs: Any) -> None:
+    """`jax.distributed.initialize` with bounded exponential backoff + jitter.
+
+    The coordination service is the flakiest moment of a pod launch: workers
+    race the coordinator's bind, and a slow heartbeat at init kills the whole
+    group (the failure mode behind the two flaky multi-process tests on the
+    ROADMAP). Knobs:
+
+    - ``ATX_COORD_INIT_RETRIES`` (default 3): retries *after* the first
+      failure, backing off 1s → 2s → 4s … (capped at 30s) with up to +100%
+      jitter so restarted workers don't re-stampede the coordinator.
+    - ``ATX_COORD_TIMEOUT_SECS``: forwarded as ``initialization_timeout`` so
+      a dead coordinator fails fast instead of blocking for jax's default;
+      dropped transparently on jax builds without the kwarg.
+    """
+    retries = get_int_from_env(("ATX_COORD_INIT_RETRIES",), 3)
+    timeout_secs = get_int_from_env(("ATX_COORD_TIMEOUT_SECS",), 0)
+    if timeout_secs > 0:
+        kwargs["initialization_timeout"] = timeout_secs
+    delay = 1.0
+    failures = 0
+    while True:
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except TypeError:
+            if "initialization_timeout" not in kwargs:
+                raise
+            kwargs.pop("initialization_timeout")  # older jax: no such kwarg
+            continue
+        except Exception as e:
+            failures += 1
+            if failures > retries:
+                raise
+            sleep_for = delay * (1.0 + _random.random())
+            logger.warning(
+                "jax.distributed.initialize failed (attempt %d/%d): %s — "
+                "retrying in %.1fs",
+                failures,
+                retries,
+                e,
+                sleep_for,
+            )
+            _time.sleep(sleep_for)
+            delay = min(delay * 2.0, 30.0)
 
 
 class ProcessState:

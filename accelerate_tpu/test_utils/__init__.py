@@ -1,6 +1,7 @@
 """Shipped test utilities (reference `test_utils/`, 5,156 LoC: the bundled
 self-diagnostic + tiny fixtures pattern, SURVEY.md §2.6/§4)."""
 
+from . import faults
 from .testing import (
     AccelerateTestCase,
     are_same_tensors,
@@ -19,6 +20,7 @@ from .training import RegressionDataset, regression_init, regression_loss
 __all__ = [
     "AccelerateTestCase",
     "RegressionDataset",
+    "faults",
     "are_same_tensors",
     "regression_init",
     "regression_loss",

@@ -1,0 +1,100 @@
+"""Fault-injection harness for the resilience tests (docs/fault_tolerance.md).
+
+Two families of faults:
+
+- **Byte corruption** of files already on disk — `truncate_file` (a write
+  that died mid-stream), `flip_bit` (silent media/DMA corruption). The
+  manifest verification in `resilience/commit.py` must catch both before
+  `load_state(resume="latest")` trusts a byte.
+- **Crash points** — named hooks compiled into the save/commit/offload
+  paths (`resilience.commit.fault_point`), normally a no-op. Setting
+  ``ATX_FAULT_KILL_AT=<point>`` makes the process ``os._exit(137)`` there
+  (the kill -9 analog: no atexit, no flush, no cleanup); setting
+  ``ATX_FAULT_RAISE_AT=<point>`` raises `FaultInjected` instead, for
+  in-process tests (e.g. the delayed-rename scenario: a save whose tmp dir
+  is fully written but never renamed).
+
+Instrumented points:
+
+==============================  =================================================
+``save.files_written``          all of this process's checkpoint files are on
+                                disk, manifest NOT yet written
+``save.manifest_written``       manifest written, commit NOT yet started
+``commit.before_rename``        tmp dir complete, final rename NOT done
+                                (the "delayed rename" fault)
+``commit.before_marker``        renamed to final, ``COMMIT`` marker NOT written
+``disk.after_sentinel``         disk-offload dirty sentinel written, moments
+                                NOT yet mutated/flushed
+==============================  =================================================
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from typing import Iterator
+
+from ..utils.environment import patch_environment
+
+KILL_EXIT_CODE = 137  # what a real `kill -9` reports (128 + SIGKILL)
+
+KILL_AT_ENV = "ATX_FAULT_KILL_AT"
+RAISE_AT_ENV = "ATX_FAULT_RAISE_AT"
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a crash point when ``ATX_FAULT_RAISE_AT`` names it."""
+
+
+def crash_point(name: str) -> None:
+    """The hook body `resilience.commit.fault_point` dispatches to once a
+    fault env var is present."""
+    if os.environ.get(RAISE_AT_ENV) == name:
+        raise FaultInjected(f"injected fault at crash point {name!r}")
+    if os.environ.get(KILL_AT_ENV) == name:
+        sys.stderr.write(f"[faults] kill -9 analog at crash point {name!r}\n")
+        sys.stderr.flush()
+        os._exit(KILL_EXIT_CODE)
+
+
+@contextmanager
+def raise_at(point: str) -> Iterator[None]:
+    """In-process fault: `FaultInjected` is raised when execution reaches
+    ``point`` inside the block."""
+    with patch_environment(**{RAISE_AT_ENV: point}):
+        yield
+
+
+def kill_env(point: str, base: dict | None = None) -> dict:
+    """Env dict for a subprocess that should die (``os._exit(137)``) at
+    ``point`` — the deterministic kill-during-save harness."""
+    env = dict(os.environ if base is None else base)
+    env[KILL_AT_ENV] = point
+    return env
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate ``path`` to a fraction of its size (a write that died
+    mid-stream). Returns the new size."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_fraction))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+def flip_bit(path: str, byte_offset: int | None = None, bit: int = 0) -> int:
+    """Flip one bit in ``path`` (default: the middle byte) — silent
+    corruption that leaves size intact, so only a checksum catches it.
+    Returns the byte offset flipped."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot flip a bit in empty file {path}")
+    offset = size // 2 if byte_offset is None else byte_offset
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ (1 << bit)]))
+    return offset

@@ -1,0 +1,17 @@
+"""Launcher exit-code-contract driver (tests/test_resilience.py): rank 0
+exits with PREEMPTION_EXIT_CODE (75) on the first group run (leaving a
+marker), every rank completes on the resume — proving the elastic loop
+resumes a preempted group without consuming a --max_restarts attempt.
+Deliberately jax-free so the launcher contract is tested in isolation."""
+
+import os
+import sys
+
+marker = sys.argv[1]
+rank = int(os.environ.get("ATX_PROCESS_ID", "0"))
+if rank == 0 and not os.path.exists(marker):
+    with open(marker, "w") as f:
+        f.write("preempted")
+    print("[exit_preempted_once] PREEMPTING", flush=True)
+    sys.exit(75)
+print(f"[proc {rank}] RESUMED OK", flush=True)

@@ -1,0 +1,38 @@
+"""Kill-9-mid-save regression driver (tests/test_resilience.py).
+
+With ``total_limit=1``, the pre-commit-protocol code deleted the old
+checkpoint BEFORE the new one was written — a crash mid-save lost both.
+This script commits one checkpoint, then dies (``os._exit(137)``, the
+kill -9 analog) at the fault point named by argv[2] during a second save;
+the parent test proves the first checkpoint still loads via
+``load_state(resume="latest")``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+project_dir, kill_point = sys.argv[1], sys.argv[2]
+
+import jax.numpy as jnp
+import optax
+
+import accelerate_tpu as atx
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+acc = atx.Accelerator(
+    project_config=ProjectConfiguration(
+        project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=1
+    ),
+    seed=0,
+)
+state = acc.create_train_state({"w": jnp.arange(16.0)}, optax.sgd(0.1))
+acc.save_state(None, state)
+print("[ckpt_crash] first checkpoint committed", flush=True)
+
+state2 = state.replace(params={"w": state.params["w"] + 1.0}, step=state.step + 1)
+os.environ["ATX_FAULT_KILL_AT"] = kill_point
+acc.save_state(None, state2)
+print("[ckpt_crash] SECOND SAVE SURVIVED (fault point never fired)", flush=True)
+sys.exit(3)

@@ -1,0 +1,33 @@
+"""Disk-offload sentinel crash driver (tests/test_resilience.py).
+
+Runs one healthy disk-offloaded step, then dies with the kill -9 analog at
+``disk.after_sentinel`` — after the dirty sentinel is written but before
+any moment flush — on step 2. The parent test proves resume over the same
+offload_dir refuses with the actionable recovery message.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+offload_dir = sys.argv[1]
+
+import jax.numpy as jnp
+
+import accelerate_tpu as atx
+
+acc = atx.Accelerator(seed=0)
+tx = atx.disk_offloaded_adamw(1e-2, offload_dir=offload_dir)
+state = acc.create_train_state({"w": jnp.ones((4, 4), jnp.float32)}, tx)
+step = acc.make_train_step(
+    lambda p, b, r: jnp.mean((b["x"] @ p["w"]) ** 2), donate=False
+)
+batch = {"x": jnp.ones((2, 4), jnp.float32)}
+state, _ = step(state, batch)
+print("[disk_crash] healthy step done", flush=True)
+
+os.environ["ATX_FAULT_KILL_AT"] = "disk.after_sentinel"
+step(state, batch)
+print("[disk_crash] SECOND STEP SURVIVED (fault point never fired)", flush=True)
+sys.exit(3)

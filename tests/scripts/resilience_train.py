@@ -1,0 +1,111 @@
+"""Resilience subprocess driver (tests/test_resilience.py).
+
+Deterministic tiny training run that appends ``<step> <loss.hex()>`` lines
+to ``--loss_file`` — the bit-identity oracle for preemption-resume. Modes:
+
+- ``--sigterm_at K``: SIGTERM itself right before step K so the step
+  helper's automatic hook writes an emergency checkpoint and exits with
+  PREEMPTION_EXIT_CODE (75) at the step boundary;
+- ``--resume``: restore the newest committed checkpoint and continue to
+  ``--steps``;
+- ``--wedge_at K``: step K blocks forever inside the compiled step (a
+  pure_callback sleep — the hung-collective analog); the watchdog
+  (ATX_WATCHDOG_SECS) must dump stacks and abort.
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--loss_file", required=True)
+    ap.add_argument("--sigterm_at", type=int, default=None)
+    ap.add_argument("--wedge_at", type=int, default=None)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = atx.Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir,
+            automatic_checkpoint_naming=True,
+            total_limit=3,
+        ),
+        seed=0,
+    )
+
+    def init_fn(rng):
+        return {
+            "w": jax.random.normal(rng, (8, 8), jnp.float32) * 0.1,
+            "b": jnp.zeros((8,), jnp.float32),
+        }
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    state = acc.create_train_state(init_fn, optax.adam(1e-2))
+    step = acc.make_train_step(loss_fn)
+
+    start = 0
+    if args.resume:
+        state = acc.load_state(None, state, resume="latest")
+        start = int(jax.device_get(state.step))
+        print(f"[resilience_train] resumed at step {start}", flush=True)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1234 + i)
+        return {
+            "x": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+            "y": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        }
+
+    with open(args.loss_file, "a") as out:
+        for i in range(start, args.steps):
+            if args.sigterm_at is not None and i == args.sigterm_at:
+                # The preemption notice: handler sets the flag; the next step
+                # call's entry hook saves + exits 75 at the step boundary.
+                os.kill(os.getpid(), signal.SIGTERM)
+            if args.wedge_at is not None and i == args.wedge_at:
+                def wedged_loss(params, batch, rng):
+                    def _sleep(x):
+                        import time
+
+                        time.sleep(3600)
+                        return x
+
+                    pause = jax.pure_callback(
+                        _sleep, jax.ShapeDtypeStruct((), jnp.float32), jnp.float32(0.0)
+                    )
+                    return loss_fn(params, batch, rng) + pause
+
+                wedged = acc.make_train_step(wedged_loss)
+                # jax dispatches asynchronously: the call itself may return.
+                # A real loop blocks fetching the metrics — the watchdog's
+                # heartbeat deadline must fire while we are blocked here.
+                _, m = wedged(state, make_batch(i))
+                float(jax.device_get(m["loss"]))
+                print("[resilience_train] WEDGED STEP RETURNED", flush=True)
+                sys.exit(3)
+            state, metrics = step(state, make_batch(i))
+            out.write(f"{i} {float(jax.device_get(metrics['loss'])).hex()}\n")
+            out.flush()
+    print("[resilience_train] DONE", flush=True)
+
+
+main()

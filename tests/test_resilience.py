@@ -1,0 +1,604 @@
+"""Resilience-layer tests (docs/fault_tolerance.md).
+
+Three layers of proof:
+
+- **unit**: the commit protocol primitives (manifests, markers, discovery)
+  and the watchdog/preemption/backoff machinery in-process;
+- **fault-injected**: every injected fault (truncate, bit-flip, delayed
+  rename, rename-without-marker, kill-during-save) must leave
+  ``load_state(resume="latest")`` recovering the last *committed*
+  checkpoint, never a corrupt one;
+- **subprocess**: real SIGTERM mid-training → emergency checkpoint →
+  bit-identical resumed loss trajectory; real kill -9 mid-save with
+  ``total_limit=1`` → the previous checkpoint survives (the
+  rotation-before-durability regression); a wedged step → watchdog stack
+  dump + nonzero exit; a preempted worker group → elastic resume without
+  burning a --max_restarts attempt.
+"""
+
+import io
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
+import accelerate_tpu as atx
+from accelerate_tpu import checkpointing, resilience
+from accelerate_tpu.resilience import commit as commit_mod
+from accelerate_tpu.resilience.watchdog import Watchdog
+from accelerate_tpu.test_utils import faults
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+from tests.launch_helpers import REPO_ROOT, clean_env
+
+SCRIPTS = os.path.join(REPO_ROOT, "tests", "scripts")
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    yield
+    resilience.clear_preemption()
+    import accelerate_tpu.resilience.watchdog as wmod
+
+    if wmod._ENV_WATCHDOG is not None:
+        wmod._ENV_WATCHDOG.stop()
+        wmod._ENV_WATCHDOG = None
+
+
+def _auto_acc(tmp_path, **cfg):
+    return atx.Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True, **cfg
+        ),
+        seed=0,
+    )
+
+
+def _w_state(acc, offset=0.0):
+    return acc.create_train_state({"w": jnp.arange(8.0) + offset}, optax.sgd(0.1))
+
+
+def _child_env(extra=None):
+    env = clean_env({"JAX_PLATFORMS": "cpu"})
+    env.update(extra or {})
+    return env
+
+
+def _run_script(script, *argv, env=None, timeout=240):
+    return subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, script), *argv],
+        cwd=REPO_ROOT,
+        env=env or _child_env(),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+# ===================================================================== commit
+class TestCommitPrimitives:
+    def test_manifest_verify_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, "a.bin"), "wb") as f:
+            f.write(b"hello world" * 100)
+        os.makedirs(os.path.join(d, "sub"))
+        with open(os.path.join(d, "sub", "b.json"), "w") as f:
+            f.write("{}")
+        commit_mod.write_manifest(d, 0, ["a.bin", os.path.join("sub", "b.json")])
+        assert commit_mod.verify_checkpoint(d) == []
+
+    def test_verify_catches_truncate_bitflip_and_missing(self, tmp_path):
+        d = str(tmp_path)
+        path = os.path.join(d, "a.bin")
+        with open(path, "wb") as f:
+            f.write(os.urandom(4096))
+        commit_mod.write_manifest(d, 0, ["a.bin"])
+
+        faults.truncate_file(path, keep_fraction=0.5)
+        assert any("size mismatch" in e for e in commit_mod.verify_checkpoint(d))
+
+        with open(path, "wb") as f:
+            f.write(os.urandom(4096))
+        commit_mod.write_manifest(d, 0, ["a.bin"])
+        faults.flip_bit(path)
+        assert any("sha256 mismatch" in e for e in commit_mod.verify_checkpoint(d))
+
+        os.remove(path)
+        assert any("missing file" in e for e in commit_mod.verify_checkpoint(d))
+
+    def test_discovery_only_sees_committed(self, tmp_path):
+        root = str(tmp_path)
+        for name in ("checkpoint_0", "checkpoint_1", "checkpoint_2.tmp", "other"):
+            os.makedirs(os.path.join(root, name))
+        commit_mod.commit_dir(
+            os.path.join(root, "checkpoint_0"), os.path.join(root, "checkpoint_0_f")
+        )
+        os.rename(os.path.join(root, "checkpoint_0_f"), os.path.join(root, "checkpoint_0"))
+        found = commit_mod.committed_checkpoints(root)
+        assert [n for n, _ in found] == [0]
+        assert commit_mod.latest_committed(root).endswith("checkpoint_0")
+        removed = commit_mod.remove_stale_tmp(root)
+        assert len(removed) == 1 and removed[0].endswith("checkpoint_2.tmp")
+        # non-checkpoint names and uncommitted dirs are left alone
+        assert os.path.isdir(os.path.join(root, "other"))
+        assert os.path.isdir(os.path.join(root, "checkpoint_1"))
+
+    def test_commit_marker_is_written_last(self, tmp_path):
+        tmp = str(tmp_path / "checkpoint_0.tmp")
+        final = str(tmp_path / "checkpoint_0")
+        os.makedirs(tmp)
+        with faults.raise_at("commit.before_marker"):
+            with pytest.raises(faults.FaultInjected):
+                commit_mod.commit_dir(tmp, final, {"step": 1})
+        # renamed but uncommitted: invisible to discovery
+        assert os.path.isdir(final) and not commit_mod.is_committed(final)
+        assert commit_mod.committed_checkpoints(str(tmp_path)) == []
+
+    def test_precommit_file_barrier(self, tmp_path):
+        d = str(tmp_path)
+        commit_mod.mark_precommit(d, 0)
+        commit_mod.mark_precommit(d, 1)
+        commit_mod.wait_for_precommit(d, 2, timeout_secs=1.0)
+        assert not any(n.startswith(".precommit") for n in os.listdir(d))
+        with pytest.raises(RuntimeError, match="timed out"):
+            commit_mod.wait_for_precommit(d, 2, timeout_secs=0.2)
+
+
+# ==================================================== fault-injected resume
+class TestVerifiedResume:
+    """Every injected fault must leave resume="latest" recovering the last
+    committed checkpoint — never a corrupt one, never crash debris."""
+
+    def _two_checkpoints(self, tmp_path, **cfg):
+        acc = _auto_acc(tmp_path, **cfg)
+        state = _w_state(acc)
+        p0 = acc.save_state(None, state)
+        state1 = state.replace(
+            params={"w": state.params["w"] + 100.0}, step=state.step + 1
+        )
+        p1 = acc.save_state(None, state1)
+        return acc, state, p0, p1
+
+    def _resume(self, acc):
+        target = _w_state(acc)
+        return acc.load_state(None, target, resume="latest")
+
+    def test_healthy_resume_picks_newest(self, tmp_path):
+        acc, _, _, _ = self._two_checkpoints(tmp_path)
+        restored = self._resume(acc)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.arange(8.0) + 100.0
+        )
+        assert int(jax.device_get(restored.step)) == 1
+
+    @pytest.mark.parametrize("corrupt", ["truncate", "bitflip", "missing"])
+    def test_corrupt_newest_falls_back_with_warning(self, tmp_path, corrupt):
+        acc, _, p0, p1 = self._two_checkpoints(tmp_path)
+        shards = os.path.join(p1, checkpointing.MODEL_DIR, "shards_0.npz")
+        if corrupt == "truncate":
+            faults.truncate_file(shards)
+        elif corrupt == "bitflip":
+            faults.flip_bit(shards)
+        else:
+            os.remove(os.path.join(p1, "rng_state_0.json"))
+        with pytest.warns(resilience.CheckpointIntegrityWarning, match="falling back"):
+            restored = self._resume(acc)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(8.0))
+        assert int(jax.device_get(restored.step)) == 0
+
+    def test_delayed_rename_tmp_dir_is_invisible(self, tmp_path):
+        acc, state, _, p1 = self._two_checkpoints(tmp_path)
+        newer = state.replace(
+            params={"w": state.params["w"] + 999.0}, step=state.step + 2
+        )
+        with faults.raise_at("commit.before_rename"):
+            with pytest.raises(faults.FaultInjected):
+                acc.save_state(None, newer)
+        root = os.path.dirname(p1)
+        assert os.path.isdir(os.path.join(root, "checkpoint_2.tmp"))
+        restored = self._resume(acc)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.arange(8.0) + 100.0
+        )
+        # the next successful save reclaims the crashed save's tmp dir
+        acc.save_state(None, newer)
+        assert not os.path.isdir(os.path.join(root, "checkpoint_2.tmp"))
+
+    def test_rename_without_marker_is_invisible(self, tmp_path):
+        acc, state, _, p1 = self._two_checkpoints(tmp_path)
+        newer = state.replace(
+            params={"w": state.params["w"] + 999.0}, step=state.step + 2
+        )
+        with faults.raise_at("commit.before_marker"):
+            with pytest.raises(faults.FaultInjected):
+                acc.save_state(None, newer)
+        root = os.path.dirname(p1)
+        debris = os.path.join(root, "checkpoint_2")
+        assert os.path.isdir(debris) and not resilience.is_committed(debris)
+        restored = self._resume(acc)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.arange(8.0) + 100.0
+        )
+
+    def test_all_committed_corrupt_raises(self, tmp_path):
+        acc, _, p0, p1 = self._two_checkpoints(tmp_path)
+        for p in (p0, p1):
+            faults.flip_bit(os.path.join(p, checkpointing.MODEL_DIR, "shards_0.npz"))
+        with pytest.warns(resilience.CheckpointIntegrityWarning):
+            with pytest.raises(ValueError, match="every committed checkpoint"):
+                self._resume(acc)
+
+    def test_no_committed_checkpoint_raises(self, tmp_path):
+        acc = _auto_acc(tmp_path)
+        with pytest.raises(FileNotFoundError, match="no committed checkpoint"):
+            acc.load_state(None, _w_state(acc), resume="latest")
+
+    def test_explicit_dir_corruption_raises(self, tmp_path):
+        acc, _, _, p1 = self._two_checkpoints(tmp_path)
+        faults.flip_bit(os.path.join(p1, checkpointing.MODEL_DIR, "shards_0.npz"))
+        with pytest.raises(ValueError, match="integrity verification"):
+            acc.load_state(p1, _w_state(acc))
+
+    def test_total_limit_1_crash_mid_save_keeps_previous(self, tmp_path):
+        """The rotation-before-durability regression, in-process variant
+        (the kill -9 subprocess variant is TestKillDuringSave): with
+        total_limit=1 a crashed second save must leave the first
+        checkpoint committed and loadable."""
+        acc = _auto_acc(tmp_path, total_limit=1)
+        state = _w_state(acc)
+        p0 = acc.save_state(None, state)
+        newer = state.replace(params={"w": state.params["w"] + 1.0}, step=state.step + 1)
+        with faults.raise_at("save.files_written"):
+            with pytest.raises(faults.FaultInjected):
+                acc.save_state(None, newer)
+        assert resilience.is_committed(p0)
+        restored = self._resume(acc)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(8.0))
+
+    def test_async_save_commits_and_rotates_after(self, tmp_path):
+        acc = _auto_acc(tmp_path, total_limit=2)
+        state = _w_state(acc)
+        for k in range(3):
+            acc.save_state(
+                None,
+                state.replace(step=jnp.asarray(k, jnp.int32)),
+                async_save=True,
+            )
+        checkpointing.wait_for_checkpoint()
+        root = tmp_path / "checkpoints"
+        assert sorted(os.listdir(root)) == ["checkpoint_1", "checkpoint_2"]
+        assert all(
+            resilience.is_committed(str(root / n)) for n in os.listdir(root)
+        )
+        assert resilience.verify_checkpoint(str(root / "checkpoint_2")) == []
+
+
+# ================================================================ async saver
+class TestAsyncSaverErrors:
+    def test_failure_logged_immediately_then_reraised_on_wait(self, caplog):
+        saver = checkpointing._AsyncSaver()
+
+        def boom():
+            raise RuntimeError("disk full")
+
+        with caplog.at_level(logging.ERROR, logger="accelerate_tpu.checkpointing"):
+            saver.submit(boom)
+            saver._thread.join()
+        assert any(
+            "async checkpoint save failed" in r.message for r in caplog.records
+        )
+        with pytest.raises(RuntimeError, match="disk full"):
+            saver.wait()
+
+    def test_atexit_hook_joins_and_swallows(self, caplog):
+        """The registered atexit hook must drain the in-flight save and log
+        (not raise) so a clean interpreter exit never truncates it."""
+        checkpointing._ASYNC_SAVER.submit(
+            lambda: (_ for _ in ()).throw(RuntimeError("late failure"))
+        )
+        with caplog.at_level(logging.ERROR, logger="accelerate_tpu.checkpointing"):
+            checkpointing._wait_for_checkpoint_at_exit()  # must not raise
+        assert any("interpreter exit" in r.message for r in caplog.records)
+        checkpointing.wait_for_checkpoint()  # drained: no error left behind
+
+
+# ================================================================= preemption
+class TestPreemption:
+    def test_sigterm_sets_flag(self):
+        from accelerate_tpu.resilience import preemption as pmod
+
+        try:
+            assert pmod.install_preemption_handler()
+            assert pmod.install_preemption_handler()  # idempotent
+            pmod.clear_preemption()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 2.0
+            while not pmod.preemption_requested() and time.time() < deadline:
+                time.sleep(0.01)
+            assert pmod.preemption_requested()
+        finally:
+            pmod._reset_for_tests()
+
+    def test_step_helper_writes_emergency_checkpoint_and_exits_75(self, tmp_path):
+        acc = _auto_acc(tmp_path)
+        state = acc.create_train_state({"w": jnp.arange(8.0)}, optax.adam(1e-2))
+        step = acc.make_train_step(lambda p, b, r: jnp.sum(p["w"] ** 2) * b["s"])
+        batch = {"s": jnp.float32(1.0)}
+        state, _ = step(state, batch)
+        resilience.request_preemption()
+        with pytest.raises(SystemExit) as e:
+            step(state, batch)
+        assert e.value.code == resilience.PREEMPTION_EXIT_CODE == 75
+        latest = resilience.latest_committed(str(tmp_path / "checkpoints"))
+        assert latest is not None
+        assert resilience.verify_checkpoint(latest) == []
+        resilience.clear_preemption()
+        restored = acc.load_state(
+            None,
+            acc.create_train_state({"w": jnp.zeros(8)}, optax.adam(1e-2)),
+            resume="latest",
+        )
+        assert int(jax.device_get(restored.step)) == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+        )
+
+    def test_without_automatic_naming_flag_is_left_for_the_loop(self):
+        acc = atx.Accelerator(seed=0)
+        state = acc.create_train_state({"w": jnp.arange(4.0)}, optax.sgd(0.1))
+        step = acc.make_train_step(lambda p, b, r: jnp.sum(p["w"] ** 2))
+        resilience.request_preemption()
+        state, _ = step(state, {})  # no SystemExit: the loop owns the policy
+        assert acc.preemption_requested()
+
+
+# =================================================================== watchdog
+class TestWatchdog:
+    def test_fires_dumps_stacks_and_aborts(self):
+        out = io.StringIO()
+        fired = []
+        wd = Watchdog(0.2, out=out, abort=lambda: fired.append(True))
+        try:
+            wd.arm()
+            assert wd.fired.wait(timeout=5.0)
+            assert fired
+            text = out.getvalue()
+            assert "exceeded its" in text and "MainThread" in text
+            assert str(resilience.WATCHDOG_EXIT_CODE) in text
+        finally:
+            wd.stop()
+
+    def test_disarm_prevents_firing(self):
+        wd = Watchdog(0.2, abort=lambda: None)
+        try:
+            wd.arm()
+            wd.disarm()
+            time.sleep(0.7)
+            assert not wd.fired.is_set()
+        finally:
+            wd.stop()
+
+    def test_first_arm_gets_compile_headroom(self):
+        out = io.StringIO()
+        wd = Watchdog(0.2, first_deadline_secs=10.0, out=out, abort=lambda: None)
+        try:
+            wd.arm()  # first arm: 10s deadline absorbs "compilation"
+            time.sleep(0.6)
+            assert not wd.fired.is_set()
+            wd.disarm()
+            wd.arm()  # steady state: 0.2s deadline
+            assert wd.fired.wait(timeout=5.0)
+        finally:
+            wd.stop()
+
+    def test_watchdog_from_env(self, monkeypatch):
+        import accelerate_tpu.resilience.watchdog as wmod
+
+        monkeypatch.delenv("ATX_WATCHDOG_SECS", raising=False)
+        assert wmod.watchdog_from_env() is None
+        monkeypatch.setenv("ATX_WATCHDOG_SECS", "120")
+        wd = wmod.watchdog_from_env()
+        assert wd is not None and wd.deadline == 120.0
+        assert wd.first_deadline == 1200.0
+        assert wmod.watchdog_from_env() is wd  # one instance per deadline
+
+
+# ======================================================= coordinator backoff
+class TestCoordInitBackoff:
+    def test_retries_with_growing_jittered_backoff(self, monkeypatch):
+        import accelerate_tpu.state as smod
+
+        calls, sleeps = [], []
+
+        def flaky_init(**kwargs):
+            calls.append(dict(kwargs))
+            if len(calls) < 3:
+                raise RuntimeError("coordination service heartbeat timeout")
+
+        monkeypatch.setattr(smod.jax.distributed, "initialize", flaky_init)
+        monkeypatch.setattr(smod._time, "sleep", lambda s: sleeps.append(s))
+        monkeypatch.setenv("ATX_COORD_INIT_RETRIES", "5")
+        monkeypatch.setenv("ATX_COORD_TIMEOUT_SECS", "7")
+        smod._initialize_distributed_with_retries(
+            coordinator_address="127.0.0.1:1", num_processes=2, process_id=0
+        )
+        assert len(calls) == 3
+        assert all(c["initialization_timeout"] == 7 for c in calls)
+        assert len(sleeps) == 2
+        assert 1.0 <= sleeps[0] < 2.0 and 2.0 <= sleeps[1] < 4.0  # 2x + jitter
+
+    def test_budget_exhausted_reraises(self, monkeypatch):
+        import accelerate_tpu.state as smod
+
+        calls = []
+
+        def dead_init(**kwargs):
+            calls.append(1)
+            raise RuntimeError("no coordinator")
+
+        monkeypatch.setattr(smod.jax.distributed, "initialize", dead_init)
+        monkeypatch.setattr(smod._time, "sleep", lambda s: None)
+        monkeypatch.setenv("ATX_COORD_INIT_RETRIES", "2")
+        with pytest.raises(RuntimeError, match="no coordinator"):
+            smod._initialize_distributed_with_retries(
+                coordinator_address="127.0.0.1:1", num_processes=2
+            )
+        assert len(calls) == 3  # 1 try + 2 retries
+
+    def test_timeout_kwarg_dropped_on_older_jax(self, monkeypatch):
+        import accelerate_tpu.state as smod
+
+        calls = []
+
+        def old_jax_init(**kwargs):
+            calls.append(dict(kwargs))
+            if "initialization_timeout" in kwargs:
+                raise TypeError("unexpected keyword argument")
+
+        monkeypatch.setattr(smod.jax.distributed, "initialize", old_jax_init)
+        monkeypatch.setenv("ATX_COORD_TIMEOUT_SECS", "5")
+        smod._initialize_distributed_with_retries(
+            coordinator_address="127.0.0.1:1", num_processes=2
+        )
+        assert len(calls) == 2
+        assert "initialization_timeout" not in calls[1]
+
+
+# ============================================================== subprocesses
+class TestKillDuringSave:
+    @pytest.mark.parametrize(
+        "point", ["save.files_written", "save.manifest_written", "commit.before_marker"]
+    )
+    def test_kill9_mid_save_previous_checkpoint_survives(self, tmp_path, point):
+        """total_limit=1 + kill -9 mid-second-save: the FIRST checkpoint
+        must still be committed and loadable (the old rotation deleted it
+        before the new save was durable, losing both)."""
+        r = _run_script("resilience_ckpt_crash.py", str(tmp_path), point)
+        assert r.returncode == faults.KILL_EXIT_CODE == 137, (r.stdout, r.stderr)
+        assert "first checkpoint committed" in r.stdout
+        root = str(tmp_path / "checkpoints")
+        committed = resilience.committed_checkpoints(root)
+        assert [n for n, _ in committed] == [0]
+
+        acc = atx.Accelerator(seed=0)
+        target = acc.create_train_state({"w": jnp.zeros(16)}, optax.sgd(0.1))
+        restored = acc.load_state(root, target, resume="latest")
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(16.0))
+        assert int(jax.device_get(restored.step)) == 0
+
+
+def test_sigterm_emergency_checkpoint_and_bitidentical_resume(tmp_path):
+    """SIGTERM mid-training → emergency checkpoint + exit 75; the resumed
+    run's loss trajectory must be BIT-identical to an uninterrupted run of
+    the same total steps."""
+    base_loss = str(tmp_path / "baseline.losses")
+    r = _run_script(
+        "resilience_train.py",
+        "--project_dir", str(tmp_path / "baseline"),
+        "--steps", "6",
+        "--loss_file", base_loss,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    run_loss = str(tmp_path / "run.losses")
+    interrupted = _run_script(
+        "resilience_train.py",
+        "--project_dir", str(tmp_path / "run"),
+        "--steps", "6",
+        "--loss_file", run_loss,
+        "--sigterm_at", "3",
+    )
+    assert interrupted.returncode == resilience.PREEMPTION_EXIT_CODE, (
+        interrupted.stdout,
+        interrupted.stderr,
+    )
+    assert "emergency checkpoint committed" in interrupted.stderr
+    latest = resilience.latest_committed(str(tmp_path / "run" / "checkpoints"))
+    assert latest is not None and resilience.verify_checkpoint(latest) == []
+
+    resumed = _run_script(
+        "resilience_train.py",
+        "--project_dir", str(tmp_path / "run"),
+        "--steps", "6",
+        "--loss_file", run_loss,
+        "--resume",
+    )
+    assert resumed.returncode == 0, (resumed.stdout, resumed.stderr)
+    assert "resumed at step 3" in resumed.stdout
+
+    with open(base_loss) as f:
+        baseline = f.read().splitlines()
+    with open(run_loss) as f:
+        spliced = f.read().splitlines()
+    assert len(baseline) == 6
+    assert spliced == baseline  # bit-identical: same hex floats per step
+
+
+def test_watchdog_aborts_wedged_step_with_stack_dump(tmp_path):
+    env = _child_env(
+        {"ATX_WATCHDOG_SECS": "2", "ATX_WATCHDOG_FIRST_STEP_SECS": "120"}
+    )
+    r = _run_script(
+        "resilience_train.py",
+        "--project_dir", str(tmp_path),
+        "--steps", "4",
+        "--loss_file", str(tmp_path / "l"),
+        "--wedge_at", "2",
+        env=env,
+    )
+    assert r.returncode == resilience.WATCHDOG_EXIT_CODE == 114, (r.stdout, r.stderr)
+    assert "atx watchdog" in r.stderr
+    assert "MainThread" in r.stderr  # the wedged thread's stack was dumped
+    assert "WEDGED STEP RETURNED" not in r.stdout
+
+
+def test_disk_offload_sentinel_kill_refuses_resume(tmp_path):
+    """Satellite for the PR-1 dirty sentinel: kill -9 between the sentinel
+    write and the moment flush; resume over the dir must refuse with the
+    recovery options spelled out."""
+    d = str(tmp_path / "moments")
+    r = _run_script("resilience_disk_crash.py", d)
+    assert r.returncode == faults.KILL_EXIT_CODE, (r.stdout, r.stderr)
+    assert "healthy step done" in r.stdout
+    assert os.path.exists(os.path.join(d, "dirty.json"))
+    with pytest.raises(ValueError) as e:
+        atx.disk_offloaded_adamw(1e-2, offload_dir=d)
+    msg = str(e.value)
+    assert "dirty sentinel" in msg
+    assert "fresh directory" in msg and "restore a full checkpoint" in msg
+
+
+def test_launcher_resumes_preempted_group_without_burning_restarts(tmp_path):
+    """Exit-code contract: a worker group dying with PREEMPTION_EXIT_CODE is
+    relaunched even with --max_restarts 0, and the resume is logged as not
+    counted."""
+    marker = str(tmp_path / "preempted_once")
+    script = os.path.join(SCRIPTS, "exit_preempted_once.py")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+            "--num_processes", "2", "--max_restarts", "0",
+            "--mixed_precision", "no", script, marker,
+        ],
+        cwd=REPO_ROOT,
+        env=_child_env(),
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "PREEMPTING" in r.stdout
+    assert "not counted against --max_restarts" in r.stderr
+    for rank in range(2):
+        assert f"[proc {rank}] RESUMED OK" in r.stdout, r.stdout
+    assert os.path.exists(marker)
