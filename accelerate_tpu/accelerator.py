@@ -252,6 +252,7 @@ class Accelerator:
 
             resilience.install_preemption_handler()
         self._preemption_exit_started = False
+        self._preemption_sync_calls = 0
         self._flag_tensor: jax.Array | None = None
         self._checkpoint_registry: list[Any] = []
         self._param_specs: Any = None
@@ -1042,6 +1043,10 @@ class Accelerator:
             # input state is exactly the last completed step's output (whose
             # metrics the caller already has), so the emergency checkpoint
             # loses nothing and the resumed trajectory is bit-identical.
+            # Multi-process, this is a COLLECTIVE (flag or-reduce): every
+            # process participates every entry so the group agrees on the
+            # exit step — one process acting on its local flag alone would
+            # barrier against peers still in training-step collectives.
             self._maybe_emergency_exit(state)
             # Hang watchdog (ATX_WATCHDOG_SECS): heartbeat semantics — each
             # step ENTRY re-arms the countdown and it stays armed across the
@@ -1237,17 +1242,55 @@ class Accelerator:
 
         return resilience.preemption_requested()
 
-    def _maybe_emergency_exit(self, state: "TrainState") -> None:
-        """The step helper's automatic preemption hook: on a pending
-        preemption notice, write a committed emergency checkpoint and raise
-        ``SystemExit(PREEMPTION_EXIT_CODE)`` — the exit code the elastic
-        loop in `commands/launch.py` resumes immediately without burning a
-        ``--max_restarts`` attempt. Only fires under
-        ``automatic_checkpoint_naming`` (otherwise there is no agreed place
-        to save; the loop polls `preemption_requested` itself)."""
+    def _preemption_agreed(self) -> bool:
+        """Cross-process agreement on the preemption flag (the orbax-style
+        multihost preemption sync). SIGTERM delivery and Python signal
+        dispatch skew across hosts: acting on the LOCAL flag alone lets one
+        process enter the collective emergency save while peers are still
+        issuing training-step collectives (mismatched collectives → hang
+        until the watchdog/KILL, emergency checkpoint lost), or lets
+        processes enter one step apart and commit shards mixing step N and
+        N+1. Every process or-reduces its flag at the same step entries, so
+        all agree on the exit step before any of them starts the save.
+
+        ``ATX_PREEMPTION_SYNC_STEPS=N`` (default 1) syncs every N entries —
+        raising it trades up to N-1 steps of notice-to-checkpoint latency
+        for fewer per-step host round-trips."""
         from . import resilience
 
-        if not resilience.preemption_requested():
+        if self.num_processes == 1:
+            return resilience.preemption_requested()
+        from .utils.environment import get_int_from_env
+
+        self._preemption_sync_calls += 1
+        interval = max(1, get_int_from_env(("ATX_PREEMPTION_SYNC_STEPS",), 1))
+        if self._preemption_sync_calls % interval:
+            return False
+        local = resilience.preemption_requested()
+        total = _ops.reduce({"flag": np.asarray(int(local), np.int32)}, "sum")["flag"]
+        if int(total) == 0:
+            return False
+        if not local:
+            # Adopt the peers' notice so local polls (`preemption_requested`)
+            # and the second-SIGTERM escalation see consistent state.
+            resilience.request_preemption()
+        return True
+
+    def _maybe_emergency_exit(self, state: "TrainState") -> None:
+        """The step helper's automatic preemption hook: once ALL processes
+        agree a preemption notice is pending (`_preemption_agreed` — the
+        collective runs at every step entry so the whole group exits at the
+        same step), write a committed emergency checkpoint and raise
+        ``SystemExit(PREEMPTION_EXIT_CODE)`` — the exit code the elastic
+        loop in `commands/launch.py` resumes immediately without burning a
+        ``--max_restarts`` attempt. The save only fires under
+        ``automatic_checkpoint_naming`` (otherwise there is no agreed place
+        to save; the loop polls `preemption_requested` itself — by the time
+        the agreement collective returns True, the flag is set on every
+        process, so such loops also act at one common step boundary)."""
+        from . import resilience
+
+        if not self._preemption_agreed():
             return
         if not self.project_config.automatic_checkpoint_naming:
             return

@@ -34,6 +34,7 @@ TPU-native redesign of the reference checkpoint stack (`accelerator.py:3106`
 from __future__ import annotations
 
 import atexit
+import contextlib
 import json
 import logging
 import os
@@ -563,6 +564,24 @@ def _wait_for_checkpoint_at_exit() -> None:
 atexit.register(_wait_for_checkpoint_at_exit)
 
 
+@contextlib.contextmanager
+def _watchdog_paused():
+    """Suspend the hang watchdog (ATX_WATCHDOG_SECS) across the blocking
+    save/load work. A routine synchronous checkpoint between steps
+    legitimately exceeds a per-step deadline; without this the watchdog
+    would dump stacks and abort mid-commit — a false positive that loses
+    the in-flight checkpoint and burns a --max_restarts attempt. The
+    countdown restarts on exit iff it was armed (heartbeat semantics)."""
+    from .resilience.watchdog import watchdog_from_env
+
+    wd = watchdog_from_env()
+    if wd is None:
+        yield
+        return
+    with wd.paused():
+        yield
+
+
 # ---------------------------------------------------------------- entry points
 def save_state(
     accelerator: "Accelerator",
@@ -582,7 +601,28 @@ def save_state(
     (`resilience/commit.py`). Rotation deletes old checkpoints strictly
     AFTER the new commit lands. The async path runs the same
     write → manifest → commit sequence from the background thread.
+
+    The hang watchdog is paused for the duration (`_watchdog_paused`): a
+    between-steps save is legitimate long host work, not a wedged step.
     """
+    with _watchdog_paused():
+        return _save_state_impl(
+            accelerator,
+            output_dir,
+            state,
+            dataloaders=dataloaders,
+            async_save=async_save,
+        )
+
+
+def _save_state_impl(
+    accelerator: "Accelerator",
+    output_dir: str | None,
+    state: "TrainState",
+    *,
+    dataloaders: Iterable[Any] | None = None,
+    async_save: bool = False,
+) -> str:
     # Join any in-flight async save first: a new save (or its rotation) must
     # never touch a directory a background writer is still filling. The
     # local join is not enough on multi-host — barrier after every host has
@@ -663,7 +703,10 @@ def save_state(
             os.path.join(MODEL_DIR, SHARDS_FILE.format(proc=proc)),
             os.path.join(MODEL_DIR, INDEX_FILE.format(proc=proc)),
         ]
-        _commit.write_manifest(tmp_dir, proc, files)
+        # The manifest records this process's step: verify_checkpoint
+        # rejects a checkpoint whose shards mix steps (processes entering
+        # save_state one step apart would otherwise commit garbage).
+        _commit.write_manifest(tmp_dir, proc, files, step=step_value)
         _fault_point("save.manifest_written")
 
     if async_save:
@@ -711,7 +754,10 @@ def _barrier_and_commit(
     nproc = jax.process_count()
     meta = {"step": step_value, "num_processes": nproc}
     if accelerator.project_config.save_on_each_node:
-        _commit.commit_dir(tmp_dir, final_dir, meta)
+        # Each node commits its own local directory carrying ONE manifest;
+        # flag it so verify_checkpoint's completeness check (manifest count
+        # vs num_processes) knows not to demand all of them here.
+        _commit.commit_dir(tmp_dir, final_dir, {**meta, "save_on_each_node": True})
         _rotate_after_commit(accelerator, final_dir)
         return
     if nproc > 1:
@@ -781,7 +827,24 @@ def load_state(
     carries a manifest; corruption raises (the caller named THIS
     checkpoint, silently substituting another would be worse). Pre-manifest
     legacy checkpoints load as before.
+
+    Like `save_state`, the hang watchdog is paused for the duration — a
+    restore (verification hashes every shard) is legitimate long host work.
     """
+    with _watchdog_paused():
+        return _load_state_impl(
+            accelerator, input_dir, state, dataloaders=dataloaders, resume=resume
+        )
+
+
+def _load_state_impl(
+    accelerator: "Accelerator",
+    input_dir: str | None,
+    state: "TrainState",
+    *,
+    dataloaders: Iterable[Any] | None = None,
+    resume: str | None = None,
+) -> "TrainState":
     wait_for_checkpoint()
     if resume is not None:
         if resume != "latest":

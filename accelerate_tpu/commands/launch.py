@@ -373,7 +373,19 @@ def _tpu_pod_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     (reference `tpu_pod_launcher`, `commands/launch.py:909`). A nonzero pod
     run is retried up to ``max_restarts`` times (same elastic policy as the
     local group path; the pod re-rendezvouses through TPU metadata, so no
-    port rotation is needed)."""
+    port rotation is needed).
+
+    Exit-code caveat (docs/fault_tolerance.md §exit-code contract): the
+    preemption fast-path below relies on ``gcloud ... ssh --worker=all``
+    surfacing the remote training process's exit status, and with multiple
+    workers gcloud's SSH fan-out does NOT reliably propagate a specific
+    worker's code. A real pod preemption may therefore be classified as an
+    ordinary failure and consume a ``--max_restarts`` attempt instead of
+    taking the free-resume path. This is safe — the emergency checkpoint
+    was committed before the workers exited, and the ordinary restart
+    resumes from it via ``load_state(resume="latest")`` — but budget
+    ``--max_restarts`` with headroom on preemptible pods. (The local
+    worker-group path reaps each child directly and is not affected.)"""
     env_exports = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in build_child_env(cfg, None, base={}).items()

@@ -71,19 +71,30 @@ def file_sha256(path: str, chunk_bytes: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
-def write_manifest(directory: str, proc: int, files: list[str]) -> str:
+def write_manifest(
+    directory: str, proc: int, files: list[str], *, step: int | None = None
+) -> str:
     """Hash ``files`` (paths relative to ``directory``) into
     ``manifest_<proc>.json``. Called after every listed file is fully
     written; the manifest itself is replaced atomically so a crash mid-write
-    can never leave a parseable-but-partial manifest."""
+    can never leave a parseable-but-partial manifest.
+
+    ``step`` records the training step THIS process wrote, so
+    `verify_checkpoint` can reject a checkpoint whose shards mix steps —
+    processes that entered save_state one step apart (preemption-notice
+    skew on a pod) would otherwise commit a consistent-looking directory
+    that resumes on inconsistent state."""
     entries: dict[str, Any] = {}
     for rel in files:
         path = os.path.join(directory, rel)
         entries[rel] = {"sha256": file_sha256(path), "size": os.path.getsize(path)}
+    payload: dict[str, Any] = {"version": 1, "process": proc, "files": entries}
+    if step is not None:
+        payload["step"] = int(step)
     out = os.path.join(directory, MANIFEST_FILE.format(proc=proc))
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"version": 1, "process": proc, "files": entries}, f)
+        json.dump(payload, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, out)
@@ -101,7 +112,18 @@ def _manifest_paths(directory: str) -> list[str]:
 
 
 def verify_checkpoint(directory: str) -> list[str]:
-    """Check every manifest-listed file's existence, size, and SHA-256.
+    """Check every manifest-listed file's existence, size, and SHA-256 —
+    plus two cross-process invariants on committed checkpoints:
+
+    - **completeness**: the ``COMMIT`` marker records how many processes
+      wrote the checkpoint; losing an entire process's files (manifest +
+      shards deleted together) must not verify clean, or resume="latest"
+      would pick the amputated checkpoint over the previous good one.
+      (``save_on_each_node`` directories are per-node by design — one
+      manifest each — and are exempt.)
+    - **step agreement**: every manifest (and the marker) must record the
+      same training step; shards mixing step N and N+1 would pass per-file
+      hashing but resume on inconsistent state.
 
     Returns a list of human-readable errors (empty = verified). A directory
     with no manifest and no ``COMMIT`` marker is treated as a pre-manifest
@@ -110,12 +132,27 @@ def verify_checkpoint(directory: str) -> list[str]:
     """
     if not os.path.isdir(directory):
         return [f"{directory} is not a directory"]
+    marker: dict[str, Any] = {}
+    if is_committed(directory):
+        try:
+            marker = read_commit_marker(directory)
+        except (ValueError, OSError) as e:
+            return [f"unreadable {COMMIT_MARKER} marker: {e}"]
     manifests = _manifest_paths(directory)
     if not manifests:
         if is_committed(directory):
             return [f"committed checkpoint {directory} has no manifest files"]
         return []
     errors: list[str] = []
+    recorded_procs = marker.get("num_processes")
+    if recorded_procs is not None and not marker.get("save_on_each_node"):
+        if len(manifests) != int(recorded_procs):
+            errors.append(
+                f"manifest count mismatch: {len(manifests)} manifest file(s) "
+                f"on disk but the {COMMIT_MARKER} marker records "
+                f"{recorded_procs} writer process(es)"
+            )
+    steps: dict[int, list[str]] = {}
     for mpath in manifests:
         try:
             with open(mpath) as f:
@@ -124,6 +161,10 @@ def verify_checkpoint(directory: str) -> list[str]:
         except (ValueError, KeyError) as e:
             errors.append(f"unreadable manifest {os.path.basename(mpath)}: {e}")
             continue
+        if manifest.get("step") is not None:
+            steps.setdefault(int(manifest["step"]), []).append(
+                os.path.basename(mpath)
+            )
         for rel, info in entries.items():
             path = os.path.join(directory, rel)
             if not os.path.exists(path):
@@ -138,6 +179,19 @@ def verify_checkpoint(directory: str) -> list[str]:
                 continue
             if file_sha256(path) != info["sha256"]:
                 errors.append(f"sha256 mismatch for {rel}")
+    if len(steps) > 1:
+        errors.append(
+            "cross-process step mismatch: "
+            + "; ".join(
+                f"step {s} in {', '.join(names)}" for s, names in sorted(steps.items())
+            )
+        )
+    marker_step = marker.get("step")
+    if marker_step is not None and steps and set(steps) != {int(marker_step)}:
+        errors.append(
+            f"manifest step(s) {sorted(steps)} disagree with the "
+            f"{COMMIT_MARKER} marker's step {marker_step}"
+        )
     return errors
 
 

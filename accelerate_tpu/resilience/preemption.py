@@ -19,10 +19,13 @@ that dies with it is resumed immediately WITHOUT burning a
 ``--max_restarts`` attempt — the checkpoint is known-good, so the restart
 is not a failure.
 
-A second SIGTERM while the flag is already set restores the default
+A second SIGTERM while the flag is already set forces the DEFAULT
 disposition and re-delivers the signal, so an impatient supervisor (or the
 launcher's own group teardown) can still terminate a process that never
-reaches a step boundary.
+reaches a step boundary. Explicitly ``SIG_DFL`` — not the pre-install
+disposition: a process that started with SIGTERM ignored (``SIG_IGN``)
+would otherwise re-deliver the second TERM into an ignoring handler and
+never die.
 """
 
 from __future__ import annotations
@@ -63,10 +66,13 @@ def install_preemption_handler(
 
 def _handler(signum: int, frame) -> None:
     if _flag.is_set():
-        # Second notice: the escalation path. Restore the default disposition
+        # Second notice: the escalation path. Force the DEFAULT disposition
         # and re-deliver so the process actually dies (the launcher's
-        # teardown, or a supervisor that ran out of patience).
-        signal.signal(signum, _installed_signals.get(signum) or signal.SIG_DFL)
+        # teardown, or a supervisor that ran out of patience). Never restore
+        # the pre-install disposition here: SIG_IGN (truthy) would swallow
+        # the re-delivery and the process would only die at the launcher's
+        # SIGKILL escalation — or hang forever under supervisors without one.
+        signal.signal(signum, signal.SIG_DFL)
         os.kill(os.getpid(), signum)
         return
     _flag.set()

@@ -33,12 +33,13 @@ Direct use for custom loops::
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 import threading
 import time
 import traceback
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
 
 WATCHDOG_EXIT_CODE = 114
 
@@ -116,6 +117,24 @@ class Watchdog:
     def disarm(self) -> None:
         with self._lock:
             self._armed_at = None
+
+    @contextlib.contextmanager
+    def paused(self) -> Iterator[None]:
+        """Suspend the deadline across legitimate long host work — a
+        synchronous ``save_state``/``load_state`` between steps routinely
+        exceeds a per-step deadline, and shooting the process mid-commit
+        would lose the in-flight checkpoint AND burn a restart attempt.
+        On exit the countdown restarts (heartbeat semantics) iff it was
+        armed on entry; pausing an unarmed watchdog never arms it."""
+        with self._lock:
+            was_armed = self._armed_at is not None
+            self._armed_at = None
+        try:
+            yield
+        finally:
+            if was_armed:
+                with self._lock:
+                    self._armed_at = time.monotonic()
 
     def stop(self) -> None:
         """Shut the heartbeat thread down (tests / end of training)."""

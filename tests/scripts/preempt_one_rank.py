@@ -1,0 +1,81 @@
+"""Multi-host preemption-agreement driver (tests/test_resilience.py).
+
+Only rank 0 receives the preemption notice mid-training — the exact
+delivery-skew scenario on a pod. The step-entry agreement collective must
+spread it: BOTH ranks have to exit with PREEMPTION_EXIT_CODE at the SAME
+step, committing one emergency checkpoint that carries every process's
+manifest at one common step. On the elastic relaunch every rank verifies
+that invariant, resumes, and finishes.
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+TOTAL_STEPS = 4
+PREEMPT_AT = 2
+
+
+def main() -> None:
+    project_dir = sys.argv[1]
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu import resilience
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = atx.Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True
+        ),
+        seed=0,
+    )
+    # init_fn (not a concrete host tree): params materialize inside jit in
+    # their target sharding — a host-array device_put onto a process-
+    # spanning sharding is not supported by the CPU gloo backend.
+    state = acc.create_train_state(
+        lambda rng: {"w": jnp.arange(8.0)}, optax.sgd(0.1)
+    )
+    step = acc.make_train_step(lambda p, b, r: jnp.sum(p["w"] ** 2))
+    rank = acc.process_index
+
+    ckpt_root = os.path.join(project_dir, "checkpoints")
+    if resilience.latest_committed(ckpt_root) is not None:
+        # Second (resumed) run: the emergency checkpoint must be whole and
+        # single-step — every process's manifest, all at the preempt step.
+        state = acc.load_state(None, state, resume="latest")
+        start = int(jax.device_get(state.step))
+        latest = resilience.latest_committed(ckpt_root)
+        errors = resilience.verify_checkpoint(latest)
+        assert errors == [], errors
+        manifests = sorted(glob.glob(os.path.join(latest, "manifest_*.json")))
+        assert len(manifests) == acc.num_processes, manifests
+        steps = set()
+        for m in manifests:
+            with open(m) as f:
+                steps.add(json.load(f).get("step"))
+        assert steps == {start}, (steps, start)
+        print(f"[proc {rank}] RESUMED CONSISTENT step={start}", flush=True)
+        for i in range(start, TOTAL_STEPS):
+            state, _ = step(state, {})
+        acc.end_training()
+        print(f"[proc {rank}] DONE", flush=True)
+        return
+
+    for i in range(TOTAL_STEPS):
+        if i == PREEMPT_AT and rank == 0:
+            # ONLY rank 0 is notified; the agreement collective at the next
+            # step entry must turn this into a group-wide exit.
+            resilience.request_preemption()
+        state, _ = step(state, {})
+    print(f"[proc {rank}] NEVER PREEMPTED", flush=True)
+    sys.exit(3)
+
+
+main()

@@ -39,7 +39,7 @@ from accelerate_tpu.resilience.watchdog import Watchdog
 from accelerate_tpu.test_utils import faults
 from accelerate_tpu.utils.dataclasses import ProjectConfiguration
 
-from tests.launch_helpers import REPO_ROOT, clean_env
+from tests.launch_helpers import REPO_ROOT, clean_env, launch
 
 SCRIPTS = os.path.join(REPO_ROOT, "tests", "scripts")
 
@@ -143,6 +143,63 @@ class TestCommitPrimitives:
         # renamed but uncommitted: invisible to discovery
         assert os.path.isdir(final) and not commit_mod.is_committed(final)
         assert commit_mod.committed_checkpoints(str(tmp_path)) == []
+
+    def _committed_two_proc(self, tmp_path, meta, *, steps=(3, 3)):
+        tmp = str(tmp_path / "checkpoint_0.tmp")
+        final = str(tmp_path / "checkpoint_0")
+        os.makedirs(tmp)
+        for proc, step in enumerate(steps):
+            fname = f"shards_{proc}.bin"
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(os.urandom(64))
+            commit_mod.write_manifest(tmp, proc, [fname], step=step)
+        commit_mod.commit_dir(tmp, final, meta)
+        return final
+
+    def test_verify_rejects_missing_process_manifest(self, tmp_path):
+        """Completeness: deleting an entire process's manifest + shard pair
+        from a committed multi-process checkpoint must NOT verify clean
+        (resume would pick the amputated checkpoint over the previous good
+        one and load partial state)."""
+        final = self._committed_two_proc(
+            tmp_path, {"step": 3, "num_processes": 2}
+        )
+        assert commit_mod.verify_checkpoint(final) == []
+        os.remove(os.path.join(final, "manifest_1.json"))
+        os.remove(os.path.join(final, "shards_1.bin"))
+        errors = commit_mod.verify_checkpoint(final)
+        assert any("manifest count mismatch" in e for e in errors), errors
+
+    def test_verify_save_on_each_node_exempt_from_completeness(self, tmp_path):
+        """save_on_each_node commits one per-node directory per process —
+        a single manifest against num_processes=2 is by design, not loss."""
+        tmp = str(tmp_path / "checkpoint_0.tmp")
+        final = str(tmp_path / "checkpoint_0")
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "shards_1.bin"), "wb") as f:
+            f.write(os.urandom(64))
+        commit_mod.write_manifest(tmp, 1, ["shards_1.bin"], step=3)
+        commit_mod.commit_dir(
+            tmp, final, {"step": 3, "num_processes": 2, "save_on_each_node": True}
+        )
+        assert commit_mod.verify_checkpoint(final) == []
+
+    def test_verify_rejects_cross_process_step_mismatch(self, tmp_path):
+        """Manifests recording different steps = shards from different
+        steps in one directory; per-file hashes all pass, the checkpoint
+        must still be rejected."""
+        final = self._committed_two_proc(
+            tmp_path, {"step": 3, "num_processes": 2}, steps=(3, 4)
+        )
+        errors = commit_mod.verify_checkpoint(final)
+        assert any("cross-process step mismatch" in e for e in errors), errors
+
+    def test_verify_rejects_marker_step_disagreement(self, tmp_path):
+        final = self._committed_two_proc(
+            tmp_path, {"step": 7, "num_processes": 2}, steps=(3, 3)
+        )
+        errors = commit_mod.verify_checkpoint(final)
+        assert any("marker's step 7" in e for e in errors), errors
 
     def test_precommit_file_barrier(self, tmp_path):
         d = str(tmp_path)
@@ -353,6 +410,93 @@ class TestPreemption:
             np.asarray(restored.params["w"]), np.asarray(state.params["w"])
         )
 
+    def test_agreement_collective_spreads_peer_notice(self, tmp_path, monkeypatch):
+        """The step-entry hook must act on the GROUP's or-reduced flag, not
+        the local one (REVIEW high: signal-delivery skew on pods). Simulated
+        2-process world: the or-reduce runs at every step entry, a
+        peer-only notice triggers the emergency exit here, and the local
+        flag is adopted so polls/escalation see consistent state."""
+        import accelerate_tpu.accelerator as amod
+
+        acc = _auto_acc(tmp_path)
+        state = _w_state(acc)
+        step = acc.make_train_step(lambda p, b, r: jnp.sum(p["w"] ** 2))
+        state, _ = step(state, {})  # compile before patching the world
+
+        monkeypatch.setattr(type(acc), "num_processes", property(lambda self: 2))
+        calls, peer_flag = [], {"v": 0}
+
+        def fake_or_reduce(tree, reduction="sum"):
+            local = int(np.asarray(tree["flag"]))
+            calls.append(local)
+            return {"flag": np.int32(local + peer_flag["v"])}
+
+        monkeypatch.setattr(amod._ops, "reduce", fake_or_reduce)
+        state, _ = step(state, {})  # no notice anywhere: collective ran, no exit
+        assert calls == [0]
+        peer_flag["v"] = 1  # the PEER was notified; this process never was
+        with pytest.raises(SystemExit) as e:
+            step(state, {})
+        assert e.value.code == resilience.PREEMPTION_EXIT_CODE
+        assert calls == [0, 0]  # the local flag was still unset when reduced
+        assert resilience.preemption_requested()  # adopted from the peer
+        latest = resilience.latest_committed(str(tmp_path / "checkpoints"))
+        assert latest is not None and resilience.verify_checkpoint(latest) == []
+
+    def test_agreement_sync_interval_knob(self, tmp_path, monkeypatch):
+        """ATX_PREEMPTION_SYNC_STEPS=N runs the or-reduce only every Nth
+        step entry (all processes share the entry count, so they still
+        sync at the same steps)."""
+        import accelerate_tpu.accelerator as amod
+
+        acc = _auto_acc(tmp_path)
+        state = _w_state(acc)
+        step = acc.make_train_step(lambda p, b, r: jnp.sum(p["w"] ** 2))
+        state, _ = step(state, {})
+
+        monkeypatch.setattr(type(acc), "num_processes", property(lambda self: 2))
+        monkeypatch.setenv("ATX_PREEMPTION_SYNC_STEPS", "3")
+        calls = []
+
+        def fake_reduce(tree, reduction="sum"):
+            calls.append(int(np.asarray(tree["flag"])))
+            return {"flag": np.int32(0)}
+
+        monkeypatch.setattr(amod._ops, "reduce", fake_reduce)
+        for _ in range(6):
+            state, _ = step(state, {})
+        assert len(calls) == 2  # entries 3 and 6 only
+
+    def test_second_sigterm_kills_even_with_sig_ign_history(self):
+        """Escalation: a process that started with SIGTERM *ignored*
+        (SIG_IGN) must still die on the second notice — restoring the
+        pre-install disposition would re-deliver TERM into an ignoring
+        handler, leaving the process unkillable until SIGKILL."""
+        code = (
+            "import os, signal, sys, time\n"
+            "from accelerate_tpu.resilience import preemption\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "assert preemption.install_preemption_handler()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "deadline = time.time() + 5\n"
+            "while not preemption.preemption_requested() and time.time() < deadline:\n"
+            "    time.sleep(0.01)\n"
+            "assert preemption.preemption_requested()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "time.sleep(30)\n"
+            "print('STILL ALIVE')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO_ROOT,
+            env=_child_env(),
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == -signal.SIGTERM, (r.returncode, r.stdout, r.stderr)
+        assert "STILL ALIVE" not in r.stdout
+
     def test_without_automatic_naming_flag_is_left_for_the_loop(self):
         acc = atx.Accelerator(seed=0)
         state = acc.create_train_state({"w": jnp.arange(4.0)}, optax.sgd(0.1))
@@ -398,6 +542,62 @@ class TestWatchdog:
             wd.disarm()
             wd.arm()  # steady state: 0.2s deadline
             assert wd.fired.wait(timeout=5.0)
+        finally:
+            wd.stop()
+
+    def test_paused_suppresses_firing_and_rearms(self):
+        fired = []
+        wd = Watchdog(0.2, abort=lambda: fired.append(True))
+        try:
+            wd.arm()
+            with wd.paused():
+                time.sleep(0.6)  # would have fired without the pause
+                assert not wd.fired.is_set() and not fired
+            # countdown restarted on exit: still armed, fires on its own
+            assert wd.fired.wait(timeout=5.0)
+        finally:
+            wd.stop()
+
+    def test_paused_never_arms_an_unarmed_watchdog(self):
+        wd = Watchdog(0.2, abort=lambda: None)
+        try:
+            with wd.paused():
+                pass
+            time.sleep(0.6)
+            assert not wd.fired.is_set()
+        finally:
+            wd.stop()
+
+    def test_save_and_load_state_pause_env_watchdog(
+        self, tmp_path, monkeypatch
+    ):
+        """A routine synchronous save/load slower than ATX_WATCHDOG_SECS
+        must not trip the armed watchdog (REVIEW: false-positive abort
+        mid-commit lost the in-flight checkpoint)."""
+        import accelerate_tpu.resilience.watchdog as wmod
+
+        fired = []
+        wd = Watchdog(0.4, abort=lambda: fired.append(True))
+        monkeypatch.setenv("ATX_WATCHDOG_SECS", "0.4")
+        monkeypatch.setattr(wmod, "_ENV_WATCHDOG", wd)
+        try:
+            acc = _auto_acc(tmp_path)
+            state = _w_state(acc)
+
+            class SlowExtra:
+                def state_dict(self):
+                    time.sleep(1.0)  # > deadline: the save itself is "slow"
+                    return {"x": 1}
+
+                def load_state_dict(self, d):
+                    time.sleep(1.0)
+
+            acc.register_for_checkpointing(SlowExtra())
+            wd.arm()  # a step is in flight — heartbeat armed
+            acc.save_state(None, state)
+            assert not wd.fired.is_set() and not fired
+            acc.load_state(None, _w_state(acc), resume="latest")
+            assert not wd.fired.is_set() and not fired
         finally:
             wd.stop()
 
@@ -576,6 +776,29 @@ def test_disk_offload_sentinel_kill_refuses_resume(tmp_path):
     msg = str(e.value)
     assert "dirty sentinel" in msg
     assert "fresh directory" in msg and "restore a full checkpoint" in msg
+
+
+@pytest.mark.multiprocess
+@pytest.mark.slow
+def test_preemption_notice_on_one_rank_becomes_group_decision(tmp_path):
+    """The multihost agreement collective (REVIEW high): only rank 0 is
+    notified mid-training, yet BOTH ranks must exit 75 at the same step
+    with ONE consistent emergency checkpoint — every process's manifest
+    present, all recording the same step — and the elastic resume must
+    verify it and complete."""
+    r = launch(
+        os.path.join(SCRIPTS, "preempt_one_rank.py"),
+        str(tmp_path / "proj"),
+        num_processes=2,
+        host_devices=1,
+        timeout=360,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "NEVER PREEMPTED" not in r.stdout
+    assert "not counted against --max_restarts" in r.stderr
+    for rank in range(2):
+        assert f"[proc {rank}] RESUMED CONSISTENT step=2" in r.stdout, r.stdout
+        assert f"[proc {rank}] DONE" in r.stdout, r.stdout
 
 
 def test_launcher_resumes_preempted_group_without_burning_restarts(tmp_path):
