@@ -20,11 +20,14 @@ smoke-transfer:
 
 # CPU smoke for the continuous-batching serving engine (docs/serving.md):
 # tiny model, a 16-request Poisson trace that must fully complete with
-# outputs bit-identical to solo generate (tests/test_serving.py), plus
-# `atx lint` over the engine's real decode step — error-severity findings
-# fail the lane.
+# outputs bit-identical to solo generate, a shared-system-prompt trace
+# that must show prefix_hit_rate > 0 with >= 50% of prompt tokens served
+# from the radix prefix cache AND stay bit-identical to the cache-off
+# engine (tests/test_serving.py, tests/test_prefix_cache.py), plus `atx
+# lint` over the engine's real decode step and the prefix-copy kernel —
+# error-severity findings fail the lane.
 smoke-serve:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_generation.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_prefix_cache.py tests/test_generation.py -q -m 'not slow'
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint serving --severity error
 
 # Ahead-of-time step lint over the examples/ entry points (no training, no

@@ -174,7 +174,10 @@ def _scenario_serving(**options: Any):
     """serving.Engine decode step: the hot path of the continuous-batching
     engine (docs/serving.md). Lints the REAL slot-batched decode function
     with the engine's own abstract call signature — donation of the slot
-    cache, no host syncs/callbacks in the compiled step, stable shapes."""
+    cache, no host syncs/callbacks in the compiled step, stable shapes.
+    When the prefix cache is on, the bucketed prefix-copy function is
+    linted the same way (donated destination cache, traced slot/row/cursor
+    indices)."""
     import jax
     import jax.numpy as jnp
 
@@ -201,7 +204,21 @@ def _scenario_serving(**options: Any):
         target="serving.Engine.decode",
         **options,
     )
-    return f"serving decode step, {engine.n_slots} slots", report
+    desc = f"serving decode step, {engine.n_slots} slots"
+    if engine.prefix_cache is not None:
+        copy_report = analysis.lint_step(
+            engine.copy_fn_for_bucket(engine.buckets[0]),
+            *engine.abstract_copy_args(),
+            donate_argnums=(0,),
+            target="serving.Engine.prefix_copy",
+            **options,
+        )
+        report = analysis.Report(
+            findings=report.findings + copy_report.findings,
+            target="serving.Engine.decode+prefix_copy",
+        )
+        desc += f", prefix copy bucket {engine.buckets[0]}"
+    return desc, report
 
 
 SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {

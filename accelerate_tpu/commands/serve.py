@@ -52,6 +52,48 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "default replays the trace as fast as the engine drains it",
     )
     p.add_argument(
+        "--prefix-cache",
+        dest="prefix_cache",
+        action="store_true",
+        default=None,
+        help="force the prefix cache ON (default: on unless "
+        "ATX_SERVE_PREFIX_CACHE=0)",
+    )
+    p.add_argument(
+        "--no-prefix-cache",
+        dest="prefix_cache",
+        action="store_false",
+        help="disable the prefix cache",
+    )
+    p.add_argument(
+        "--prefix-cache-mib",
+        type=float,
+        default=None,
+        help="prefix-cache pool byte budget in MiB (ATX_SERVE_PREFIX_CACHE_MIB)",
+    )
+    p.add_argument(
+        "--shared-prefix",
+        type=int,
+        default=0,
+        metavar="LEN",
+        help="give every request one of --shared-prefixes common system "
+        "prompts of LEN tokens (the prefix-cache workload shape); "
+        "prompt-lens then sizes only the unique tails",
+    )
+    p.add_argument(
+        "--shared-prefixes",
+        type=int,
+        default=2,
+        help="number of distinct shared system prompts (with --shared-prefix)",
+    )
+    p.add_argument(
+        "--stop",
+        default=None,
+        metavar="IDS",
+        help="comma-separated token ids used as one multi-token stop "
+        "sequence on every request (host-side tail match)",
+    )
+    p.add_argument(
         "--compare-b1",
         action="store_true",
         help="also run the request set sequentially through batch-1 "
@@ -120,7 +162,7 @@ def run(args: argparse.Namespace) -> int:
     import numpy as np
 
     from ..generation import GenerationConfig, Generator
-    from ..serving import Engine, poisson_trace
+    from ..serving import Engine, poisson_trace, shared_prefix_trace
 
     apply_fn, init_cache_fn, params, vocab = _build_model(args.model)
     prompt_lens = _span(args.prompt_lens)
@@ -131,14 +173,18 @@ def run(args: argparse.Namespace) -> int:
     config = GenerationConfig(
         do_sample=args.do_sample, temperature=args.temperature
     )
+    stop_sequences = (
+        [tuple(int(t) for t in args.stop.split(","))] if args.stop else None
+    )
     max_len = args.max_len
     if max_len is None:
         # Fit the worst-case request: prompt rounded up to a bucket + budget.
         from ..serving import default_buckets
 
         bs = buckets or default_buckets()
-        rounded = min((b for b in bs if b >= prompt_lens[1]), default=None)
-        top = rounded if rounded is not None else -(-prompt_lens[1] // bs[-1]) * bs[-1]
+        longest = prompt_lens[1] + args.shared_prefix
+        rounded = min((b for b in bs if b >= longest), default=None)
+        top = rounded if rounded is not None else -(-longest // bs[-1]) * bs[-1]
         max_len = top + new_tokens[1]
     engine = Engine(
         apply_fn,
@@ -148,15 +194,31 @@ def run(args: argparse.Namespace) -> int:
         slots=args.slots,
         buckets=buckets,
         max_len=max_len,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_mib=args.prefix_cache_mib,
     )
-    trace = poisson_trace(
-        args.requests,
-        args.rate,
-        vocab_size=vocab,
-        prompt_lens=prompt_lens,
-        new_tokens=new_tokens,
-        seed=args.seed,
-    )
+    if args.shared_prefix > 0:
+        trace = shared_prefix_trace(
+            args.requests,
+            args.rate,
+            vocab_size=vocab,
+            n_prefixes=args.shared_prefixes,
+            prefix_len=args.shared_prefix,
+            tail_lens=prompt_lens,
+            new_tokens=new_tokens,
+            seed=args.seed,
+            stop_sequences=stop_sequences,
+        )
+    else:
+        trace = poisson_trace(
+            args.requests,
+            args.rate,
+            vocab_size=vocab,
+            prompt_lens=prompt_lens,
+            new_tokens=new_tokens,
+            seed=args.seed,
+            stop_sequences=stop_sequences,
+        )
     t0 = time.perf_counter()
     completions = engine.serve(trace, realtime=args.realtime)
     wall = time.perf_counter() - t0
@@ -172,6 +234,7 @@ def run(args: argparse.Namespace) -> int:
         "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
         "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
         "serve_ttft_p50_ms": round(pick(ttft_ms, 0.50), 1),
+        "serve_ttft_p99_ms": round(pick(ttft_ms, 0.99), 1),
         "serve_slots": engine.n_slots,
         "serve_buckets": list(engine.buckets),
         "serve_prefill_compiles": engine._prefill._cache_size(),
@@ -182,6 +245,8 @@ def run(args: argparse.Namespace) -> int:
             3,
         ),
     }
+    for key, val in engine.prefix_metrics().items():
+        result["serve_" + key] = val
     if args.compare_b1:
         gens: dict[int, Generator] = {}
         t0 = time.perf_counter()

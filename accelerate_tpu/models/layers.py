@@ -171,6 +171,44 @@ def cache_slot_write(kv: Any, row: Any, slot: jax.Array) -> Any:
     )
 
 
+def cache_slot_copy(
+    dst: Any,
+    src: Any,
+    dst_slot: jax.Array,
+    src_slot: jax.Array,
+    start: jax.Array,
+    length: int,
+) -> Any:
+    """Copy ``length`` committed KV positions from row ``src_slot`` of
+    ``src`` into row ``dst_slot`` of ``dst`` at the same sequence offset
+    ``start``, for every layer-stacked (L, B, T, ...) leaf of two family
+    caches (``length`` cursors excluded, like `cache_slot_view`).
+
+    The positions are preserved (source offset == destination offset)
+    because committed KV has its rotary/positional encoding baked in — KV
+    for token t at position p is only reusable AT position p. ``length`` is
+    a static chunk size drawn from the serving engine's prefill bucket set
+    while ``dst_slot``/``src_slot``/``start`` are traced int32, so one
+    jitted caller compiles at most once per bucket whatever slots and
+    cursors traffic produces — the primitive behind the prefix cache's
+    device-to-device hit copies and promotions (serving/prefix_cache.py).
+    ``dst`` and ``src`` may have different batch (row-pool) sizes."""
+    dst_slot = jnp.asarray(dst_slot, jnp.int32)
+    src_slot = jnp.asarray(src_slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+
+    def one(d: jax.Array, s: jax.Array) -> jax.Array:
+        tail = (0,) * (s.ndim - 3)
+        seg = jax.lax.dynamic_slice(
+            s, (0, src_slot, start) + tail, (s.shape[0], 1, length) + s.shape[3:]
+        )
+        return jax.lax.dynamic_update_slice(
+            d, seg.astype(d.dtype), (0, dst_slot, start) + tail
+        )
+
+    return jax.tree.map(one, dst, src)
+
+
 # ---------------------------------------------------------------------- rope
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:

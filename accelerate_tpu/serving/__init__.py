@@ -1,7 +1,24 @@
 """Continuous-batching serving: slot-paged KV cache, bucketed chunked
-prefill, iteration-level scheduling. See `serving/engine.py` and
-docs/serving.md."""
+prefill, iteration-level scheduling, and automatic prefix caching
+(radix-tree KV reuse across requests). See `serving/engine.py`,
+`serving/prefix_cache.py`, and docs/serving.md."""
 
-from .engine import Completion, Engine, Request, default_buckets, poisson_trace
+from .engine import (
+    Completion,
+    Engine,
+    Request,
+    default_buckets,
+    poisson_trace,
+    shared_prefix_trace,
+)
+from .prefix_cache import PrefixCache
 
-__all__ = ["Engine", "Request", "Completion", "poisson_trace", "default_buckets"]
+__all__ = [
+    "Engine",
+    "Request",
+    "Completion",
+    "poisson_trace",
+    "shared_prefix_trace",
+    "default_buckets",
+    "PrefixCache",
+]

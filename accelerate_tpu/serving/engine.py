@@ -50,10 +50,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..generation import GenerationConfig, warp_logits
-from ..models.layers import cache_slot_view, cache_slot_write
-from ..utils.environment import get_int_from_env, get_str_from_env
+from ..models.layers import cache_slot_copy, cache_slot_view, cache_slot_write
+from ..utils.environment import (
+    get_int_from_env,
+    get_str_from_env,
+    parse_flag_from_env,
+)
+from .prefix_cache import PrefixCache
 
-__all__ = ["Engine", "Request", "Completion", "poisson_trace", "default_buckets"]
+__all__ = [
+    "Engine",
+    "Request",
+    "Completion",
+    "poisson_trace",
+    "shared_prefix_trace",
+    "default_buckets",
+]
 
 ApplyFn = Callable[[Any, jax.Array, Any], tuple[jax.Array, Any]]
 
@@ -82,14 +94,18 @@ class Request:
     """One generation request. ``arrival`` is seconds relative to the trace
     start (used by `Engine.serve(realtime=True)` and the bench); ``seed``
     drives the per-request sampling stream, so a request's tokens don't
-    depend on which other requests share the batch."""
+    depend on which other requests share the batch. ``max_new_tokens=None``
+    falls back to the engine config's budget; ``stop_sequences`` are
+    multi-token stop strings matched HOST-side against the emitted tail
+    (the device step never sees them — no recompiles per stop set)."""
 
     prompt: np.ndarray
-    max_new_tokens: int
+    max_new_tokens: int | None = None
     rid: int = -1
     seed: int = 0
     arrival: float | None = None
     stream: Callable[[int, int, str | None], None] | None = None
+    stop_sequences: Sequence[Sequence[int]] | None = None
 
 
 @dataclasses.dataclass
@@ -97,7 +113,9 @@ class Completion:
     """A finished request. ``tokens`` is (max_new_tokens,) int32 padded with
     ``pad_token_id`` after EOS — the exact layout solo `generate()` emits
     for the generated region, so bit-identity checks are a slice compare.
-    Timestamps are absolute `time.perf_counter()` values."""
+    Timestamps are absolute `time.perf_counter()` values. ``finish_reason``
+    is ``"eos"`` / ``"stop"`` (a stop sequence matched; its tokens stay in
+    ``tokens``) / ``"length"`` (budget exhausted)."""
 
     rid: int
     prompt: np.ndarray
@@ -107,18 +125,27 @@ class Completion:
     submitted_at: float
     first_token_at: float
     finished_at: float
+    finish_reason: str = "length"
 
 
 class _Slot:
     __slots__ = (
         "req", "chunks", "cursor", "n_new", "last_token", "out",
-        "first_token_at", "decoding",
+        "first_token_at", "decoding", "pending_copy",
     )
 
-    def __init__(self, req: Request, chunks: list, pad: int) -> None:
+    def __init__(
+        self, req: Request, chunks: list, pad: int, *, matched: int = 0,
+        pending_copy=None,
+    ) -> None:
         self.req = req
         self.chunks = chunks  # [(padded (1, bucket) np.int32, real_len), ...]
-        self.cursor = 0  # KV positions written & committed so far
+        # KV positions written & committed so far. A prefix-cache hit
+        # starts the cursor at the match boundary; the pinned source node
+        # in ``pending_copy`` is copied into the slot row right before the
+        # slot's first prefill chunk (same device order: copy, then chunk).
+        self.cursor = matched
+        self.pending_copy = pending_copy  # (CacheNode, matched) | None
         self.n_new = 0
         self.last_token = 0
         self.out = np.full((req.max_new_tokens,), pad, np.int32)
@@ -141,6 +168,15 @@ class Engine:
     work are pending (1 = strict alternation; 0 = prefill-first, which
     stalls in-flight decodes for the whole prompt — the fixed-batch
     behaviour this engine exists to avoid).
+
+    ``prefix_cache`` (default on; ``ATX_SERVE_PREFIX_CACHE=0`` disables)
+    retains committed prompt-prefix KV in a dedicated device pool and
+    serves future requests' shared prefixes by device-to-device copy
+    instead of prefill (docs/serving.md). ``prefix_cache_mib``
+    (``ATX_SERVE_PREFIX_CACHE_MIB``, default 64) is the pool's byte
+    budget; ``prefix_cache_rows`` overrides the derived row count
+    directly (tests / exact sizing). Greedy outputs are bit-identical
+    with the cache on or off.
     """
 
     def __init__(
@@ -156,6 +192,9 @@ class Engine:
         prefill_interleave: int = 1,
         decode_block: int = 1,
         detokenize: Callable[[Sequence[int]], str] | None = None,
+        prefix_cache: bool | None = None,
+        prefix_cache_mib: float | None = None,
+        prefix_cache_rows: int | None = None,
     ) -> None:
         self.config = config or GenerationConfig()
         self.n_slots = (
@@ -232,6 +271,50 @@ class Engine:
         self._decode = jax.jit(decode_fn, donate_argnums=(3,))
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
 
+        # Prefix cache: a dedicated pool of KV rows (same leaf layout as the
+        # slot pool) indexed by a host-side radix tree. Hit/promotion copies
+        # go through ONE jitted cache_slot_copy whose chunk length is a
+        # static drawn from the bucket set (slots/cursor traced), so its jit
+        # cache is bounded by 2 x len(buckets) — hit copies (dst = slot kv)
+        # and promotions (dst = pool) have different dst/src shapes when the
+        # pool row count differs from the slot count.
+        # Per-engine wrapper (not cache_slot_copy itself): jit caches key on
+        # the function object, so a shared callee would pool compile counts
+        # across engines and make prefix_copy_compiles meaningless.
+        def copy_fn(dst, src, dst_slot, src_slot, start, length: int):
+            return cache_slot_copy(dst, src, dst_slot, src_slot, start, length)
+
+        self._copy_fn = copy_fn
+        self._copy = jax.jit(copy_fn, static_argnums=(5,), donate_argnums=(0,))
+        self.copy_signatures: list[int] = []  # chunk length per issued copy
+        enabled = (
+            parse_flag_from_env("ATX_SERVE_PREFIX_CACHE", True)
+            if prefix_cache is None
+            else prefix_cache
+        )
+        self.prefix_cache: PrefixCache | None = None
+        self._pool: Any = None
+        if enabled:
+            rows = prefix_cache_rows
+            if rows is None:
+                mib = (
+                    prefix_cache_mib
+                    if prefix_cache_mib is not None
+                    else get_int_from_env(("ATX_SERVE_PREFIX_CACHE_MIB",), 64)
+                )
+                row_bytes = sum(
+                    int(np.prod(v.shape)) * v.dtype.itemsize
+                    for v in jax.tree.leaves(kv)
+                ) // self.n_slots
+                rows = int(mib * 2**20 // max(row_bytes, 1))
+            rows = min(rows, 1024)  # bound host tree bookkeeping
+            if rows >= 1:
+                pool = init_cache_fn(rows, self.max_len)
+                self._pool = jax.device_put(
+                    {k: v for k, v in pool.items() if k != "length"}, self._device
+                )
+                self.prefix_cache = PrefixCache(rows, self.buckets, self.max_len)
+
         self._queue: deque[Request] = deque()
         self._slots: list[_Slot | None] = [None] * self.n_slots
         self._free: deque[int] = deque(range(self.n_slots))
@@ -245,6 +328,11 @@ class Engine:
             "prefill_chunks": 0,
             "decode_steps": 0,
             "decode_slot_steps": 0,  # active rows summed over decode steps
+            "prompt_tokens": 0,
+            "prefix_hits": 0,
+            "prefill_tokens_saved": 0,  # prompt tokens served by copy, not prefill
+            "prefix_copy_chunks": 0,
+            "prefix_promotions": 0,
         }
         self.actions: list[str] = []  # "prefill" / "decode", for tests/traces
 
@@ -257,26 +345,36 @@ class Engine:
         seed: int = 0,
         stream: Callable[[int, int, str | None], None] | None = None,
         arrival: float | None = None,
+        stop_sequences: Sequence[Sequence[int]] | None = None,
     ) -> int:
         """Queue one request; returns its request id. ``stream`` is called
         as ``stream(rid, token_id, text)`` for every generated token (text
-        is the detokenized piece when the engine has a detokenizer)."""
+        is the detokenized piece when the engine has a detokenizer).
+        ``max_new_tokens`` overrides the engine config's budget per
+        request; ``stop_sequences`` end the request early when the emitted
+        tail matches any of the token sequences (host-side — see
+        `Request`)."""
         req = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
-            max_new_tokens=(
-                max_new_tokens
-                if max_new_tokens is not None
-                else self.config.max_new_tokens
-            ),
+            max_new_tokens=max_new_tokens,
             seed=seed,
             arrival=arrival,
             stream=stream,
+            stop_sequences=stop_sequences,
         )
         return self.submit_request(req)
 
     def submit_request(self, req: Request) -> int:
+        if req.max_new_tokens is None:
+            req.max_new_tokens = self.config.max_new_tokens
         if req.max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        if req.stop_sequences is not None:
+            req.stop_sequences = tuple(
+                tuple(int(t) for t in seq) for seq in req.stop_sequences
+            )
+            if any(len(seq) == 0 for seq in req.stop_sequences):
+                raise ValueError("empty stop sequence")
         S = int(req.prompt.shape[0])
         if S < 1:
             raise ValueError("empty prompt")
@@ -297,9 +395,13 @@ class Engine:
     def busy(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
-    def _chunk_plan(self, prompt: np.ndarray) -> list[tuple[np.ndarray, int]]:
+    def _chunk_plan(
+        self, prompt: np.ndarray, start: int = 0
+    ) -> list[tuple[np.ndarray, int]]:
+        """Bucket-padded prefill chunks for ``prompt[start:]`` (``start`` is
+        the prefix-cache match boundary — 0 when there's no hit)."""
         chunks = []
-        pos, S = 0, len(prompt)
+        pos, S = start, len(prompt)
         while pos < S:
             rem = S - pos
             if rem > self.buckets[-1]:
@@ -317,11 +419,30 @@ class Engine:
         while self._queue and self._free:
             req = self._queue.popleft()
             slot_id = self._free.popleft()
+            node, matched = None, 0
+            if self.prefix_cache is not None:
+                # Cap the match one token short of the prompt: the final
+                # prefill chunk must forward at least one real token to
+                # produce the first sampling logits. The returned node is
+                # pinned until the copy dispatch in _prefill_step — LRU
+                # eviction cannot recycle its row in between, however many
+                # promotions other slots' completions trigger first.
+                node, matched = self.prefix_cache.match(
+                    req.prompt, limit=len(req.prompt) - 1
+                )
             self._slots[slot_id] = _Slot(
-                req, self._chunk_plan(req.prompt), self.config.pad_token_id
+                req,
+                self._chunk_plan(req.prompt, start=matched),
+                self.config.pad_token_id,
+                matched=matched,
+                pending_copy=(node, matched) if node is not None else None,
             )
             self._prefill_order.append(slot_id)
             self.stats["admitted"] += 1
+            self.stats["prompt_tokens"] += len(req.prompt)
+            if matched:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefill_tokens_saved"] += matched
 
     def step(self) -> list[Completion]:
         """One scheduler iteration: admit what fits, then run EITHER one
@@ -377,6 +498,25 @@ class Engine:
     def _prefill_step(self) -> list[Completion]:
         slot_id = self._prefill_order[0]
         slot = self._slots[slot_id]
+        if slot.pending_copy is not None:
+            # Prefix-cache hit: copy the matched KV span out of the pool
+            # into this slot's row, chunked at bucket lengths (static per
+            # chunk — the jit cache stays bounded by the bucket set). The
+            # copies are dispatched BEFORE this slot's first prefill chunk,
+            # so in device order the chunk's attention over [0, cursor)
+            # reads committed prefix KV, never the pool row's future state.
+            node, matched = slot.pending_copy
+            off = 0
+            for ln in self.prefix_cache.chunks(matched):
+                self._kv = self._copy(
+                    self._kv, self._pool,
+                    np.int32(slot_id), np.int32(node.row), np.int32(off), ln,
+                )
+                self.copy_signatures.append(ln)
+                self.stats["prefix_copy_chunks"] += 1
+                off += ln
+            self.prefix_cache.release(node)
+            slot.pending_copy = None
         buf, real = slot.chunks.pop(0)
         tok, self._kv = self._prefill(
             self.params,
@@ -450,8 +590,8 @@ class Engine:
         return out
 
     def _emit(self, slot_id: int, tok: int) -> list[Completion]:
-        """Record one generated token for a slot; finish/evict on EOS or
-        budget exhaustion."""
+        """Record one generated token for a slot; finish/evict on EOS, a
+        stop-sequence match, or budget exhaustion."""
         slot = self._slots[slot_id]
         req = slot.req
         slot.out[slot.n_new] = tok
@@ -463,7 +603,14 @@ class Engine:
         eos_hit = (
             self.config.eos_token_id is not None and tok == self.config.eos_token_id
         )
-        if not eos_hit and slot.n_new < req.max_new_tokens:
+        stop_hit = False
+        if req.stop_sequences and not eos_hit:
+            for seq in req.stop_sequences:
+                n = len(seq)
+                if n <= slot.n_new and slot.out[slot.n_new - n : slot.n_new].tolist() == list(seq):
+                    stop_hit = True
+                    break
+        if not eos_hit and not stop_hit and slot.n_new < req.max_new_tokens:
             return []
         completion = Completion(
             rid=req.rid,
@@ -476,11 +623,73 @@ class Engine:
             submitted_at=getattr(req, "submitted_at", 0.0),
             first_token_at=slot.first_token_at,
             finished_at=time.perf_counter(),
+            finish_reason="eos" if eos_hit else ("stop" if stop_hit else "length"),
         )
+        if self.prefix_cache is not None:
+            self._promote(slot_id, slot)
         self._slots[slot_id] = None  # evict: the slot is immediately reusable
         self._free.append(slot_id)
         self.stats["completed"] += 1
         return [completion]
+
+    def _promote(self, slot_id: int, slot: _Slot) -> None:
+        """Offer an evicted slot's committed prefix to the cache: the
+        chunk-aligned front of [0, cursor) — the prompt plus every
+        generated token whose KV has been committed (all but the last, so
+        multi-turn follow-ups hit past the original prompt). The copies
+        read the slot row BEFORE any later admission overwrites it (host
+        dispatch order is device order), and a dedup/full-pool insert
+        returns None, in which case promotion is just skipped — hits are
+        an optimization, never a correctness dependency."""
+        committed = slot.cursor
+        cached_len = self.prefix_cache.aligned(committed)
+        if cached_len <= 0:
+            return
+        tokens = slot.req.prompt
+        if cached_len > len(tokens):
+            tokens = np.concatenate([tokens, slot.out[: cached_len - len(tokens)]])
+        else:
+            tokens = tokens[:cached_len]
+        row = self.prefix_cache.insert(tokens)
+        if row is None:
+            return
+        off = 0
+        for ln in self.prefix_cache.chunks(cached_len):
+            self._pool = self._copy(
+                self._pool, self._kv,
+                np.int32(row), np.int32(slot_id), np.int32(off), ln,
+            )
+            self.copy_signatures.append(ln)
+            self.stats["prefix_copy_chunks"] += 1
+            off += ln
+        self.stats["prefix_promotions"] += 1
+
+    # ------------------------------------------------------------ metrics
+    def prefix_metrics(self) -> dict:
+        """Prefix-cache counters in reporting shape (`atx serve` JSON /
+        bench.py serve phase). ``prefill_saved_frac`` is the fraction of
+        all admitted prompt tokens that were served by KV copy instead of
+        prefill compute — the headline number for shared-prefix traffic."""
+        if self.prefix_cache is None:
+            return {"prefix_cache": 0}
+        pc = self.prefix_cache
+        return {
+            "prefix_cache": 1,
+            "prefix_rows": pc.n_rows,
+            "prefix_rows_used": pc.used_rows,
+            "prefix_hit_rate": round(
+                self.stats["prefix_hits"] / max(pc.stats["lookups"], 1), 3
+            ),
+            "prefill_tokens_saved": self.stats["prefill_tokens_saved"],
+            "prefill_saved_frac": round(
+                self.stats["prefill_tokens_saved"]
+                / max(self.stats["prompt_tokens"], 1),
+                3,
+            ),
+            "prefix_promotions": self.stats["prefix_promotions"],
+            "prefix_evictions": pc.stats["evictions"],
+            "prefix_copy_compiles": self._copy._cache_size(),
+        }
 
     # --------------------------------------------------------------- lint
     def abstract_decode_args(self) -> tuple:
@@ -499,6 +708,31 @@ class Engine:
             vec(np.int32),
         )
 
+    def copy_fn_for_bucket(self, bucket: int):
+        """The prefix-copy computation at one static chunk length, for
+        linting: `analysis.lint_step(engine.copy_fn_for_bucket(b),
+        *engine.abstract_copy_args(), donate_argnums=(0,))` — the `atx
+        lint serving` scenario runs it alongside the decode step."""
+        return lambda dst, src, dst_slot, src_slot, start: self._copy_fn(
+            dst, src, dst_slot, src_slot, start, bucket
+        )
+
+    def abstract_copy_args(self) -> tuple:
+        """ShapeDtypeStructs matching one hit-direction prefix-copy call
+        (dst = the slot kv pool, src = the prefix pool); pairs with
+        `copy_fn_for_bucket`. Requires the prefix cache to be enabled."""
+        if self._pool is None:
+            raise RuntimeError("prefix cache is disabled on this engine")
+        sds = lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        scalar = lambda dt: jax.ShapeDtypeStruct((), dt)
+        return (
+            jax.tree.map(sds, self._kv),
+            jax.tree.map(sds, self._pool),
+            scalar(np.int32),
+            scalar(np.int32),
+            scalar(np.int32),
+        )
+
 
 def poisson_trace(
     n: int,
@@ -508,9 +742,11 @@ def poisson_trace(
     prompt_lens: tuple[int, int] = (8, 96),
     new_tokens: tuple[int, int] = (8, 48),
     seed: int = 0,
+    stop_sequences: Sequence[Sequence[int]] | None = None,
 ) -> list[Request]:
     """Synthetic mixed-length request trace with Poisson arrivals at
-    ``rate`` requests/sec — the bench.py / `atx serve` workload shape."""
+    ``rate`` requests/sec — the bench.py / `atx serve` workload shape.
+    ``stop_sequences`` (if given) is attached to every request."""
     rng = np.random.RandomState(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
     reqs = []
@@ -523,6 +759,49 @@ def poisson_trace(
                 rid=i,
                 seed=i,
                 arrival=float(arrivals[i]),
+                stop_sequences=stop_sequences,
+            )
+        )
+    return reqs
+
+
+def shared_prefix_trace(
+    n: int,
+    rate: float,
+    *,
+    vocab_size: int,
+    n_prefixes: int = 2,
+    prefix_len: int = 64,
+    tail_lens: tuple[int, int] = (4, 24),
+    new_tokens: tuple[int, int] = (4, 16),
+    seed: int = 0,
+    stop_sequences: Sequence[Sequence[int]] | None = None,
+) -> list[Request]:
+    """Poisson trace where every prompt is one of ``n_prefixes`` shared
+    system prompts (``prefix_len`` tokens) plus a unique tail — the
+    workload shape automatic prefix caching targets. With the cache on,
+    hit-rate approaches ``(n - n_prefixes) / n`` once each prefix has been
+    promoted; make ``prefix_len`` a sum of bucket lengths so the whole
+    prefix is reusable (docs/serving.md)."""
+    rng = np.random.RandomState(seed)
+    prefixes = [
+        rng.randint(0, vocab_size, (prefix_len,)).astype(np.int32)
+        for _ in range(n_prefixes)
+    ]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    reqs = []
+    for i in range(n):
+        tail = rng.randint(
+            0, vocab_size, (int(rng.randint(tail_lens[0], tail_lens[1] + 1)),)
+        ).astype(np.int32)
+        reqs.append(
+            Request(
+                prompt=np.concatenate([prefixes[i % n_prefixes], tail]),
+                max_new_tokens=int(rng.randint(new_tokens[0], new_tokens[1] + 1)),
+                rid=i,
+                seed=i,
+                arrival=float(arrivals[i]),
+                stop_sequences=stop_sequences,
             )
         )
     return reqs

@@ -483,7 +483,11 @@ def _bench_serve(config) -> dict:
     `generate()` — the fixed-batch workflow the engine replaces. The
     ISSUE-3 acceptance bar is `serve_vs_b1_speedup >= 3`. Then a second
     pass replays Poisson arrivals at ~70% of the measured capacity on the
-    wall clock for honest p50/p99 request latency."""
+    wall clock for honest p50/p99 request + TTFT latency. Finally a
+    shared-prefix trace (two 128-token system prompts) is served with the
+    prefix cache on vs off: `serve_prefix_hit_rate`/`serve_prefill_saved`
+    quantify the radix-tree KV reuse and the TTFT p50 pair shows the
+    time-to-first-token win (ISSUE-6)."""
     import dataclasses
 
     from accelerate_tpu import serving
@@ -519,15 +523,17 @@ def _bench_serve(config) -> dict:
         for i in range(n_requests)
     ]
 
-    def fresh_engine():
+    def fresh_engine(prefix_cache: bool = False, max_len: int | None = None):
         return serving.Engine(
             apply_fn,
             init_cache_fn,
             params,
             GenerationConfig(),
             buckets=buckets,
-            max_len=max(prompt_lens) + max(budgets),
+            max_len=max_len or (max(prompt_lens) + max(budgets)),
             decode_block=8,
+            prefix_cache=prefix_cache,
+            prefix_cache_rows=8 if prefix_cache else None,
         )
 
     engine = fresh_engine()
@@ -575,7 +581,43 @@ def _bench_serve(config) -> dict:
     ]
     lat = lat_engine.serve(lat_trace, realtime=True)
     lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in lat)
+    ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in lat)
     pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    # Prefix-cache phase: 32 requests behind two 128-token system prompts
+    # with short unique tails, replayed (as-fast-as-possible) through a
+    # cache-on and a cache-off engine. TTFT here is the queue+prefill time
+    # per request; with ~94% of each prompt's prefill skipped on a hit the
+    # cache-on engine should cut it well below the cache-off run.
+    prefix_trace = serving.shared_prefix_trace(
+        32,
+        1e9,  # all requests queued up-front: measures prefill work, not arrivals
+        vocab_size=gen_config.vocab_size,
+        n_prefixes=2,
+        prefix_len=128,
+        tail_lens=(8, 32),
+        new_tokens=(8, 24),
+        seed=11,
+    )
+    prefix_max_len = 128 + 32 + 24
+    prefix_results = {}
+    for label, on in (("prefix", True), ("nocache", False)):
+        eng = fresh_engine(prefix_cache=on, max_len=prefix_max_len)
+        # Warm compiles (prefill buckets + decode) outside the timed pass.
+        eng.serve(
+            serving.Request(
+                prompt=rng.randint(0, gen_config.vocab_size, (S,)).astype(np.int32),
+                max_new_tokens=2,
+                rid=2000 + S,
+            )
+            for S in buckets
+        )
+        done = eng.serve(prefix_trace)
+        tt = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in done)
+        prefix_results[label] = (eng, pick(tt, 0.50), pick(tt, 0.99))
+    prefix_eng = prefix_results["prefix"][0]
+    pm = prefix_eng.prefix_metrics()
+
     return {
         "serve_requests": n_requests,
         "serve_tokens_per_sec": round(serve_tps, 1),
@@ -583,6 +625,8 @@ def _bench_serve(config) -> dict:
         "serve_vs_b1_speedup": round(serve_tps / b1_tps, 2),
         "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
         "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
+        "serve_ttft_p50_ms": round(pick(ttft_ms, 0.50), 1),
+        "serve_ttft_p99_ms": round(pick(ttft_ms, 0.99), 1),
         "serve_slots": engine.n_slots,
         "serve_occupancy": round(
             engine.stats["decode_slot_steps"]
@@ -591,6 +635,15 @@ def _bench_serve(config) -> dict:
         ),
         "serve_prefill_compiles": engine._prefill._cache_size(),
         "serve_decode_compiles": engine._decode._cache_size(),
+        "serve_prefix_hit_rate": round(pm["prefix_hit_rate"], 3),
+        "serve_prefill_tokens_saved": pm["prefill_tokens_saved"],
+        "serve_prefill_saved_frac": round(pm["prefill_saved_frac"], 3),
+        "serve_prefix_copy_compiles": pm["prefix_copy_compiles"],
+        "serve_prefix_ttft_p50_ms": round(prefix_results["prefix"][1], 1),
+        "serve_nocache_ttft_p50_ms": round(prefix_results["nocache"][1], 1),
+        "serve_prefix_ttft_speedup": round(
+            prefix_results["nocache"][1] / max(prefix_results["prefix"][1], 1e-9), 2
+        ),
     }
 
 
@@ -1055,15 +1108,30 @@ def _bench_bigmodel() -> dict:
     io_mib_s = read_bytes / (time.perf_counter() - t0) / 2**20
 
     # Host->device link roofline: the load time must be judged against what
-    # the link can move (through the remote tunnel a put runs ~50 MiB/s,
-    # so an 8 GiB packed model has a ~170 s floor no loader can beat).
+    # the link can move. BENCH_r05's `device_put_mib_s: 23.9` was a
+    # cold-path artifact: a 1 MiB warm-up does not open the full-size
+    # transfer path, so the single timed 64 MiB put paid first-touch
+    # allocation and link setup. Measure steady state instead — full-size
+    # warm put, then best-of-3 — and report the chunked TransferEngine
+    # (parallel/transfer.py, PR 1) over the same buffer alongside it, since
+    # that is the path load_pretrained actually rides.
+    from accelerate_tpu.parallel.transfer import TransferEngine
+
     probe = np.empty(64 * 2**20, np.int8)
-    jax.device_put(probe[: 2**20]).block_until_ready()  # warm the path
-    t0 = time.perf_counter()
-    d = jax.device_put(probe)
-    float(jnp.sum(d[:8].astype(jnp.float32)))
-    tunnel_put_mib_s = 64 / (time.perf_counter() - t0)
-    del d, probe
+
+    def _put_mib_s(fn) -> float:
+        fn().block_until_ready()  # full-size warm: opens the real path
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn().block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return 64 / best
+
+    tunnel_put_mib_s = _put_mib_s(lambda: jax.device_put(probe))
+    transfer_engine = TransferEngine()
+    engine_put_mib_s = _put_mib_s(lambda: transfer_engine.put(probe).result())
+    del probe
 
     AcceleratorState._reset_state()
     t0 = time.perf_counter()
@@ -1107,6 +1175,7 @@ def _bench_bigmodel() -> dict:
         "bigmodel_8b_synth_s": round(synth_s, 1),
         "io_read_mib_s": round(io_mib_s, 1),
         "device_put_mib_s": round(tunnel_put_mib_s, 1),
+        "device_put_engine_mib_s": round(engine_put_mib_s, 1),
         "bigmodel_8b_decode_tokens_per_sec": round(B * n_tokens / decode_dt, 1),
         "bigmodel_8b_decode_ms_per_token": round(1000 * decode_dt / n_tokens, 2),
     }

@@ -272,6 +272,18 @@ class TestKnobsAndFamilies:
             with pytest.raises(ValueError, match="ATX_SERVE_BUCKETS"):
                 serving.default_buckets()
 
+    def test_prefix_cache_env_knobs(self, params):
+        with patch_environment(ATX_SERVE_PREFIX_CACHE="0"):
+            eng = _engine(params)
+            assert eng.prefix_cache is None
+            assert eng.prefix_metrics() == {"prefix_cache": 0}
+        with patch_environment(ATX_SERVE_PREFIX_CACHE_MIB="1"):
+            eng = _engine(params)
+            assert eng.prefix_cache is not None
+        # A budget too small for one row disables the cache outright.
+        eng = _engine(params, prefix_cache_mib=1e-6)
+        assert eng.prefix_cache is None
+
     def test_gpt_family_contract(self):
         """The engine is family-agnostic: any cache whose non-length leaves
         are (L, B, T, ...) layer-stacked buffers works — here a GPT-2-style
@@ -293,3 +305,259 @@ class TestKnobsAndFamilies:
             )
         )[0, 7:]
         np.testing.assert_array_equal(c.tokens, want)
+
+
+def _prefixed_requests(prefix, tails, budgets, *, rid0=0, seed0=0):
+    return [
+        serving.Request(
+            prompt=np.concatenate([prefix, t]).astype(np.int32),
+            max_new_tokens=int(b),
+            rid=rid0 + i,
+            seed=seed0 + i,
+        )
+        for i, (t, b) in enumerate(zip(tails, budgets))
+    ]
+
+
+class TestPrefixCache:
+    """Automatic prefix caching (`serving/prefix_cache.py` + the engine's
+    match/copy/promote hooks). The load-bearing claim everywhere: greedy
+    outputs with the cache ON are bit-identical to the cache-off engine
+    and to solo `generate()` — a hit changes where KV comes from, never
+    what it contains."""
+
+    def test_hit_is_bit_identical_llama_gqa(self, params):
+        """Second request shares a 24-token prefix with the first: the
+        engine copies the cached KV and prefills only the tail, and the
+        output still matches solo generate token for token."""
+        rng = np.random.RandomState(3)
+        prefix = rng.randint(0, 61, (24,)).astype(np.int32)
+        tails = [rng.randint(0, 61, (5,)).astype(np.int32) for _ in range(2)]
+        eng = _engine(params, prefix_cache_rows=4)
+        outs = {}
+        for r in _prefixed_requests(prefix, tails, (8, 8)):
+            eng.submit_request(r)
+            # Serialize so the first completion PROMOTES before the second
+            # request's admission runs its match.
+            outs.update({c.rid: c for c in eng.run_until_idle()})
+        pc = eng.prefix_cache
+        assert pc.stats["hits"] >= 1 and pc.stats["tokens_matched"] >= 24
+        assert eng.stats["prefill_tokens_saved"] >= 24
+        for r in _prefixed_requests(prefix, tails, (8, 8)):
+            np.testing.assert_array_equal(
+                outs[r.rid].tokens, _solo(params, r.prompt, 8)
+            )
+
+    def test_admit_hit_evict_readmit_cycle_bit_identical(self, params):
+        """One pool row: promote A, hit on A', evict A for B, re-admit a
+        fresh A'' that must MISS (its row is gone) and re-prefill — every
+        stage bit-identical to solo."""
+        rng = np.random.RandomState(4)
+        pa = rng.randint(0, 61, (24,)).astype(np.int32)
+        pb = rng.randint(0, 61, (24,)).astype(np.int32)
+        eng = _engine(params, slots=1, prefix_cache_rows=1)
+        reqs, outs = [], {}
+        for i, prefix in enumerate((pa, pa, pb, pa)):
+            tail = rng.randint(0, 61, (4,)).astype(np.int32)
+            (r,) = _prefixed_requests(prefix, [tail], [6], rid0=i, seed0=i)
+            reqs.append(r)
+            eng.submit_request(r)
+            outs.update({c.rid: c for c in eng.run_until_idle()})
+        pc = eng.prefix_cache
+        assert pc.stats["hits"] >= 1  # request 1 hit on request 0's row
+        assert pc.stats["evictions"] >= 1  # pb's promotion stole the row
+        assert eng.stats["completed"] == 4
+        # Request 3 (pa again) missed: its row was evicted in between.
+        assert pc.stats["hits"] < pc.stats["lookups"]
+        for r in reqs:
+            np.testing.assert_array_equal(
+                outs[r.rid].tokens, _solo(params, r.prompt, 6)
+            )
+
+    def test_cache_on_equals_cache_off_same_trace(self, params):
+        """The whole-trace contract: identical Completion token streams
+        from a cache-on and a cache-off engine over a shared-prefix trace."""
+        trace = serving.shared_prefix_trace(
+            10, 200.0, vocab_size=61, n_prefixes=2, prefix_len=32,
+            tail_lens=(3, 8), new_tokens=(4, 10), seed=7,
+        )
+        on = _engine(params, slots=3, prefix_cache_rows=4)
+        off = _engine(params, slots=3, prefix_cache=False)
+        got_on = {c.rid: c.tokens for c in on.serve(trace)}
+        got_off = {c.rid: c.tokens for c in off.serve(trace)}
+        assert on.prefix_cache.stats["hits"] > 0
+        assert off.prefix_cache is None
+        for rid in got_off:
+            np.testing.assert_array_equal(got_on[rid], got_off[rid])
+
+    def test_multi_turn_promotion_hits_past_prompt(self, params):
+        """Promotion caches prompt + committed GENERATED tokens, so a
+        follow-up whose prompt extends the previous full stream (the
+        multi-turn shape) matches deeper than the original prompt."""
+        prompt = (np.arange(16, dtype=np.int32) * 7) % 61
+        eng = _engine(params, slots=1, prefix_cache_rows=2)
+        eng.submit(prompt, 12, seed=0)
+        (first,) = eng.run_until_idle()
+        turn2 = np.concatenate(
+            [prompt, first.tokens, (np.arange(9) * 5 % 61)]
+        ).astype(np.int32)
+        eng.submit(turn2, 6, seed=1)
+        (second,) = eng.run_until_idle()
+        pc = eng.prefix_cache
+        assert pc.stats["tokens_matched"] > len(prompt)
+        np.testing.assert_array_equal(second.tokens, _solo(params, turn2, 6))
+
+    def test_match_pin_blocks_eviction_until_copy(self, params):
+        """Between admission (match pins the node) and the copy dispatch,
+        a promotion cannot steal the matched row: insert is denied rather
+        than evicting the pinned entry."""
+        rng = np.random.RandomState(5)
+        prefix = rng.randint(0, 61, (24,)).astype(np.int32)
+        eng = _engine(params, slots=2, prefix_cache_rows=1)
+        eng.submit(np.concatenate([prefix, [3, 4]]).astype(np.int32), 6, seed=0)
+        eng.run_until_idle()
+        pc = eng.prefix_cache
+        assert pc.used_rows == 1
+        eng.submit(np.concatenate([prefix, [9, 8]]).astype(np.int32), 6, seed=1)
+        eng._admit()  # match() pins; the copy has NOT been dispatched yet
+        slot = next(s for s in eng._slots if s is not None and s.pending_copy)
+        node, matched = slot.pending_copy
+        assert matched >= 24 and node.refs == 1
+        assert pc.insert(rng.randint(0, 61, (16,)).astype(np.int32)) is None
+        assert pc.stats["insert_denied"] == 1  # pinned row survived
+        (c,) = eng.run_until_idle()
+        assert node.refs == 0  # released at copy dispatch
+        np.testing.assert_array_equal(
+            c.tokens, _solo(params, np.concatenate([prefix, [9, 8]]), 6)
+        )
+
+    def test_gpt_family_hit_bit_identical(self):
+        """Family-agnostic: the copy kernel tree-maps over whatever cache
+        leaves the family allocates (GPT's learned-positional cache here)."""
+        cfg = gpt.GPTConfig.tiny(vocab_size=61, max_seq_len=128)
+        gparams = gpt.init(jax.random.PRNGKey(2), cfg)
+        apply_fn = lambda p, t, c: gpt.forward_with_cache(p, t, c, cfg)
+        init_fn = lambda b, m: gpt.init_cache(cfg, b, m)
+        eng = serving.Engine(
+            apply_fn, init_fn, gparams, GenerationConfig(),
+            slots=2, buckets=(8,), max_len=48, prefix_cache_rows=2,
+        )
+        prefix = (np.arange(16, dtype=np.int32) * 3) % 61
+        outs = []
+        for tail in ([1, 2], [5, 6]):
+            eng.submit(np.concatenate([prefix, tail]).astype(np.int32), 5)
+            outs.extend(eng.run_until_idle())
+        assert eng.prefix_cache.stats["hits"] == 1
+        for c, tail in zip(outs, ([1, 2], [5, 6])):
+            want = np.asarray(
+                Generator(apply_fn, init_fn, GenerationConfig(max_new_tokens=5))(
+                    gparams,
+                    jnp.asarray(np.concatenate([prefix, tail]).astype(np.int32)[None]),
+                )
+            )[0, len(prefix) + 2 :]
+            np.testing.assert_array_equal(c.tokens, want)
+
+    def test_copy_compile_discipline(self, params):
+        """Hits and promotions reuse <= 2 compiles per bucket (hit and
+        promote directions differ in shape when pool rows != slots); decode
+        and prefill counts are untouched by cache traffic."""
+        trace = serving.shared_prefix_trace(
+            12, 200.0, vocab_size=61, n_prefixes=2, prefix_len=32,
+            tail_lens=(3, 8), new_tokens=(4, 8), seed=9,
+        )
+        eng = _engine(params, slots=3, prefix_cache_rows=4, decode_block=2)
+        eng.serve(trace)
+        assert eng.prefix_cache.stats["hits"] > 0
+        assert eng._decode._cache_size() == 1
+        assert eng._prefill._cache_size() <= len(eng.buckets)
+        assert eng._copy._cache_size() <= 2 * len(eng.buckets)
+        assert set(eng.copy_signatures) <= set(eng.buckets)
+
+    def test_atx302_copy_fn_no_drift(self, params):
+        """The lint-lane contract for the copy kernel: repeated calls at
+        one bucket present identical signatures (no per-request drift)."""
+        from accelerate_tpu import analysis
+
+        eng = _engine(params, prefix_cache_rows=4)
+        report = analysis.lint_step(
+            eng.copy_fn_for_bucket(8),
+            *eng.abstract_copy_args(),
+            alternates=[eng.abstract_copy_args()],
+            donate_argnums=(0,),
+        )
+        assert not report.filter(family="ATX302"), [str(f) for f in report.findings]
+        assert not report.has_errors, [str(f) for f in report.findings]
+
+    def test_shared_prefix_poisson_smoke(self, params):
+        """The `make smoke-serve` prefix contract: a shared-system-prompt
+        Poisson trace completes with hit_rate > 0, >= 50% of prompt tokens
+        served from cache, and bit-identity against the cache-off engine."""
+        trace = serving.shared_prefix_trace(
+            12, 150.0, vocab_size=61, n_prefixes=1, prefix_len=32,
+            tail_lens=(3, 8), new_tokens=(4, 8), seed=13,
+        )
+        eng = _engine(params, slots=3, prefix_cache_rows=4)
+        outs = {c.rid: c for c in eng.serve(trace)}
+        assert len(outs) == 12 and eng.stats["completed"] == 12
+        m = eng.prefix_metrics()
+        assert m["prefix_hit_rate"] > 0
+        assert m["prefill_saved_frac"] >= 0.5, m
+        off = _engine(params, slots=3, prefix_cache=False)
+        for c in off.serve(trace):
+            np.testing.assert_array_equal(outs[c.rid].tokens, c.tokens)
+
+
+class TestStopAndBudget:
+    def test_stop_sequence_truncates_and_matches_solo_prefix(self, params):
+        """Pick a 2-token window from the solo greedy stream as the stop
+        sequence: the served stream must equal the solo stream up to and
+        including the stop match, with finish_reason 'stop'."""
+        prompt = (np.arange(9, dtype=np.int32) * 11) % 61
+        free = _solo(params, prompt, 12)
+        stop = tuple(int(t) for t in free[4:6])
+        eng = _engine(params)
+        eng.submit(prompt, 12, stop_sequences=[stop])
+        (c,) = eng.run_until_idle()
+        assert c.finish_reason == "stop"
+        assert c.n_new == 6
+        # tokens keeps the (max_new_tokens,) padded layout; the generated
+        # region up to the stop match equals the solo stream.
+        np.testing.assert_array_equal(c.tokens[:6], free[:6])
+        assert not c.tokens[6:].any()  # pad after the stop
+
+    def test_stop_sequence_not_hit_runs_to_budget(self, params):
+        prompt = (np.arange(9, dtype=np.int32) * 11) % 61
+        eng = _engine(params)
+        eng.submit(prompt, 7, stop_sequences=[(60, 60, 60, 60)])
+        (c,) = eng.run_until_idle()
+        assert c.finish_reason == "length" and c.n_new == 7
+
+    def test_eos_reports_eos_reason(self, params):
+        prompt = np.arange(5, dtype=np.int32) % 61
+        free = _solo(params, prompt, 8)
+        eos = int(free[2])
+        config = GenerationConfig(max_new_tokens=8, eos_token_id=eos, pad_token_id=0)
+        eng = _engine(params, config)
+        eng.submit(prompt, 8)
+        (c,) = eng.run_until_idle()
+        assert c.finish_reason == "eos" and c.n_new == 3
+
+    def test_per_request_budget_override(self, params):
+        """submit() without max_new_tokens falls back to the engine
+        config's budget; an explicit value overrides it per request."""
+        config = GenerationConfig(max_new_tokens=5)
+        eng = _engine(params, config)
+        prompt = np.arange(6, dtype=np.int32) % 61
+        rid_default = eng.submit(prompt)
+        rid_long = eng.submit(prompt, 9, seed=0)
+        outs = {c.rid: c for c in eng.run_until_idle()}
+        assert outs[rid_default].n_new == 5
+        assert outs[rid_long].n_new == 9
+        np.testing.assert_array_equal(
+            outs[rid_long].tokens[:5], outs[rid_default].tokens
+        )
+
+    def test_empty_stop_sequence_rejected(self, params):
+        eng = _engine(params)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.arange(4, dtype=np.int32), 4, stop_sequences=[()])
