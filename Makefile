@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-resilience lint-graph
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-resilience lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -38,6 +38,17 @@ lint-graph:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m accelerate_tpu.commands.cli lint examples --severity error
 
+# Multi-host SPMD-consistency lint (ATX5xx, docs/static_analysis.md): the
+# example train steps are re-traced under 2 simulated processes (divergent
+# jitted collectives fail), and the host-side save / preemption-exit loops
+# are replayed process-by-process so a collective-schedule divergence — the
+# kind that hangs a real pod — fails here instead.
+lint-multihost:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint --multihost 2 \
+		nlp_example lm_example cv_example save_path preemption_exit \
+		--severity error
+
 # CPU resilience lane (docs/fault_tolerance.md): fault-injected save/load
 # roundtrips (truncate / bit-flip / kill-9 mid-save must never lose the last
 # committed checkpoint), the SIGTERM-resume bit-identity subprocess smoke,
@@ -48,5 +59,5 @@ smoke-resilience:
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph smoke-serve smoke-resilience
+test-all: lint-graph lint-multihost smoke-serve smoke-resilience
 	python -m pytest tests/ -q --heavy

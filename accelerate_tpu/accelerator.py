@@ -251,6 +251,12 @@ class Accelerator:
             from . import resilience
 
             resilience.install_preemption_handler()
+        # GCE maintenance-event poller (resilience/gce.py): opt-in via
+        # ATX_GCE_PREEMPT_POLL_SECS — catches metadata preemption notices
+        # that arrive before (or without) the SIGTERM.
+        from . import resilience as _resilience
+
+        self._gce_poller = _resilience.maintenance_poller_from_env()
         self._preemption_exit_started = False
         self._preemption_sync_calls = 0
         self._flag_tensor: jax.Array | None = None
@@ -592,6 +598,22 @@ class Accelerator:
                 opt_shapes=jax.eval_shape(lambda: state.opt_state),
                 target="prepare_train_state",
             )
+            # ATX_LINT_PROCESSES=N (N >= 2) additionally proves the planned
+            # specs are process-independent: the same inference replayed
+            # under each simulated process_index must agree (ATX501).
+            import os
+
+            procs = int(os.environ.get("ATX_LINT_PROCESSES", "1") or "1")
+            if procs >= 2:
+                from .analysis import rules_multihost
+
+                shapes = jax.eval_shape(lambda: state.params)
+                report.findings.extend(
+                    rules_multihost.spec_consistency_findings(
+                        lambda: infer_param_specs(shapes, self.mesh, self.strategy),
+                        procs,
+                    )
+                )
             self._dispatch_lint(report, mode)
 
         params_shapes = jax.eval_shape(lambda: state.params)

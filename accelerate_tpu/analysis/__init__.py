@@ -16,12 +16,22 @@ emits structured `Finding` records across four rule families:
   drift across calls, dtype/weak-type flips;
 - **ATX4xx host sync & collectives** — callbacks/`debug.print` in the hot
   jaxpr, and collective byte accounting mined from the compiled HLO with a
-  threshold catching accidental full-param gathers.
+  threshold catching accidental full-param gathers;
+- **ATX5xx multi-host consistency** — a simulated-process replay harness
+  (`host_trace.replay_host_loop`) runs a host loop once per patched
+  `process_index`, records every owned collective's (op, signature, stack)
+  per process, and flags the first cross-process divergence: the
+  pod-hanging bug class (a SIGTERM flag checked locally, a barrier one
+  rank skips, dict-ordered collective issue) caught before it reaches a
+  pod. Opt-in runtime mirror: ``ATX_COLLECTIVE_LOG=1``
+  (`analysis.collective_log`).
 
 Three surfaces: `lint_step(fn, *abstract_args, mesh=...)` /
-`lint_training(accelerator, ...)` as a library, `Accelerator.prepare(...,
-lint="warn"|"error")` inline, and the `atx lint` CLI over the `examples/`
-entry points (`make lint-graph`). Rule catalogue: docs/static_analysis.md.
+`lint_training(accelerator, ...)` / `lint_host_loop(loop_fn,
+processes=N)` as a library, `Accelerator.prepare(..., lint="warn"|"error")`
+inline, and the `atx lint` CLI over the `examples/` entry points
+(`make lint-graph`, `make lint-multihost`). Rule catalogue:
+docs/static_analysis.md.
 """
 
 from .findings import AnalysisWarning, Finding, LintError, Report, Severity
@@ -29,6 +39,7 @@ from .engine import (
     DEFAULT_OPTIONS,
     LintContext,
     RuleSpec,
+    lint_host_loop,
     lint_specs,
     lint_step,
     lint_training,
@@ -36,10 +47,12 @@ from .engine import (
     rule,
 )
 from .hbm import HbmBreakdown, human_bytes, state_hbm_per_device, tree_device_bytes
+from .host_trace import HostEvent, HostTraceResult, replay_host_loop
 
 # Importing the rule modules registers their rules.
 from . import rules_collectives  # noqa: F401  (ATX4xx)
 from . import rules_donation  # noqa: F401  (ATX2xx)
+from . import rules_multihost  # noqa: F401  (ATX5xx)
 from . import rules_recompile  # noqa: F401  (ATX3xx)
 from . import rules_sharding  # noqa: F401  (ATX1xx)
 
@@ -48,16 +61,20 @@ __all__ = [
     "DEFAULT_OPTIONS",
     "Finding",
     "HbmBreakdown",
+    "HostEvent",
+    "HostTraceResult",
     "LintContext",
     "LintError",
     "Report",
     "RuleSpec",
     "Severity",
     "human_bytes",
+    "lint_host_loop",
     "lint_specs",
     "lint_step",
     "lint_training",
     "registered_rules",
+    "replay_host_loop",
     "rule",
     "state_hbm_per_device",
     "tree_device_bytes",

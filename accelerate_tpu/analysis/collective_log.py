@@ -1,0 +1,150 @@
+"""Opt-in runtime mirror of the simulated collective log (``ATX_COLLECTIVE_LOG=1``).
+
+The simulated-process harness (`analysis/host_trace.py`) predicts the
+collective schedule ahead of time; this module records the REAL one. When
+``ATX_COLLECTIVE_LOG=1`` every owned collective entry point — the `ops/`
+host collectives, `ProcessState.wait_for_everyone`, and the checkpoint
+commit barrier in `resilience/commit.py` — appends one JSON line per call
+to ``collective_log_<proc>.jsonl`` under ``ATX_COLLECTIVE_LOG_DIR``
+(default: CWD). Multi-process fault-injection tests then call
+`verify_agreement` on the directory to assert every process issued the
+same ordered schedule — the runtime ground truth the ATX5xx rules
+approximate statically.
+
+Call sites import lazily (`_maybe_collective_log` helpers at each site do
+the env check before importing this module), so the analysis package stays
+off the hot path unless the flag is set.
+
+Process-index resolution order: ``ATX_COLLECTIVE_LOG_PROC`` (explicit test
+override) → `jax.process_index()` if jax is already imported →
+``ATX_PROCESS_ID`` (launcher contract) → 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+from typing import Any
+
+ENV_FLAG = "ATX_COLLECTIVE_LOG"
+ENV_DIR = "ATX_COLLECTIVE_LOG_DIR"
+ENV_PROC = "ATX_COLLECTIVE_LOG_PROC"
+
+LOG_FILE = "collective_log_{proc}.jsonl"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip().lower() in ("1", "true", "yes", "on")
+
+
+def _process_index() -> int:
+    explicit = os.environ.get(ENV_PROC)
+    if explicit is not None:
+        try:
+            return int(explicit)
+        except ValueError:
+            pass
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return int(jax.process_index())
+        except Exception:  # pragma: no cover - jax mid-init
+            pass
+    try:
+        return int(os.environ.get("ATX_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def log_path(proc: int | None = None) -> str:
+    proc = _process_index() if proc is None else proc
+    root = os.environ.get(ENV_DIR) or os.getcwd()
+    return os.path.join(root, LOG_FILE.format(proc=proc))
+
+
+def runtime_record(kind: str, name: str, signature: str = "") -> None:
+    """Append one collective event to this process's JSONL log. Never raises
+    (a logging failure must not take down a training step)."""
+    if not enabled():
+        return
+    try:
+        proc = _process_index()
+        entry = {
+            "kind": kind,
+            "name": name,
+            "signature": signature,
+            "process": proc,
+            "time": time.time(),
+            "stack": traceback.format_stack(limit=8)[:-1],
+        }
+        path = log_path(proc)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except Exception:  # pragma: no cover - best-effort by contract
+        pass
+
+
+def read_logs(directory: str) -> dict[int, list[dict[str, Any]]]:
+    """Load every ``collective_log_<proc>.jsonl`` under ``directory`` into
+    ``{proc: [event, ...]}`` (events in issue order)."""
+    logs: dict[int, list[dict[str, Any]]] = {}
+    if not os.path.isdir(directory):
+        return logs
+    for fname in sorted(os.listdir(directory)):
+        if not (fname.startswith("collective_log_") and fname.endswith(".jsonl")):
+            continue
+        try:
+            proc = int(fname[len("collective_log_") : -len(".jsonl")])
+        except ValueError:
+            continue
+        events = []
+        with open(os.path.join(directory, fname)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue
+        logs[proc] = events
+    return logs
+
+
+def verify_agreement(directory: str) -> list[str]:
+    """Align the recorded per-process logs; return human-readable mismatch
+    descriptions (empty = every process issued the same collective schedule).
+
+    This is the runtime analog of the ATX5xx alignment: same event count,
+    and at each position the same (kind, name, signature) triple.
+    """
+    logs = read_logs(directory)
+    if len(logs) < 2:
+        return []
+    procs = sorted(logs)
+    base_proc = procs[0]
+    base = logs[base_proc]
+    errors: list[str] = []
+    for proc in procs[1:]:
+        other = logs[proc]
+        for i, (a, b) in enumerate(zip(base, other)):
+            ka = (a["kind"], a["name"], a.get("signature", ""))
+            kb = (b["kind"], b["name"], b.get("signature", ""))
+            if ka != kb:
+                errors.append(
+                    f"event {i}: process {base_proc} issued {ka} but "
+                    f"process {proc} issued {kb}"
+                )
+                break
+        else:
+            if len(base) != len(other):
+                errors.append(
+                    f"event count mismatch: process {base_proc} issued "
+                    f"{len(base)} collective(s), process {proc} issued "
+                    f"{len(other)}"
+                )
+    return errors

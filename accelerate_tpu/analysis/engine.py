@@ -134,6 +134,8 @@ class LintContext:
         opt_specs: Any = None,
         strategy: Any = None,
         alternates: Sequence[Sequence[Any]] = (),
+        host_trace: Any = None,
+        processes: int = 1,
         options: dict[str, Any] | None = None,
     ) -> None:
         unknown = set(options or ()) - set(DEFAULT_OPTIONS)
@@ -150,6 +152,8 @@ class LintContext:
         self.opt_specs = opt_specs
         self.strategy = strategy
         self.alternates = tuple(tuple(a) for a in alternates)
+        self.host_trace = host_trace
+        self.processes = int(processes)
         self.options = {**DEFAULT_OPTIONS, **(options or {})}
         self.spec_warnings: list[ShardingSpecWarning] = []
         self.lowering_warnings: list[warnings.WarningMessage] = []
@@ -339,6 +343,7 @@ def _run(ctx: LintContext, only: Sequence[str] | None, strict: bool, target: str
     # them, but guard against direct-engine use.
     from . import rules_collectives  # noqa: F401
     from . import rules_donation  # noqa: F401
+    from . import rules_multihost  # noqa: F401
     from . import rules_recompile  # noqa: F401
     from . import rules_sharding  # noqa: F401
 
@@ -347,6 +352,8 @@ def _run(ctx: LintContext, only: Sequence[str] | None, strict: bool, target: str
         if only is not None and spec.rule_id not in only:
             continue
         if "fn" in spec.needs and ctx.fn is None:
+            continue
+        if "host_trace" in spec.needs and ctx.host_trace is None:
             continue
         try:
             findings.extend(spec.fn(ctx))
@@ -383,6 +390,7 @@ def lint_step(
     opt_shapes: Any = None,
     strategy: Any = None,
     alternates: Sequence[Sequence[Any]] = (),
+    processes: int = 1,
     rules: Sequence[str] | None = None,
     strict: bool = False,
     target: str = "",
@@ -397,8 +405,10 @@ def lint_step(
     batch); the recompilation rules diff them against the primary one.
     ``param_specs``/``opt_specs``/``strategy``/``params_shapes`` feed the
     sharding rules when linting a training step; omit them for a plain
-    function and only the fn-shaped rules run. Threshold keyword overrides:
-    see `DEFAULT_OPTIONS`.
+    function and only the fn-shaped rules run. ``processes=N`` additionally
+    traces the step once per simulated process (patched
+    ``jax.process_index``) and flags process-dependent programs (ATX501).
+    Threshold keyword overrides: see `DEFAULT_OPTIONS`.
     """
     ctx = LintContext(
         fn=fn,
@@ -412,9 +422,62 @@ def lint_step(
         opt_specs=opt_specs,
         strategy=strategy,
         alternates=alternates,
+        processes=processes,
         options=options or None,
     )
     return _run(ctx, rules, strict, target)
+
+
+def lint_host_loop(
+    loop_fn: Callable[[], Any],
+    *,
+    processes: int = 2,
+    env: Any = None,
+    preempted: Sequence[int] = (),
+    max_rounds: int = 3,
+    rules: Sequence[str] | None = None,
+    strict: bool = False,
+    target: str = "",
+    **options: Any,
+) -> Report:
+    """Replay a host-side step/save/serve loop once per simulated process
+    and lint the recorded collective schedules (the ATX5xx family).
+
+    ``loop_fn`` is a zero-arg callable — it may freely construct
+    Accelerators, call `ops` collectives, save checkpoints, read the
+    preemption flag, and branch on `jax.process_index()`; every owned
+    collective entry point is intercepted (`host_trace.replay_host_loop`).
+    ``preempted`` marks simulated processes whose preemption flag starts
+    set — the SIGTERM-skew scenario. ``env`` is a common env-delta dict or
+    ``{process: {...}}`` per-process deltas.
+    """
+    from .host_trace import replay_host_loop
+
+    result = replay_host_loop(
+        loop_fn,
+        processes=processes,
+        env=env,
+        preempted=preempted,
+        max_rounds=max_rounds,
+    )
+    ctx = LintContext(
+        host_trace=result, processes=processes, options=options or None
+    )
+    report = _run(ctx, rules, strict, target)
+    if result.errors:
+        report.extend(
+            Finding(
+                "ATX000",
+                Severity.WARNING,
+                f"process{p}",
+                f"simulated process {p} raised during replay: {msg} — the "
+                "collective log for this process may be truncated",
+                "if the loop needs real multi-process results to run, gate "
+                "the failing section on the replay's patched collectives",
+            )
+            for p, msg in sorted(result.errors.items())
+        )
+    return report
 
 
 def lint_specs(
@@ -457,6 +520,7 @@ def lint_training(
     donate: bool = True,
     batch_alternates: Sequence[Any] = (),
     rng: Any = None,
+    processes: int = 1,
     rules: Sequence[str] | None = None,
     strict: bool = False,
     target: str = "",
@@ -522,6 +586,7 @@ def lint_training(
         opt_shapes=opt_shapes,
         strategy=accelerator.strategy,
         alternates=[(state_sds, to_batch_sds(b)) for b in batch_alternates],
+        processes=processes,
         rules=rules,
         strict=strict,
         target=target,

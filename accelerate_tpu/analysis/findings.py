@@ -47,13 +47,19 @@ class AnalysisWarning(UserWarning):
 
 @dataclass(frozen=True)
 class Finding:
-    """One lint hit, anchored to a pytree path (or arg index) in the step."""
+    """One lint hit, anchored to a pytree path (or arg index) in the step.
+
+    ``data`` carries optional machine-readable detail (e.g. ATX404's
+    per-collective byte table) for the JSON surfaces; it never renders in
+    `format()` and is excluded from equality/hashing so findings stay
+    comparable by their human-facing identity."""
 
     rule_id: str
     severity: Severity
     path: str
     message: str
     fix_hint: str = ""
+    data: dict | None = field(default=None, compare=False)
 
     def format(self) -> str:
         where = f" {self.path}" if self.path else ""
@@ -65,6 +71,8 @@ class Finding:
     def to_dict(self) -> dict:
         d = asdict(self)
         d["severity"] = str(self.severity)
+        if d.get("data") is None:
+            d.pop("data", None)
         return d
 
 

@@ -1,0 +1,688 @@
+"""Simulated-process replay of a host-side loop: the ATX5xx data source.
+
+The PR-4 bug class — host control flow that diverges across processes and
+sends one rank into a collective its peers never issue — hangs a real pod
+and is invisible to single-process tests. This module makes it visible
+ahead of time, in the spirit of MPI deadlock verifiers (MUST/ISP
+match-order checking) reduced to the JAX SPMD world:
+
+`replay_host_loop(loop_fn, processes=N)` runs ``loop_fn`` once *per
+simulated process*, with `jax.process_index`/`jax.process_count` patched,
+the state singletons isolated, per-process env deltas applied, and every
+owned collective entry point intercepted:
+
+- the `ops/` host collectives (gather / reduce / broadcast /
+  gather_object / broadcast_object_list — `pad_across_processes` routes
+  through the patched `gather_object`),
+- `ProcessState.wait_for_everyone` (the `multihost_utils`-style barrier),
+- the checkpoint commit barrier in `resilience/commit.py`
+  (mark_precommit / wait_for_precommit / commit_dir),
+- the preemption flag reads in `resilience/preemption.py` (recorded as
+  annotations so ATX502 can tie a divergence to the flag that caused it),
+- jitted-fn dispatch identity (`jax.jit` products record which compiled
+  function each process actually invoked, with the abstract call
+  signature).
+
+Each intercepted call appends a `HostEvent` (op kind, name, abstract
+operand signature, small-integer value fingerprint, call-site stack) to
+that process's ordered **collective log**. The ATX5xx rules in
+`rules_multihost.py` then align the N logs and report the first
+divergence with both stacks.
+
+**Group semantics under sequential replay.** Processes run in index order
+within a *round*; a collective's group result is assembled from the peer
+operands recorded at the same log position — current-round operands for
+peers that already ran, previous-round operands for peers that haven't.
+Round 0 therefore resolves lower-index peers exactly and falls back to
+the caller's own operand for the rest; the replay iterates (``max_rounds``,
+default 3) until every process's event sequence is identical to its
+previous round — a fixpoint that lets information flow "backwards"
+(e.g. process 1 adopting process 0's or-reduced preemption flag).
+
+What the model cannot see: real wall-clock interleaving, per-process file
+I/O content (each simulated process writes into the same local
+filesystem), device-level collectives inside compiled code (GSPMD's
+problem, checked by ATX4xx), and host effects outside the patched entry
+points. docs/static_analysis.md lists the limits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import re
+import traceback
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator
+
+_THIS_FILE = os.path.abspath(__file__)
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+# Event kinds that participate in cross-process schedule alignment. The
+# rest (flag_read, flag_set, commit, precommit_wait, exit, error) are
+# per-process annotations: legitimately asymmetric (proc-0-only commit) or
+# metadata the rules consult (flag values for ATX502).
+ALIGNED_KINDS = frozenset(
+    {
+        "gather",
+        "reduce",
+        "broadcast",
+        "gather_object",
+        "broadcast_object_list",
+        "barrier",
+        "precommit",
+        "dispatch",
+    }
+)
+
+
+def sanitize_signature(text: str) -> str:
+    """Strip memory addresses from reprs (treedefs embed ``<function ... at
+    0x7f..>`` for optax/lambda nodes, which differ per replay run)."""
+    return _ADDR_RE.sub("0x…", text)
+
+
+def tree_signature(tree: Any) -> str:
+    """Abstract signature of a pytree: structure + per-leaf shape:dtype.
+    Values never enter the signature — two processes passing different
+    *numbers* through the same collective still align."""
+    import jax
+
+    def leaf_sig(x: Any) -> str:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return f"{tuple(x.shape)}:{x.dtype}"
+        return type(x).__name__
+
+    try:
+        structure = jax.tree.structure(tree)
+        leaves = [leaf_sig(leaf) for leaf in jax.tree.leaves(tree)]
+        return sanitize_signature(f"{structure}|{leaves}")
+    except Exception:
+        return sanitize_signature(type(tree).__name__)
+
+
+def tree_fingerprint(tree: Any) -> str:
+    """Value hash of the *small integer* leaves only (flags, counters,
+    uint32 PRNG keys — the things host control flow branches on and ATX504
+    compares). Floats and big tensors are excluded so numeric churn never
+    breaks the replay fixpoint."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha1()
+    found = False
+    for leaf in jax.tree.leaves(tree):
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        if arr.dtype.kind in "iub" and arr.nbytes <= 1024:
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+            found = True
+    return h.hexdigest()[:12] if found else ""
+
+
+def _capture_stack(limit: int = 6) -> str:
+    frames = traceback.extract_stack()
+    keep = [
+        f
+        for f in frames
+        if os.path.abspath(f.filename) != _THIS_FILE
+        and "contextlib" not in os.path.basename(f.filename)
+    ]
+    return "".join(traceback.format_list(keep[-limit:])).rstrip()
+
+
+@dataclasses.dataclass(frozen=True)
+class HostEvent:
+    """One intercepted host-side event in a simulated process's log."""
+
+    kind: str  # gather/reduce/broadcast/.../barrier/precommit/dispatch/...
+    name: str  # op detail: reduction kind, barrier name, jitted fn name
+    signature: str  # abstract operand signature (sanitized)
+    fingerprint: str  # value hash of small integer leaves ("" if none)
+    stack: str  # formatted user call stack
+    process: int
+    index: int  # position in this process's full log
+    collective: bool = True  # participates in schedule alignment
+    cpos: int = -1  # position among this process's COLLECTIVE events
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        """Alignment identity: what must agree across processes."""
+        return (self.kind, self.name, self.signature)
+
+    def describe(self) -> str:
+        sig = self.signature
+        if len(sig) > 120:
+            sig = sig[:117] + "..."
+        return f"{self.kind}:{self.name}" + (f" {sig}" if sig else "")
+
+
+@dataclasses.dataclass
+class HostTraceResult:
+    """The aligned input to the ATX5xx rules: one ordered log per process."""
+
+    logs: dict[int, list[HostEvent]]
+    processes: int
+    rounds: int
+    converged: bool
+    errors: dict[int, str] = dataclasses.field(default_factory=dict)
+
+    def collectives(self, process: int) -> list[HostEvent]:
+        """The alignment-relevant subsequence of one process's log."""
+        return [e for e in self.logs.get(process, []) if e.collective]
+
+    def annotations(self, process: int) -> list[HostEvent]:
+        return [e for e in self.logs.get(process, []) if not e.collective]
+
+
+class _SimWorld:
+    """Cross-round state: recorders from the current and previous round."""
+
+    def __init__(self, processes: int) -> None:
+        self.processes = processes
+        self.current: dict[int, "_Recorder"] = {}
+        self.previous: dict[int, "_Recorder"] = {}
+
+    def peer(self, q: int) -> "_Recorder | None":
+        # Within a round processes run in index order, so a lower-index
+        # peer's current-round log exists by the time a higher-index
+        # process asks; higher-index peers resolve from the previous round.
+        return self.current.get(q) or self.previous.get(q)
+
+
+class _Recorder:
+    """Per-(round, process) collective log + the sim's preemption flag."""
+
+    def __init__(self, world: _SimWorld, process: int, preempted: bool) -> None:
+        self.world = world
+        self.process = process
+        self.preempted = preempted
+        self.events: list[HostEvent] = []
+        self.collective_events: list[HostEvent] = []
+        self.operands: dict[int, Any] = {}
+        self.error: str | None = None
+
+    def record(
+        self,
+        kind: str,
+        name: str,
+        tree: Any = None,
+        *,
+        signature: str | None = None,
+        fingerprint: str | None = None,
+        collective: bool | None = None,
+    ) -> HostEvent:
+        index = len(self.events)
+        if signature is None:
+            signature = tree_signature(tree) if tree is not None else ""
+        if fingerprint is None:
+            fingerprint = tree_fingerprint(tree) if tree is not None else ""
+        if collective is None:
+            collective = kind in ALIGNED_KINDS
+        event = HostEvent(
+            kind=kind,
+            name=name,
+            signature=signature,
+            fingerprint=fingerprint,
+            stack=_capture_stack(),
+            process=self.process,
+            index=index,
+            collective=collective,
+            cpos=len(self.collective_events) if collective else -1,
+        )
+        self.events.append(event)
+        if collective:
+            self.collective_events.append(event)
+        if tree is not None:
+            self.operands[index] = tree
+        return event
+
+    def peer_operand(self, own_event: HostEvent, q: int) -> Any | None:
+        """Peer q's operand at the same *collective* position — per-process
+        annotations (flag reads, proc-0-only commits) shift full-log
+        indices, so alignment is by position in the collective subsequence.
+        Only a peer whose event there has the same alignment key
+        contributes (a diverged peer yields None; the caller falls back to
+        its own operand)."""
+        rec = self.world.peer(q)
+        if rec is None or own_event.cpos < 0:
+            return None
+        if own_event.cpos >= len(rec.collective_events):
+            return None
+        peer_event = rec.collective_events[own_event.cpos]
+        if peer_event.key != own_event.key:
+            return None
+        return rec.operands.get(peer_event.index)
+
+    def group_operands(self, own_event: HostEvent, own_tree: Any) -> list[Any]:
+        out: list[Any] = []
+        for q in range(self.world.processes):
+            if q == self.process:
+                out.append(own_tree)
+            else:
+                peer = self.peer_operand(own_event, q)
+                out.append(own_tree if peer is None else peer)
+        return out
+
+
+_ACTIVE_RECORDER: _Recorder | None = None
+
+
+# ------------------------------------------------------------- collective stubs
+def _stub_gather(rec: _Recorder) -> Callable:
+    import jax
+    import numpy as np
+
+    def gather(tree: Any) -> Any:
+        event = rec.record("gather", "gather", tree)
+        trees = rec.group_operands(event, tree)
+        try:
+            return jax.tree.map(
+                lambda *xs: np.concatenate(
+                    [np.atleast_1d(np.asarray(x)) for x in xs], axis=0
+                ),
+                *trees,
+            )
+        except Exception:
+            return jax.tree.map(
+                lambda x: np.concatenate(
+                    [np.atleast_1d(np.asarray(x))] * rec.world.processes, axis=0
+                ),
+                tree,
+            )
+
+    return gather
+
+
+def _stub_reduce(rec: _Recorder) -> Callable:
+    import jax
+    import numpy as np
+
+    def reduce(tree: Any, reduction: str = "mean") -> Any:
+        if reduction == "none":
+            return tree
+        event = rec.record("reduce", f"reduce[{reduction}]", tree)
+        trees = rec.group_operands(event, tree)
+
+        def _combine(*xs: Any) -> Any:
+            arrs = [np.asarray(x) for x in xs]
+            out = arrs[0].astype(np.float64, copy=True)
+            for a in arrs[1:]:
+                out = out + a
+            if reduction == "mean":
+                out = out / len(arrs)
+            return out.astype(arrs[0].dtype)
+
+        try:
+            return jax.tree.map(_combine, *trees)
+        except Exception:
+            return jax.tree.map(
+                lambda x: (
+                    np.asarray(x)
+                    if reduction == "mean"
+                    else np.asarray(x) * rec.world.processes
+                ).astype(np.asarray(x).dtype),
+                tree,
+            )
+
+    return reduce
+
+
+def _stub_broadcast(rec: _Recorder) -> Callable:
+    import jax
+    import numpy as np
+
+    def broadcast(tree: Any, from_process: int = 0) -> Any:
+        event = rec.record("broadcast", f"broadcast[from={from_process}]", tree)
+        src = (
+            tree
+            if from_process == rec.process
+            else rec.peer_operand(event, from_process)
+        )
+        chosen = tree if src is None else src
+        try:
+            return jax.tree.map(lambda x: np.asarray(x).copy(), chosen)
+        except Exception:
+            return chosen
+
+    return broadcast
+
+
+def _stub_gather_object(rec: _Recorder) -> Callable:
+    def gather_object(objects: list[Any]) -> list[Any]:
+        # Object channels carry control metadata of per-process shape (the
+        # source broadcasts a payload, peers pass templates/None), so only
+        # the element COUNT enters the alignment signature.
+        event = rec.record(
+            "gather_object",
+            "gather_object",
+            signature=f"objects[{len(objects)}]",
+        )
+        rec.operands[event.index] = list(objects)
+        out: list[Any] = []
+        for q in range(rec.world.processes):
+            if q == rec.process:
+                out.extend(objects)
+            else:
+                peer = rec.peer_operand(event, q)
+                out.extend(list(objects) if peer is None else peer)
+        return out
+
+    return gather_object
+
+
+def _stub_broadcast_object_list(rec: _Recorder) -> Callable:
+    def broadcast_object_list(objects: list[Any], from_process: int = 0) -> list[Any]:
+        event = rec.record(
+            "broadcast_object_list",
+            f"broadcast_object_list[from={from_process}]",
+            signature=f"objects[{len(objects)}]",
+        )
+        rec.operands[event.index] = list(objects)
+        if from_process == rec.process:
+            return list(objects)
+        peer = rec.peer_operand(event, from_process)
+        return list(objects) if peer is None else list(peer)
+
+    return broadcast_object_list
+
+
+def _stub_wait_for_everyone(rec: _Recorder) -> Callable:
+    def wait_for_everyone(self) -> None:  # bound as a ProcessState method
+        rec.record("barrier", "wait_for_everyone")
+
+    return wait_for_everyone
+
+
+def _stub_mark_precommit(rec: _Recorder, real: Callable) -> Callable:
+    def mark_precommit(tmp_dir: str, proc: int) -> None:
+        rec.record("precommit", "mark_precommit")
+        real(tmp_dir, proc)
+
+    return mark_precommit
+
+
+def _stub_wait_for_precommit(rec: _Recorder) -> Callable:
+    def wait_for_precommit(
+        tmp_dir: str, num_processes: int, timeout_secs: float
+    ) -> None:
+        # Proc-0-only annotation; never actually waits (peers run later in
+        # the same round). Clean up any markers the real mark_precommit
+        # wrote so they don't land in the committed directory.
+        rec.record("precommit_wait", "wait_for_precommit", collective=False)
+        from ..resilience.commit import PRECOMMIT_FILE
+
+        for p in range(num_processes):
+            try:
+                os.remove(os.path.join(tmp_dir, PRECOMMIT_FILE.format(proc=p)))
+            except OSError:
+                pass
+
+    return wait_for_precommit
+
+
+def _stub_commit_dir(rec: _Recorder, real: Callable) -> Callable:
+    def commit_dir(tmp_dir: str, final_dir: str, meta: Any = None) -> None:
+        rec.record("commit", "commit_dir", collective=False)
+        real(tmp_dir, final_dir, meta)
+
+    return commit_dir
+
+
+def _stub_preemption(rec: _Recorder) -> tuple[Callable, Callable, Callable]:
+    def preemption_requested() -> bool:
+        rec.record(
+            "flag_read",
+            "preemption_requested",
+            fingerprint=str(int(rec.preempted)),
+            collective=False,
+        )
+        return rec.preempted
+
+    def request_preemption() -> None:
+        rec.record("flag_set", "request_preemption", collective=False)
+        rec.preempted = True
+
+    def clear_preemption() -> None:
+        rec.preempted = False
+
+    return preemption_requested, request_preemption, clear_preemption
+
+
+class _DispatchRecorder:
+    """Wraps a `jax.jit` product: records which compiled function each
+    simulated process dispatches (and on what abstract signature), then
+    calls through. Attribute access (``.lower``, ``.trace`` …) passes
+    through so the wrapper stays a drop-in jitted callable."""
+
+    def __init__(self, jitted: Callable, name: str) -> None:
+        object.__setattr__(self, "_atx_jitted", jitted)
+        object.__setattr__(self, "_atx_name", name)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        rec = _ACTIVE_RECORDER
+        if rec is not None:
+            rec.record(
+                "dispatch",
+                self._atx_name,
+                signature=tree_signature((args, kwargs)),
+            )
+        return self._atx_jitted(*args, **kwargs)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_atx_jitted"), name)
+
+
+def _patched_jit(orig_jit: Callable) -> Callable:
+    def jit(fn: Callable | None = None, *args: Any, **kwargs: Any) -> Any:
+        if fn is None:
+
+            def deco(f: Callable) -> Any:
+                return jit(f, *args, **kwargs)
+
+            return deco
+        jitted = orig_jit(fn, *args, **kwargs)
+        return _DispatchRecorder(jitted, getattr(fn, "__name__", "jitted"))
+
+    return jit
+
+
+# -------------------------------------------------------------------- patching
+@contextmanager
+def simulated_process(
+    process: int, process_count: int, env: dict[str, str] | None = None
+) -> Iterator[None]:
+    """Impersonate one SPMD process: patch `jax.process_index`/`process_count`
+    (safe — jax internals resolve theirs through `jax._src.xla_bridge`,
+    only user/host code sees the patch), isolate the shared-``__dict__``
+    state singletons, and apply env deltas. Restores everything on exit."""
+    import jax
+
+    from .. import state as _state
+
+    deltas = {"ATX_PREEMPTION_HANDLER": "0", **(env or {})}
+    saved_env: dict[str, str | None] = {}
+    for key, value in deltas.items():
+        saved_env[key] = os.environ.get(key)
+        os.environ[key] = value
+
+    orig_pi, orig_pc = jax.process_index, jax.process_count
+    jax.process_index = lambda backend=None: process
+    jax.process_count = lambda backend=None: process_count
+
+    singletons = (
+        _state.ProcessState,
+        _state.AcceleratorState,
+        _state.GradientState,
+    )
+    # The shared dict IS every instance's __dict__ — save/restore its
+    # CONTENTS, never swap the dict object.
+    saved_states = [(cls, dict(cls._shared_state)) for cls in singletons]
+    for cls in singletons:
+        cls._shared_state.clear()
+    try:
+        yield
+    finally:
+        for cls, saved in saved_states:
+            cls._shared_state.clear()
+            cls._shared_state.update(saved)
+        jax.process_index, jax.process_count = orig_pi, orig_pc
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+@contextmanager
+def _patched_world(rec: _Recorder) -> Iterator[None]:
+    """Swap every owned collective entry point for the recorder's stubs.
+    Patches module attrs AND every by-value re-export site (`ops` package,
+    `resilience` package, the top-level `accelerate_tpu` namespace), so
+    `from .ops import collectives as _ops` and `resilience.request_preemption`
+    style call sites all land on the stubs."""
+    global _ACTIVE_RECORDER
+
+    import jax
+
+    import accelerate_tpu as _pkg
+
+    from .. import ops as _ops_pkg
+    from .. import resilience as _res_pkg
+    from ..ops import collectives as _coll
+    from ..resilience import commit as _commit
+    from ..resilience import preemption as _pre
+    from ..state import ProcessState
+
+    pre_req, pre_set, pre_clear = _stub_preemption(rec)
+    replacements: dict[str, Callable] = {
+        "gather": _stub_gather(rec),
+        "reduce": _stub_reduce(rec),
+        "broadcast": _stub_broadcast(rec),
+        "gather_object": _stub_gather_object(rec),
+        "broadcast_object_list": _stub_broadcast_object_list(rec),
+    }
+    commit_replacements: dict[str, Callable] = {
+        "mark_precommit": _stub_mark_precommit(rec, _commit.mark_precommit),
+        "wait_for_precommit": _stub_wait_for_precommit(rec),
+        "commit_dir": _stub_commit_dir(rec, _commit.commit_dir),
+    }
+    pre_replacements: dict[str, Callable] = {
+        "preemption_requested": pre_req,
+        "request_preemption": pre_set,
+        "clear_preemption": pre_clear,
+    }
+
+    patches: list[tuple[Any, str, Any]] = []
+
+    def patch(obj: Any, name: str, value: Any) -> None:
+        if hasattr(obj, name):
+            patches.append((obj, name, getattr(obj, name)))
+            setattr(obj, name, value)
+
+    for name, value in replacements.items():
+        patch(_coll, name, value)
+        patch(_ops_pkg, name, value)
+    for name, value in commit_replacements.items():
+        patch(_commit, name, value)
+        patch(_res_pkg, name, value)
+    for name, value in pre_replacements.items():
+        patch(_pre, name, value)
+        patch(_res_pkg, name, value)
+        patch(_pkg, name, value)
+    patch(ProcessState, "wait_for_everyone", _stub_wait_for_everyone(rec))
+    patch(jax, "jit", _patched_jit(jax.jit))
+
+    prev_recorder = _ACTIVE_RECORDER
+    _ACTIVE_RECORDER = rec
+    try:
+        yield
+    finally:
+        _ACTIVE_RECORDER = prev_recorder
+        for obj, name, orig in reversed(patches):
+            setattr(obj, name, orig)
+
+
+# ---------------------------------------------------------------------- replay
+def _env_for(env: Any, process: int) -> dict[str, str] | None:
+    if not env:
+        return None
+    if all(isinstance(k, int) for k in env):
+        return env.get(process)
+    return env
+
+
+def _logs_equal(a: dict[int, "_Recorder"], b: dict[int, "_Recorder"]) -> bool:
+    if set(a) != set(b):
+        return False
+    for p in a:
+        ea = [(e.kind, e.name, e.signature, e.fingerprint) for e in a[p].events]
+        eb = [(e.kind, e.name, e.signature, e.fingerprint) for e in b[p].events]
+        if ea != eb:
+            return False
+    return True
+
+
+def replay_host_loop(
+    loop_fn: Callable[[], Any],
+    *,
+    processes: int = 2,
+    env: dict[str, str] | dict[int, dict[str, str]] | None = None,
+    preempted: Any = (),
+    max_rounds: int = 3,
+) -> HostTraceResult:
+    """Run ``loop_fn`` once per simulated process (per round) and return the
+    per-process collective logs.
+
+    ``env`` is either a common env-delta dict or ``{process: {...}}``.
+    ``preempted`` lists simulated process indices whose preemption flag
+    starts set (the SIGTERM-skew scenario ATX502 exists for).
+    ``SystemExit`` from the loop is part of the preemption protocol and is
+    recorded, not raised; other exceptions are recorded as annotations and
+    reported via ``result.errors``.
+    """
+    if processes < 2:
+        raise ValueError("replay_host_loop needs processes >= 2")
+    world = _SimWorld(processes)
+    preempted_set = set(preempted)
+    converged = False
+    rounds = 0
+    for r in range(max_rounds):
+        rounds = r + 1
+        world.previous, world.current = world.current, {}
+        for p in range(processes):
+            rec = _Recorder(world, p, preempted=p in preempted_set)
+            with simulated_process(p, processes, env=_env_for(env, p)):
+                with _patched_world(rec):
+                    try:
+                        loop_fn()
+                    except SystemExit as e:
+                        rec.record(
+                            "exit",
+                            f"SystemExit({e.code})",
+                            collective=False,
+                        )
+                    except Exception as e:
+                        rec.error = f"{type(e).__name__}: {e}"
+                        rec.record(
+                            "error", f"{type(e).__name__}: {e}", collective=False
+                        )
+            world.current[p] = rec
+        if world.previous and _logs_equal(world.previous, world.current):
+            converged = True
+            break
+    return HostTraceResult(
+        logs={p: world.current[p].events for p in range(processes)},
+        processes=processes,
+        rounds=rounds,
+        converged=converged,
+        errors={
+            p: world.current[p].error
+            for p in range(processes)
+            if world.current[p].error
+        },
+    )

@@ -235,4 +235,10 @@ def atx404_traffic_summary(ctx: LintContext) -> Iterator[Finding]:
         "collective traffic per step (per-device result bytes): "
         + ", ".join(parts),
         "",
+        data={
+            "collectives": [
+                {"op": op, "count": count, "bytes": nbytes}
+                for op, (count, nbytes) in sorted(totals.items())
+            ]
+        },
     )

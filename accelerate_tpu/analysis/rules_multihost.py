@@ -1,0 +1,393 @@
+"""ATX5xx — multi-host SPMD consistency of the collective schedule.
+
+Input: a `HostTraceResult` from `host_trace.replay_host_loop` (the
+`lint_host_loop` surface, `atx lint --multihost N`), or — for ATX501's
+function variant — the step function itself traced once per simulated
+process. The rules align the N per-process collective logs and report the
+FIRST divergence with both processes' call stacks; one divergence yields
+exactly one finding, classified by cause:
+
+- **ATX501** divergent jitted/host collective sequence — a branch on
+  `process_index` changes what gets compiled or dispatched;
+- **ATX502** a process-local host flag guards a collective-bearing path
+  without group agreement (the PR-4 preemption bug: a SIGTERM flag
+  checked locally instead of or-reduced);
+- **ATX503** barrier/commit ordering mismatch in the save path;
+- **ATX504** per-process RNG values feeding a collective that expects
+  replicated operands (missing — or extra — `fold_in(process_index)`);
+- **ATX505** collective issue order derived from unordered dict/set
+  iteration (same multiset of collectives, different order).
+
+Classification precedence on the first divergence: ATX502 (the diverging
+processes read different flag values just before) → ATX503 (a barrier or
+commit-barrier event is on either side of the split) → ATX505 (the
+remaining schedules are permutations of each other) → ATX501 (everything
+else). ATX504 scans the *aligned* prefix independently — it is a value
+property, not a schedule property, and is WARNING severity because
+per-process keys are sometimes intended (data-parallel sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from .engine import LintContext, rule
+from .findings import Finding, Severity
+from .host_trace import HostEvent, HostTraceResult, sanitize_signature, simulated_process
+
+_FAMILY = "multihost"
+
+
+# ------------------------------------------------------------------ alignment
+def _indent(text: str, prefix: str = "      ") -> str:
+    return prefix + text.replace("\n", "\n" + prefix)
+
+
+def _analysis(ctx: LintContext) -> dict[str, Any]:
+    """Align the per-process collective logs once per context; every ATX5xx
+    rule reads the cached verdict so one divergence → one finding."""
+    cached = getattr(ctx, "_atx5_analysis", None)
+    if cached is not None:
+        return cached
+    result: HostTraceResult = ctx.host_trace
+    seqs = {p: result.collectives(p) for p in sorted(result.logs)}
+    min_len = min((len(s) for s in seqs.values()), default=0)
+    div: int | None = None
+    for i in range(min_len):
+        if len({seqs[p][i].key for p in seqs}) > 1:
+            div = i
+            break
+    if div is None and len({len(s) for s in seqs.values()}) > 1:
+        div = min_len  # one process's schedule simply ends early
+    events: dict[int, HostEvent | None] = {}
+    if div is not None:
+        events = {p: (seqs[p][div] if div < len(seqs[p]) else None) for p in seqs}
+    verdict, flags = _classify(result, seqs, div, events)
+    info = {
+        "seqs": seqs,
+        "index": div,
+        "events": events,
+        "rule": verdict,
+        "flags": flags,
+    }
+    ctx._atx5_analysis = info
+    return info
+
+
+def _classify(
+    result: HostTraceResult,
+    seqs: dict[int, list[HostEvent]],
+    div: int | None,
+    events: dict[int, HostEvent | None],
+) -> tuple[str | None, dict[int, HostEvent]]:
+    if div is None:
+        return None, {}
+    # ATX502: the diverging processes read DIFFERENT values from a host
+    # flag just before splitting — the un-agreed conditional is the cause.
+    flags: dict[int, HostEvent] = {}
+    for p in seqs:
+        limit = (
+            events[p].index if events[p] is not None else len(result.logs.get(p, []))
+        )
+        reads = [
+            e
+            for e in result.logs.get(p, [])
+            if e.kind == "flag_read" and e.index < limit
+        ]
+        if reads:
+            flags[p] = reads[-1]
+    if len(flags) >= 2 and len({e.fingerprint for e in flags.values()}) > 1:
+        return "ATX502", flags
+    # ATX503: a barrier (or the commit file-barrier) sits on either side of
+    # the split — save-path ordering bug.
+    kinds = {e.kind for e in events.values() if e is not None}
+    if kinds & {"barrier", "precommit"}:
+        return "ATX503", flags
+    # ATX505: every process issues the SAME multiset of collectives from
+    # here on, just in different orders — unordered-container iteration.
+    suffixes = {
+        p: tuple(sorted(repr(e.key) for e in seq[div:])) for p, seq in seqs.items()
+    }
+    if len(set(suffixes.values())) == 1:
+        return "ATX505", flags
+    return "ATX501", flags
+
+
+def _divergence_message(
+    seqs: dict[int, list[HostEvent]],
+    div: int,
+    events: dict[int, HostEvent | None],
+) -> str:
+    lines = [f"first cross-process divergence at collective #{div}:"]
+    for p in sorted(events):
+        e = events[p]
+        if e is None:
+            lines.append(
+                f"  process {p}: issues NO further collectives "
+                f"({len(seqs[p])} total) — its peers block forever in theirs"
+            )
+        else:
+            lines.append(f"  process {p}: {e.describe()}")
+            lines.append(_indent(e.stack, "      "))
+    return "\n".join(lines)
+
+
+def _path_for(div: int | None) -> str:
+    return f"collective#{div}" if div is not None else ""
+
+
+# ---------------------------------------------------------------------- rules
+@rule(
+    "ATX501",
+    Severity.ERROR,
+    _FAMILY,
+    "collective schedule diverges across processes (process_index branch "
+    "changes what gets compiled/dispatched)",
+    fix_hint="make every process issue the identical collective sequence: "
+    "hoist process_index branches out of collective-bearing paths, or make "
+    "the branch outcome a group decision (broadcast/reduce it first)",
+)
+def _atx501(ctx: LintContext) -> Iterator[Finding]:
+    if ctx.host_trace is not None:
+        info = _analysis(ctx)
+        if info["rule"] != "ATX501":
+            return
+        yield Finding(
+            "ATX501",
+            Severity.ERROR,
+            _path_for(info["index"]),
+            _divergence_message(info["seqs"], info["index"], info["events"]),
+            "every process must run the same host collective schedule; on a "
+            "real pod the minority rank wedges the whole group",
+        )
+        return
+    # Function variant (`lint_step(fn, ..., processes=N)`): trace the step
+    # once per simulated process and require identical jaxprs. jax's trace
+    # cache is keyed on the fn+avals, NOT on our patched process_index —
+    # clear it so each process really re-traces.
+    if ctx.fn is None or ctx.processes < 2:
+        return
+    import jax
+
+    texts: dict[int, str] = {}
+    failures: dict[int, str] = {}
+    for p in range(ctx.processes):
+        with simulated_process(p, ctx.processes):
+            jax.clear_caches()
+            try:
+                with ctx._mesh_ctx():
+                    jaxpr = jax.make_jaxpr(
+                        ctx.jitted, static_argnums=ctx.static_argnums
+                    )(*ctx.args)
+                texts[p] = sanitize_signature(str(jaxpr))
+            except Exception as e:
+                failures[p] = f"{type(e).__name__}: {e}"
+    jax.clear_caches()  # drop traces made under a patched process_index
+    if failures and texts:
+        yield Finding(
+            "ATX501",
+            Severity.ERROR,
+            "trace",
+            "the step traces on some processes but fails on others: "
+            + "; ".join(f"process {p}: {msg}" for p, msg in sorted(failures.items())),
+            "a step that only traces for certain process indices compiles "
+            "different programs per rank — or crashes a subset of the pod",
+        )
+        return
+    if len(set(texts.values())) > 1:
+        base_p = min(texts)
+        base_lines = texts[base_p].splitlines()
+        for p in sorted(texts):
+            if texts[p] == texts[base_p]:
+                continue
+            other_lines = texts[p].splitlines()
+            where = next(
+                (
+                    i
+                    for i, (a, b) in enumerate(zip(base_lines, other_lines))
+                    if a != b
+                ),
+                min(len(base_lines), len(other_lines)),
+            )
+
+            def _line(lines: list[str], i: int) -> str:
+                return lines[i].strip() if i < len(lines) else "<end of program>"
+
+            yield Finding(
+                "ATX501",
+                Severity.ERROR,
+                "trace",
+                f"the step traces to DIFFERENT programs on process {base_p} "
+                f"vs process {p} (first differing jaxpr line {where}:\n"
+                f"  process {base_p}: {_line(base_lines, where)}\n"
+                f"  process {p}: {_line(other_lines, where)})"
+                " — a branch on process_index changes what gets compiled, so "
+                "GSPMD emits mismatched collective programs across the pod",
+                "compute rank-dependent values as data (e.g. pass "
+                "process_index as an input) instead of branching the trace "
+                "on it",
+            )
+            return
+
+
+@rule(
+    "ATX502",
+    Severity.ERROR,
+    _FAMILY,
+    "host flag guards a collective-bearing path without group agreement",
+    fix_hint="or-reduce the flag across processes before acting on it "
+    "(the fixed preemption handler reduces the SIGTERM flag with "
+    "ops.reduce(..., 'sum') at every step entry)",
+    needs=("host_trace",),
+)
+def _atx502(ctx: LintContext) -> Iterator[Finding]:
+    info = _analysis(ctx)
+    if info["rule"] != "ATX502":
+        return
+    flags: dict[int, HostEvent] = info["flags"]
+    lines = [
+        "a process-local flag sent the processes down different "
+        "collective paths (the PR-4 hang class):",
+    ]
+    for p in sorted(flags):
+        e = flags[p]
+        lines.append(
+            f"  process {p} read {e.name} -> {e.fingerprint or '?'} at"
+        )
+        lines.append(_indent(e.stack, "      "))
+    lines.append(_divergence_message(info["seqs"], info["index"], info["events"]))
+    yield Finding(
+        "ATX502",
+        Severity.ERROR,
+        _path_for(info["index"]),
+        "\n".join(lines),
+        "a SIGTERM/maintenance notice lands on ONE process; every process "
+        "must agree (reduce the flag) before any of them changes its "
+        "collective schedule",
+    )
+
+
+@rule(
+    "ATX503",
+    Severity.ERROR,
+    _FAMILY,
+    "barrier/commit ordering mismatch across processes in the save path",
+    fix_hint="issue barriers and commit-barrier halves in the same order on "
+    "every process; keep proc-0-only work (commit_dir, rotation) strictly "
+    "between the same pair of barriers everywhere",
+    needs=("host_trace",),
+)
+def _atx503(ctx: LintContext) -> Iterator[Finding]:
+    info = _analysis(ctx)
+    if info["rule"] != "ATX503":
+        return
+    yield Finding(
+        "ATX503",
+        Severity.ERROR,
+        _path_for(info["index"]),
+        _divergence_message(info["seqs"], info["index"], info["events"]),
+        "a barrier one process never reaches (or reaches out of order) "
+        "deadlocks the checkpoint commit on a real pod",
+    )
+
+
+@rule(
+    "ATX504",
+    Severity.WARNING,
+    _FAMILY,
+    "per-process RNG value feeds a collective that expects replicated "
+    "operands",
+    fix_hint="either all processes pass the SAME key (drop the "
+    "fold_in(process_index)) or the collective is data-parallel by design "
+    "— then silence this by folding in explicitly at the call site",
+    needs=("host_trace",),
+)
+def _atx504(ctx: LintContext) -> Iterator[Finding]:
+    info = _analysis(ctx)
+    seqs = info["seqs"]
+    if not seqs:
+        return
+    min_len = min(len(s) for s in seqs.values())
+    end = min_len if info["index"] is None else info["index"]
+    for i in range(end):
+        events = {p: seqs[p][i] for p in seqs}
+        fps = {e.fingerprint for e in events.values()}
+        if len(fps) <= 1:
+            continue
+        if not any("(2,):uint32" in e.signature for e in events.values()):
+            continue
+        procs = sorted(events)
+        a, b = events[procs[0]], events[procs[-1]]
+        yield Finding(
+            "ATX504",
+            Severity.WARNING,
+            f"collective#{i}",
+            f"{a.describe()} receives a DIFFERENT PRNG-key value on each "
+            f"process (process {procs[0]} vs process {procs[-1]} "
+            "fingerprints differ) — replication-expecting collectives "
+            "(broadcast/reduce of sampling state) silently desync when fed "
+            "per-process keys:\n"
+            f"  process {procs[0]}:\n{_indent(a.stack)}\n"
+            f"  process {procs[-1]}:\n{_indent(b.stack)}",
+            "a missing or extra jax.random.fold_in(key, process_index) is "
+            "the usual cause",
+        )
+
+
+@rule(
+    "ATX505",
+    Severity.ERROR,
+    _FAMILY,
+    "collective issue order derived from unordered dict/set iteration",
+    fix_hint="iterate collections in a deterministic order (sorted keys / "
+    "insertion-ordered dicts shared by construction) before issuing "
+    "collectives from them",
+    needs=("host_trace",),
+)
+def _atx505(ctx: LintContext) -> Iterator[Finding]:
+    info = _analysis(ctx)
+    if info["rule"] != "ATX505":
+        return
+    yield Finding(
+        "ATX505",
+        Severity.ERROR,
+        _path_for(info["index"]),
+        "every process issues the SAME collectives but in DIFFERENT "
+        "orders — the signature of iterating an unordered container:\n"
+        + _divergence_message(info["seqs"], info["index"], info["events"]),
+        "mismatched collective order deadlocks exactly like a missing one: "
+        "each rank blocks in a different op",
+    )
+
+
+# ------------------------------------------------------- prepare() spec check
+def spec_consistency_findings(build: Any, processes: int) -> list[Finding]:
+    """Run a spec-producing callable once per simulated process and flag
+    divergent results — `Accelerator.prepare(lint=...)` uses this (under
+    ``ATX_LINT_PROCESSES``) to prove the planned parameter shardings don't
+    depend on `process_index`."""
+    reprs: dict[int, str] = {}
+    for p in range(processes):
+        with simulated_process(p, processes):
+            try:
+                reprs[p] = sanitize_signature(repr(build()))
+            except Exception as e:
+                reprs[p] = f"<failed: {type(e).__name__}: {e}>"
+    if len(set(reprs.values())) <= 1:
+        return []
+    base_p = min(reprs)
+    detail = "\n".join(
+        f"  process {p}: {'identical' if reprs[p] == reprs[base_p] and p != base_p else reprs[p][:200]}"
+        for p in sorted(reprs)
+    )
+    return [
+        Finding(
+            "ATX501",
+            Severity.ERROR,
+            "prepare",
+            "the planned parameter shardings differ across processes — "
+            "every process must compute identical PartitionSpecs or GSPMD "
+            "compiles mismatched programs:\n" + detail,
+            "sharding strategy decisions must not read process_index",
+        )
+    ]

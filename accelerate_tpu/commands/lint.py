@@ -44,6 +44,22 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="minimum severity to print",
     )
     p.add_argument("--format", dest="fmt", default="text", choices=["text", "json"])
+    p.add_argument(
+        "--json",
+        dest="json_lines",
+        action="store_true",
+        help="emit findings as JSON lines (one finding object per line, "
+        "machine-readable `data` included — e.g. the ATX404 byte table)",
+    )
+    p.add_argument(
+        "--multihost",
+        type=int,
+        default=0,
+        metavar="N",
+        help="also verify multi-host SPMD consistency (ATX5xx) by replaying "
+        "each scenario under N simulated processes; adds the host-loop "
+        "scenarios (save_path, preemption_exit) to the default set",
+    )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
         "--rules", action="store_true", help="list the registered rule catalogue"
@@ -229,34 +245,144 @@ SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
 }
 
 
+# ----------------------------------------------- multi-host (ATX5xx) scenarios
+# Host-side loops replayed under N simulated processes via
+# `analysis.lint_host_loop` — these verify the COLLECTIVE SCHEDULE (barrier /
+# commit / broadcast ordering across processes), not the compiled step.
+# Builders take `processes` and return (description, Report).
+
+
+def _mh_scenario_save_path(processes: int = 2):
+    """checkpointing.save_state: train one step then save synchronously —
+    the precommit markers, commit barrier, and final-dir broadcast must
+    issue an identical collective schedule on every process."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis, checkpointing
+    from ..accelerator import Accelerator, TrainState
+    from ..state import AcceleratorState
+    from ..utils.dataclasses import ProjectConfiguration
+
+    def save_loop():
+        AcceleratorState._reset_state()
+        root = tempfile.mkdtemp(prefix="atx_lint_mh_save_")
+        acc = Accelerator(
+            seed=0,
+            project_config=ProjectConfiguration(
+                project_dir=root, automatic_checkpoint_naming=True
+            ),
+        )
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)}
+        state = acc.prepare_train_state(
+            TrainState.create(params=params, tx=optax.sgd(1e-2))
+        )
+        step = acc.make_train_step(
+            lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+        )
+        state, _ = step(state, {"x": np.ones((8, 8), np.float32)})
+        checkpointing.save_state(acc, None, state, async_save=False)
+
+    report = analysis.lint_host_loop(
+        save_loop, processes=processes, target="save_path"
+    )
+    return f"train step + synchronous save_state, {processes} processes", report
+
+
+def _mh_scenario_preemption_exit(processes: int = 2):
+    """Emergency-save path: one process gets the preemption notice; the
+    group must still agree (or-reduce) before the synchronized emergency
+    checkpoint + exit — the schedule every process runs must match."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis
+    from ..accelerator import Accelerator, TrainState
+    from ..state import AcceleratorState
+    from ..utils.dataclasses import ProjectConfiguration
+
+    def train_loop():
+        AcceleratorState._reset_state()
+        root = tempfile.mkdtemp(prefix="atx_lint_mh_preempt_")
+        acc = Accelerator(
+            seed=0,
+            project_config=ProjectConfiguration(
+                project_dir=root, automatic_checkpoint_naming=True
+            ),
+        )
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)}
+        state = acc.prepare_train_state(
+            TrainState.create(params=params, tx=optax.sgd(1e-2))
+        )
+        step = acc.make_train_step(
+            lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+        )
+        batch = {"x": np.ones((8, 8), np.float32)}
+        for _ in range(3):
+            state, _ = step(state, batch)
+
+    report = analysis.lint_host_loop(
+        train_loop,
+        processes=processes,
+        preempted=[0],
+        target="preemption_exit",
+    )
+    return (
+        f"preemption notice on process 0 of {processes} — emergency save + exit",
+        report,
+    )
+
+
+MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
+    "save_path": _mh_scenario_save_path,
+    "preemption_exit": _mh_scenario_preemption_exit,
+}
+
+
 def _examples_dir():
     from pathlib import Path
 
     return Path(__file__).resolve().parents[2] / "examples"
 
 
-def resolve_targets(targets: list[str]) -> tuple[list[str], list[str]]:
+def resolve_targets(
+    targets: list[str], multihost: bool = False
+) -> tuple[list[str], list[str]]:
     """Map CLI targets (scenario names / example files / directories) to
-    scenario names; second element is the unmatched remainder."""
+    scenario names; second element is the unmatched remainder. Multi-host
+    scenario names always resolve when given explicitly; ``multihost``
+    adds them to the no-target default set."""
+    known = {**SCENARIOS, **MULTIHOST_SCENARIOS}
     if not targets:
-        return list(SCENARIOS), []
+        names = list(SCENARIOS)
+        if multihost:
+            names += list(MULTIHOST_SCENARIOS)
+        return names, []
     names: list[str] = []
     unmatched: list[str] = []
     for t in targets:
         stem = os.path.splitext(os.path.basename(t.rstrip("/")))[0]
-        if t in SCENARIOS:
+        if t in known:
             names.append(t)
         elif os.path.isdir(t):
             found = [
                 os.path.splitext(f)[0]
                 for f in sorted(os.listdir(t))
-                if os.path.splitext(f)[0] in SCENARIOS and f.endswith(".py")
+                if os.path.splitext(f)[0] in known and f.endswith(".py")
             ]
             if found:
                 names.extend(found)
             else:
                 unmatched.append(t)
-        elif stem in SCENARIOS:
+        elif stem in known:
             names.append(stem)
         else:
             unmatched.append(t)
@@ -286,14 +412,17 @@ def run(args: argparse.Namespace) -> int:
     if args.list:
         for name, builder in SCENARIOS.items():
             print(f"{name}: {builder.__doc__.splitlines()[0]}")
+        for name, builder in MULTIHOST_SCENARIOS.items():
+            print(f"{name} [multihost]: {builder.__doc__.splitlines()[0]}")
         return 0
 
-    names, unmatched = resolve_targets(args.targets)
+    procs = int(args.multihost or 0)
+    names, unmatched = resolve_targets(args.targets, multihost=procs >= 2)
     if unmatched:
         print(
             f"lint: no scenario registered for {unmatched} "
-            f"(known: {', '.join(SCENARIOS)}); register one in "
-            "accelerate_tpu/commands/lint.py:SCENARIOS",
+            f"(known: {', '.join(list(SCENARIOS) + list(MULTIHOST_SCENARIOS))}); "
+            "register one in accelerate_tpu/commands/lint.py:SCENARIOS",
             file=sys.stderr,
         )
         return 2
@@ -303,10 +432,21 @@ def run(args: argparse.Namespace) -> int:
     failed = False
     json_reports = []
     for name in names:
-        desc, report = SCENARIOS[name]()
+        if name in MULTIHOST_SCENARIOS:
+            desc, report = MULTIHOST_SCENARIOS[name](processes=max(procs, 2))
+        elif procs >= 2:
+            desc, report = SCENARIOS[name](processes=procs)
+        else:
+            desc, report = SCENARIOS[name]()
         if report.filter(gate):
             failed = True
-        if args.fmt == "json":
+        if args.json_lines:
+            for finding in report.filter(show):
+                d = finding.to_dict()
+                d["scenario"] = name
+                d["target"] = report.target or name
+                print(json.dumps(d, sort_keys=True))
+        elif args.fmt == "json":
             d = report.to_dict()
             d["scenario"] = name
             d["description"] = desc
@@ -314,7 +454,9 @@ def run(args: argparse.Namespace) -> int:
         else:
             print(f"== {report.target or name} — {desc}")
             print(f"   {report.format(show)}".replace("\n", "\n   "))
-    if args.fmt == "json":
+    if args.json_lines:
+        pass  # JSON-lines streams findings only; exit code carries the gate
+    elif args.fmt == "json":
         print(json.dumps({"reports": json_reports}, indent=2))
     elif failed:
         print(f"\nlint: findings at/above severity '{gate}' — failing")

@@ -20,6 +20,7 @@ The reference's `recursively_apply` (`operations.py:84`) is `jax.tree.map`.
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Callable, Sequence
 
@@ -48,6 +49,28 @@ def _is_arraylike(x: Any) -> bool:
 def is_tensor_tree(tree: Any) -> bool:
     leaves = jax.tree.leaves(tree)
     return len(leaves) > 0 and all(_is_arraylike(leaf) for leaf in leaves)
+
+
+def _maybe_collective_log(kind: str, name: str, tree: Any = None) -> None:
+    """Opt-in runtime mirror of the ATX5xx simulated collective log
+    (``ATX_COLLECTIVE_LOG=1``): records (kind, name, signature) at the REAL
+    call site so multi-process tests can assert group agreement. One env
+    lookup when off; never raises."""
+    if os.environ.get("ATX_COLLECTIVE_LOG", "").strip().lower() not in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return
+    try:
+        from ..analysis.collective_log import runtime_record
+
+        runtime_record(
+            kind, name, _tree_signature(tree) if tree is not None else ""
+        )
+    except Exception:  # pragma: no cover - diagnostics must not break steps
+        pass
 
 
 # --------------------------------------------------------------------- debug
@@ -159,6 +182,7 @@ def gather(tree: Any) -> Any:
     - A *process-local* value (numpy or single-device array) is concatenated
       across processes along dim 0.
     """
+    _maybe_collective_log("gather", "gather", tree)
     verify_operation("gather", tree)
     state = ProcessState()
 
@@ -184,6 +208,7 @@ def reduce(tree: Any, reduction: str = "mean") -> Any:
     `operations.py:724`). ``reduction`` in {"sum", "mean", "none"}."""
     if reduction == "none":
         return tree
+    _maybe_collective_log("reduce", f"reduce[{reduction}]", tree)
     verify_operation("reduce", tree)
     state = ProcessState()
 
@@ -209,6 +234,7 @@ def broadcast(tree: Any, from_process: int = 0) -> Any:
     templates (`ATX_DEBUG_MODE=1` verifies agreement). For source-only
     payloads of arbitrary shape use `broadcast_object_list`.
     """
+    _maybe_collective_log("broadcast", f"broadcast[from={from_process}]", tree)
     verify_operation("broadcast", tree)
     state = ProcessState()
     if state.num_processes == 1:
@@ -283,6 +309,9 @@ def gather_object(objects: list[Any]) -> list[Any]:
     `torch.distributed.all_gather_object` — built on padded uint8 tensor
     all-gather over the JAX runtime (SURVEY.md §5: host-level object channel).
     """
+    # Payloads are legitimately per-process here; only the count is logged
+    # (mirrors the ATX5xx alignment signature).
+    _maybe_collective_log("gather_object", "gather_object")
     state = ProcessState()
     if state.num_processes == 1:
         return list(objects)
@@ -309,6 +338,9 @@ def broadcast_object_list(objects: list[Any], from_process: int = 0) -> list[Any
     process's (possibly None) payload to everyone, O(world) bandwidth on
     the dispatch_batches hot path.
     """
+    _maybe_collective_log(
+        "broadcast_object_list", f"broadcast_object_list[from={from_process}]"
+    )
     state = ProcessState()
     if state.num_processes == 1:
         return list(objects)

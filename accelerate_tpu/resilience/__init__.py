@@ -42,6 +42,7 @@ from .commit import (
     verify_checkpoint,
     write_manifest,
 )
+from .gce import MaintenancePoller, maintenance_poller_from_env
 from .preemption import (
     PREEMPTION_EXIT_CODE,
     clear_preemption,
@@ -55,10 +56,12 @@ __all__ = [
     "COMMIT_MARKER",
     "TMP_SUFFIX",
     "CheckpointIntegrityWarning",
+    "MaintenancePoller",
     "PREEMPTION_EXIT_CODE",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "clear_preemption",
+    "maintenance_poller_from_env",
     "commit_dir",
     "committed_checkpoints",
     "dump_all_stacks",

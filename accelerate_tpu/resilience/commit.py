@@ -48,6 +48,27 @@ class CheckpointIntegrityWarning(UserWarning):
     (resume fell back to the previous committed checkpoint)."""
 
 
+def _maybe_collective_log(kind: str, name: str) -> None:
+    """Opt-in runtime mirror of the ATX5xx collective log
+    (``ATX_COLLECTIVE_LOG=1``): the commit barrier halves are part of the
+    cross-process schedule, so multi-process tests can assert every process
+    agreed on save ordering. The lazy import only happens when the flag is
+    set, preserving this module's cheap-import contract by default."""
+    if os.environ.get("ATX_COLLECTIVE_LOG", "").strip().lower() not in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return
+    try:
+        from ..analysis.collective_log import runtime_record
+
+        runtime_record(kind, name)
+    except Exception:  # pragma: no cover - diagnostics must not break saves
+        pass
+
+
 def fault_point(name: str) -> None:
     """Fault-injection hook. No-op (one dict lookup) unless the test harness
     set ``ATX_FAULT_KILL_AT`` (simulated kill -9 via ``os._exit``) or
@@ -229,6 +250,7 @@ def commit_dir(tmp_dir: str, final_dir: str, meta: dict[str, Any] | None = None)
     under ``automatic_checkpoint_naming`` (the crash-safe workflow) the
     final name is always fresh and this path never runs.
     """
+    _maybe_collective_log("commit", "commit_dir")
     fault_point("commit.before_rename")
     aside = None
     if os.path.isdir(final_dir):
@@ -254,6 +276,7 @@ def commit_dir(tmp_dir: str, final_dir: str, meta: dict[str, Any] | None = None)
 def mark_precommit(tmp_dir: str, proc: int) -> None:
     """File-based barrier half for the async-save path: each process drops a
     marker once its files + manifest are fully written."""
+    _maybe_collective_log("precommit", "mark_precommit")
     path = os.path.join(tmp_dir, PRECOMMIT_FILE.format(proc=proc))
     with open(path, "w") as f:
         f.flush()
@@ -264,6 +287,7 @@ def wait_for_precommit(tmp_dir: str, num_processes: int, timeout_secs: float) ->
     """Process 0's half of the file barrier: poll until every process's
     marker exists (shared filesystem), then remove the markers so they never
     appear in the committed directory."""
+    _maybe_collective_log("precommit_wait", "wait_for_precommit")
     deadline = time.monotonic() + timeout_secs
     paths = [
         os.path.join(tmp_dir, PRECOMMIT_FILE.format(proc=p))

@@ -1,0 +1,144 @@
+"""GCE maintenance-event metadata poller → `request_preemption()`.
+
+SIGTERM is not the only preemption notice on GCE: host maintenance events
+and spot reclaims are announced on the instance metadata server
+(``maintenance-event`` flips from ``NONE``; ``preempted`` flips to
+``TRUE``) — often *earlier* than the TERM signal reaches the process. This
+poller watches both endpoints from a daemon thread and, on the first
+non-benign value, feeds `resilience.request_preemption()` so the training
+loop writes its emergency checkpoint with the full grace window instead of
+the signal-to-kill remainder.
+
+Off by default. ``ATX_GCE_PREEMPT_POLL_SECS=<seconds>`` (> 0) enables it —
+`Accelerator.__init__` calls `maintenance_poller_from_env()` alongside the
+SIGTERM handler install. ``ATX_GCE_METADATA_URL`` overrides the metadata
+base URL (the unit tests point it at a stub HTTP server). Requests carry
+the mandatory ``Metadata-Flavor: Google`` header; network errors are
+treated as "not on GCE" and simply retried on the next tick — the poller
+must never take down a training process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from .preemption import request_preemption
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance"
+)
+# maintenance-event values that do NOT announce an upcoming disruption.
+_BENIGN_MAINTENANCE = ("", "NONE")
+
+
+def _read_endpoint(base_url: str, name: str, timeout: float) -> str | None:
+    req = urllib.request.Request(
+        f"{base_url.rstrip('/')}/{name}",
+        headers={"Metadata-Flavor": "Google"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace").strip()
+    except (urllib.error.URLError, OSError, ValueError):
+        return None  # not on GCE / transient — retry next tick
+
+
+class MaintenancePoller:
+    """Daemon thread polling the metadata server until a preemption notice
+    appears (then fires ``on_preempt`` once and stops) or `stop()`."""
+
+    def __init__(
+        self,
+        poll_secs: float,
+        metadata_url: str = DEFAULT_METADATA_URL,
+        on_preempt: Callable[[], None] = request_preemption,
+        request_timeout: float = 2.0,
+    ) -> None:
+        if poll_secs <= 0:
+            raise ValueError("poll_secs must be > 0 (the poller is opt-in)")
+        self.poll_secs = float(poll_secs)
+        self.metadata_url = metadata_url
+        self.on_preempt = on_preempt
+        self.request_timeout = float(request_timeout)
+        self.notice: str | None = None  # what tripped the poller, for logs
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------ poll
+    def check_once(self) -> str | None:
+        """One metadata sweep; returns the notice string (and records it)
+        when a disruption is announced, else None."""
+        event = _read_endpoint(
+            self.metadata_url, "maintenance-event", self.request_timeout
+        )
+        if event is not None and event.upper() not in _BENIGN_MAINTENANCE:
+            self.notice = f"maintenance-event={event}"
+            return self.notice
+        preempted = _read_endpoint(
+            self.metadata_url, "preempted", self.request_timeout
+        )
+        if preempted is not None and preempted.upper() == "TRUE":
+            self.notice = "preempted=TRUE"
+            return self.notice
+        return None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            notice = self.check_once()
+            if notice is not None:
+                logger.warning(
+                    "GCE metadata announced %s — requesting preemption "
+                    "(emergency checkpoint at the next step boundary)",
+                    notice,
+                )
+                self.on_preempt()
+                return
+            self._stop.wait(self.poll_secs)
+
+    # --------------------------------------------------------------- control
+    def start(self) -> "MaintenancePoller":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="atx-gce-maintenance-poller", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+def maintenance_poller_from_env() -> MaintenancePoller | None:
+    """Start a poller iff ``ATX_GCE_PREEMPT_POLL_SECS`` > 0 (off by
+    default); ``ATX_GCE_METADATA_URL`` overrides the server for tests."""
+    raw = os.environ.get("ATX_GCE_PREEMPT_POLL_SECS", "").strip()
+    if not raw:
+        return None
+    try:
+        poll_secs = float(raw)
+    except ValueError:
+        logger.warning(
+            "ATX_GCE_PREEMPT_POLL_SECS=%r is not a number; GCE maintenance "
+            "polling stays off",
+            raw,
+        )
+        return None
+    if poll_secs <= 0:
+        return None
+    url = os.environ.get("ATX_GCE_METADATA_URL", DEFAULT_METADATA_URL)
+    return MaintenancePoller(poll_secs, metadata_url=url).start()

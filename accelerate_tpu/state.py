@@ -41,6 +41,24 @@ _jax_distributed_initialized = False
 _init_lock = threading.Lock()
 
 
+def _maybe_collective_log(kind: str, name: str) -> None:
+    """Opt-in runtime collective-log mirror (``ATX_COLLECTIVE_LOG=1``, see
+    `analysis/collective_log.py`). One env lookup when off; never raises."""
+    if os.environ.get("ATX_COLLECTIVE_LOG", "").strip().lower() not in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return
+    try:
+        from .analysis.collective_log import runtime_record
+
+        runtime_record(kind, name)
+    except Exception:  # pragma: no cover - diagnostics must not break sync
+        pass
+
+
 def maybe_initialize_jax_distributed() -> None:
     """Initialize the JAX multi-host control plane if the launcher asked for it.
 
@@ -197,6 +215,7 @@ class ProcessState:
         Reference `state.py:359`. Uses a named cross-process barrier via the
         JAX runtime; no-op in single-process mode.
         """
+        _maybe_collective_log("barrier", "wait_for_everyone")
         if self.num_processes > 1:
             from jax.experimental import multihost_utils
 

@@ -61,6 +61,67 @@ def launch(
     )
 
 
+# ------------------------------------------------------------- flake retry
+# Known flake class (see ROADMAP.md "Known test flakes"): the forked
+# multi-process rendezvous occasionally misses a heartbeat / coordination
+# deadline on loaded CI machines — the job is correct, the clock was not.
+# Output markers below identify that class; anything else is a real failure
+# and is NOT retried.
+_COORDINATION_FLAKE_MARKERS = (
+    "heartbeat",
+    "deadline exceeded",
+    "coordination service",
+    "barrier timed out",
+    "failed to connect to coordination",
+    "connection reset by peer",
+    "unavailable: connection",
+)
+
+
+def is_coordination_flake(proc: subprocess.CompletedProcess) -> bool:
+    """True when a FAILED launch's output matches the known
+    heartbeat/coordination-timeout flake class (never true on rc=0)."""
+    if proc.returncode == 0:
+        return False
+    text = ((proc.stdout or "") + (proc.stderr or "")).lower()
+    return any(marker in text for marker in _COORDINATION_FLAKE_MARKERS)
+
+
+def retry_coordination_flakes(run_once, attempts: int = 3):
+    """Bounded rerun for the coordination-flake class only.
+
+    ``run_once(attempt)`` performs one full launch and returns the
+    `CompletedProcess` (it must reset any on-disk state itself — e.g.
+    delete a crash-marker file — so every attempt starts clean). A run is
+    retried only when it times out (`subprocess.TimeoutExpired`) or its
+    output matches `_COORDINATION_FLAKE_MARKERS`; assertion-relevant
+    failures surface immediately. The last attempt's result (or timeout)
+    is returned/raised so a persistent failure still fails the test.
+    """
+    last: subprocess.CompletedProcess | subprocess.TimeoutExpired | None = None
+    for attempt in range(attempts):
+        try:
+            proc = run_once(attempt)
+        except subprocess.TimeoutExpired as e:
+            last = e
+            sys.stderr.write(
+                f"[launch_helpers] attempt {attempt + 1}/{attempts} timed out; "
+                "retrying (coordination-flake class)\n"
+            )
+            continue
+        if not is_coordination_flake(proc):
+            return proc
+        last = proc
+        sys.stderr.write(
+            f"[launch_helpers] attempt {attempt + 1}/{attempts} hit a "
+            "coordination-timeout flake (rc="
+            f"{proc.returncode}); retrying\n"
+        )
+    if isinstance(last, subprocess.TimeoutExpired):
+        raise last
+    return last
+
+
 def assert_all_ranks(proc: subprocess.CompletedProcess, marker: str, n: int) -> None:
     assert proc.returncode == 0, (
         f"rc={proc.returncode}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"

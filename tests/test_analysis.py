@@ -522,3 +522,344 @@ class TestEstimateCrossCheck:
             acct.format(),
             est_state_bytes,
         )
+
+
+# ------------------------------------------- multi-host replay (ATX5xx)
+from accelerate_tpu.ops import collectives as C  # noqa: E402
+from accelerate_tpu.state import ProcessState  # noqa: E402
+from accelerate_tpu import resilience  # noqa: E402
+
+
+def error_ids(report):
+    return sorted({f.rule_id for f in report.findings if f.severity >= Severity.ERROR})
+
+
+class TestMultihostReplayHarness:
+    """host_trace.py: the simulated-process replay machinery itself."""
+
+    def test_simulated_process_patches_and_restores(self):
+        from accelerate_tpu.analysis.host_trace import simulated_process
+
+        before_idx, before_cnt = jax.process_index(), jax.process_count()
+        with simulated_process(1, 3):
+            assert jax.process_index() == 1
+            assert jax.process_count() == 3
+            assert os.environ.get("ATX_PREEMPTION_HANDLER") == "0"
+        assert jax.process_index() == before_idx
+        assert jax.process_count() == before_cnt
+
+    def test_replay_records_aligned_collectives(self):
+        def loop():
+            C.reduce({"loss": np.ones((), np.float32)})
+            ProcessState().wait_for_everyone()
+
+        result = analysis.replay_host_loop(loop, processes=3)
+        assert result.converged
+        for p in range(3):
+            kinds = [e.kind for e in result.collectives(p)]
+            assert kinds == ["reduce", "barrier"], kinds
+            assert all(e.process == p for e in result.collectives(p))
+
+    def test_replay_reduce_sums_across_simulated_processes(self):
+        seen = {}
+
+        def loop():
+            out = C.reduce({"v": np.ones((), np.float32)}, reduction="sum")
+            seen[jax.process_index()] = float(out["v"])
+
+        result = analysis.replay_host_loop(loop, processes=2)
+        assert result.converged
+        # The stub reduce resolves peer operands: every process sees the
+        # group sum, exactly like the real collective.
+        assert seen == {0: 2.0, 1: 2.0}
+
+    def test_preempted_processes_see_their_flag(self):
+        flags = {}
+
+        def loop():
+            flags[jax.process_index()] = bool(resilience.preemption_requested())
+
+        analysis.replay_host_loop(loop, processes=2, preempted=[1])
+        assert flags == {0: False, 1: True}
+
+    def test_loop_exception_is_annotated_not_raised(self):
+        def loop():
+            if jax.process_index() == 1:
+                raise RuntimeError("boom on proc 1")
+            C.reduce({"x": np.ones((), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        atx000 = [f for f in report.findings if f.rule_id == "ATX000"]
+        assert atx000 and "boom on proc 1" in atx000[0].message
+
+    def test_requires_at_least_two_processes(self):
+        with pytest.raises(ValueError):
+            analysis.replay_host_loop(lambda: None, processes=1)
+
+
+class TestMultihostRules:
+    """Each ATX5xx rule: fires on its seeded defect, quiet on the clean
+    variant of the same pattern."""
+
+    # -- ATX501: divergent collective sequence ---------------------------
+    def test_atx501_seeded_divergent_ops(self):
+        def loop():
+            if jax.process_index() == 0:
+                C.gather({"x": np.ones((2,), np.float32)})
+            else:
+                C.reduce({"x": np.ones((2,), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert error_ids(report) == ["ATX501"]
+
+    def test_atx501_clean_same_schedule(self):
+        def loop():
+            C.gather({"x": np.ones((2,), np.float32)})
+            C.reduce({"x": np.ones((2,), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert not report.findings, [f.format() for f in report.findings]
+
+    def test_atx501_fn_variant_process_dependent_jaxpr(self):
+        def step(x):
+            return x * 2 if jax.process_index() == 0 else x + 1
+
+        report = analysis.lint_step(step, sds(8, 8), processes=2)
+        assert "ATX501" in ids(report)
+
+    def test_atx501_fn_variant_clean(self):
+        def step(x):
+            return x * 2
+
+        report = analysis.lint_step(step, sds(8, 8), processes=2)
+        assert "ATX501" not in ids(report)
+
+    def test_lint_step_single_process_skips_host_rules(self):
+        def step(x):
+            return x * 2 if jax.process_index() == 0 else x + 1
+
+        report = analysis.lint_step(step, sds(8, 8))
+        assert "ATX501" not in ids(report)
+
+    # -- ATX502: host flag consumed without group agreement (PR-4 bug) ---
+    def _pre_fix_pr4_loop(self):
+        # The preemption handler as shipped in PR 4 BEFORE the fixup
+        # (78b037c): each process acts on its OWN SIGTERM flag. Only the
+        # preempted process enters the save path; its peers head into the
+        # next step's reduce and the pod deadlocks.
+        def loop():
+            if resilience.preemption_requested():
+                ProcessState().wait_for_everyone()
+                C.broadcast_object_list(["checkpoint_0"])
+                raise SystemExit(75)
+            C.reduce({"loss": np.ones((), np.float32)})
+
+        return loop
+
+    def test_atx502_seeded_pre_fix_preemption_handler(self):
+        report = analysis.lint_host_loop(
+            self._pre_fix_pr4_loop(), processes=2, preempted=[0]
+        )
+        assert error_ids(report) == ["ATX502"]
+
+    def test_atx502_reports_both_processes_stacks(self):
+        report = analysis.lint_host_loop(
+            self._pre_fix_pr4_loop(), processes=2, preempted=[0]
+        )
+        msg = next(f for f in report.findings if f.rule_id == "ATX502").message
+        assert "process 0" in msg and "process 1" in msg
+        # Both processes' call stacks point at the divergent frames.
+        assert msg.count("test_analysis.py") >= 2, msg
+
+    def test_atx502_clean_group_agreed_flag(self):
+        # The fixed handler: or-reduce the flag first so the whole group
+        # takes the same branch (accelerator.py:_preemption_agreed).
+        def loop():
+            own = np.asarray(int(resilience.preemption_requested()), np.int32)
+            agreed = C.reduce({"flag": own}, reduction="sum")
+            if int(agreed["flag"]) > 0:
+                ProcessState().wait_for_everyone()
+                C.broadcast_object_list(["checkpoint_0"])
+                raise SystemExit(75)
+            C.reduce({"loss": np.ones((), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2, preempted=[0])
+        assert not report.findings, [f.format() for f in report.findings]
+
+    # -- ATX503: barrier/commit ordering mismatch ------------------------
+    def test_atx503_seeded_barrier_order_swap(self):
+        def loop():
+            if jax.process_index() == 0:
+                ProcessState().wait_for_everyone()
+                C.reduce({"x": np.ones((), np.float32)})
+            else:
+                C.reduce({"x": np.ones((), np.float32)})
+                ProcessState().wait_for_everyone()
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert error_ids(report) == ["ATX503"]
+
+    def test_atx503_clean_consistent_barriers(self):
+        def loop():
+            ProcessState().wait_for_everyone()
+            C.reduce({"x": np.ones((), np.float32)})
+            ProcessState().wait_for_everyone()
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert not report.findings, [f.format() for f in report.findings]
+
+    # -- ATX504: per-process RNG into a replicated collective ------------
+    def test_atx504_seeded_folded_key(self):
+        def loop():
+            key = jax.random.fold_in(jax.random.PRNGKey(0), jax.process_index())
+            C.broadcast({"key": np.asarray(key)})
+            C.reduce({"loss": np.ones((), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert "ATX504" in ids(report)
+        f = next(f for f in report.findings if f.rule_id == "ATX504")
+        assert f.severity == Severity.WARNING
+
+    def test_atx504_clean_replicated_key(self):
+        def loop():
+            key = jax.random.PRNGKey(0)  # same on every process
+            C.broadcast({"key": np.asarray(key)})
+            C.reduce({"loss": np.ones((), np.float32)})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert not report.findings, [f.format() for f in report.findings]
+
+    # -- ATX505: unordered-iteration collective order --------------------
+    def test_atx505_seeded_dict_order(self):
+        def loop():
+            items = {"a": np.ones((), np.float32), "b": np.ones((), np.float32)}
+            order = (
+                list(items)
+                if jax.process_index() == 0
+                else list(reversed(list(items)))
+            )
+            for k in order:
+                C.reduce({k: items[k]})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert error_ids(report) == ["ATX505"]
+
+    def test_atx505_clean_sorted_iteration(self):
+        def loop():
+            items = {"b": np.ones((), np.float32), "a": np.ones((), np.float32)}
+            for k in sorted(items):
+                C.reduce({k: items[k]})
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert not report.findings, [f.format() for f in report.findings]
+
+
+class TestMultihostSurfaces:
+    """The ATX5xx family through its user-facing surfaces: the CLI
+    (`--multihost`, `--json`), `Finding.data`, the runtime collective log,
+    and the prepare-time spec-consistency check."""
+
+    def test_cli_lists_multihost_scenarios(self, capsys):
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", "--list"]) == 0
+        out = capsys.readouterr().out
+        assert "save_path" in out and "preemption_exit" in out
+
+    def test_resolve_targets_multihost_default_set(self):
+        from accelerate_tpu.commands.lint import resolve_targets
+
+        names, unmatched = resolve_targets([], multihost=True)
+        assert "save_path" in names and "preemption_exit" in names
+        assert not unmatched
+        names, _ = resolve_targets([], multihost=False)
+        assert "save_path" not in names
+        # Explicit multihost names resolve even without the flag.
+        names, unmatched = resolve_targets(["save_path"])
+        assert names == ["save_path"] and not unmatched
+
+    def test_cli_multihost_save_path_clean(self, capsys):
+        """Acceptance: the current (fixed) resilience save path replays
+        clean under 2 simulated processes through the CLI."""
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        rc = cli_main(
+            ["lint", "--multihost", "2", "save_path", "--severity", "error"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "save_path" in out
+
+    def test_cli_json_lines_carries_atx404_table(self, capsys):
+        from accelerate_tpu.commands.cli import main as cli_main
+
+        assert cli_main(["lint", "--json", "cv_example"]) == 0
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        findings = [__import__("json").loads(l) for l in lines]
+        assert all("rule_id" in f and "scenario" in f for f in findings)
+        table = next(f["data"] for f in findings if f["rule_id"] == "ATX404")
+        assert table["collectives"], table
+        for row in table["collectives"]:
+            assert set(row) == {"op", "count", "bytes"}
+            assert row["count"] > 0 and row["bytes"] > 0
+
+    def test_finding_data_in_dict_not_identity(self):
+        from accelerate_tpu.analysis.findings import Finding
+
+        plain = Finding("ATX404", Severity.INFO, "", "traffic", "")
+        with_data = Finding(
+            "ATX404", Severity.INFO, "", "traffic", "",
+            data={"collectives": [{"op": "all-reduce", "count": 1, "bytes": 4}]},
+        )
+        assert "data" not in plain.to_dict()
+        assert with_data.to_dict()["data"]["collectives"][0]["op"] == "all-reduce"
+        assert plain == with_data  # data is detail, not identity
+
+    def test_runtime_collective_log_roundtrip(self, tmp_path, monkeypatch):
+        from accelerate_tpu.analysis import collective_log
+
+        monkeypatch.setenv("ATX_COLLECTIVE_LOG", "1")
+        monkeypatch.setenv("ATX_COLLECTIVE_LOG_DIR", str(tmp_path))
+        for proc in (0, 1):
+            monkeypatch.setenv("ATX_COLLECTIVE_LOG_PROC", str(proc))
+            ProcessState().wait_for_everyone()
+            C.reduce({"x": np.ones((2,), np.float32)})
+        logs = collective_log.read_logs(str(tmp_path))
+        assert set(logs) == {0, 1}
+        assert [e["kind"] for e in logs[0]] == ["barrier", "reduce"]
+        assert collective_log.verify_agreement(str(tmp_path)) == []
+        # A divergent extra collective on one process is called out.
+        monkeypatch.setenv("ATX_COLLECTIVE_LOG_PROC", "1")
+        C.reduce({"x": np.ones((2,), np.float32)})
+        mismatches = collective_log.verify_agreement(str(tmp_path))
+        assert mismatches and "process 1" in " ".join(mismatches)
+
+    def test_runtime_log_off_by_default(self, tmp_path, monkeypatch):
+        from accelerate_tpu.analysis import collective_log
+
+        monkeypatch.delenv("ATX_COLLECTIVE_LOG", raising=False)
+        monkeypatch.setenv("ATX_COLLECTIVE_LOG_DIR", str(tmp_path))
+        C.reduce({"x": np.ones((2,), np.float32)})
+        assert not collective_log.enabled()
+        assert collective_log.read_logs(str(tmp_path)) == {}
+
+    def test_spec_consistency_flags_process_dependent_specs(self):
+        from accelerate_tpu.analysis import rules_multihost
+
+        findings = rules_multihost.spec_consistency_findings(
+            lambda: P("fsdp") if jax.process_index() == 0 else P(), 2
+        )
+        assert [f.rule_id for f in findings] == ["ATX501"]
+        assert rules_multihost.spec_consistency_findings(lambda: P("fsdp"), 2) == []
+
+    def test_prepare_multiprocess_spec_lint_clean(self, monkeypatch):
+        monkeypatch.setenv("ATX_LINT_PROCESSES", "2")
+        AcceleratorState._reset_state()
+        acc = atx.Accelerator(seed=0)
+        state = atx.TrainState.create(
+            params={"w": jnp.zeros((64, 64))}, tx=optax.sgd(1e-2)
+        )
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            acc.prepare_train_state(state, lint="warn")
+        assert not [x for x in w if "ATX501" in str(x.message)]

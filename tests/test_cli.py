@@ -272,7 +272,7 @@ def test_max_restarts_recovers_crashed_group(tmp_path):
     """A rank crashes on the first group attempt; --max_restarts relaunches
     the whole group on a fresh coordinator port and the job completes
     (the torch-elastic restart analog, reference commands/launch.py:142-771)."""
-    from tests.launch_helpers import REPO_ROOT, clean_env
+    from tests.launch_helpers import REPO_ROOT, clean_env, retry_coordination_flakes
 
     marker = str(tmp_path / "crashed_once")
     script = os.path.join(REPO_ROOT, "tests", "scripts", "crash_once.py")
@@ -282,10 +282,17 @@ def test_max_restarts_recovers_crashed_group(tmp_path):
         "--max_restarts", "2", "--mixed_precision", "no",
         script, marker,
     ]
-    proc = subprocess.run(
-        cmd, cwd=REPO_ROOT, env=clean_env(), capture_output=True, text=True,
-        timeout=240,
-    )
+
+    def run_once(attempt):
+        # Each attempt must see a crash-then-recover cycle from scratch.
+        if os.path.exists(marker):
+            os.remove(marker)
+        return subprocess.run(
+            cmd, cwd=REPO_ROOT, env=clean_env(), capture_output=True,
+            text=True, timeout=240,
+        )
+
+    proc = retry_coordination_flakes(run_once)
     assert proc.returncode == 0, f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
     assert "CRASHING ONCE" in proc.stdout
     assert "restarting group (1/2)" in proc.stderr

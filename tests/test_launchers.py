@@ -39,14 +39,18 @@ def test_debug_launcher_refuses_with_live_backends():
 
 @pytest.mark.multiprocess
 def test_debug_launcher_forks_working_rendezvous():
+    from tests.launch_helpers import retry_coordination_flakes
+
     script = os.path.join(REPO_ROOT, "tests", "scripts", "notebook_launcher_check.py")
-    proc = subprocess.run(
-        [sys.executable, script],
-        cwd=REPO_ROOT,
-        env=clean_env(),
-        capture_output=True,
-        text=True,
-        timeout=240,
+    proc = retry_coordination_flakes(
+        lambda attempt: subprocess.run(
+            [sys.executable, script],
+            cwd=REPO_ROOT,
+            env=clean_env(),
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
     )
     assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
     for rank in range(2):

@@ -825,3 +825,124 @@ def test_launcher_resumes_preempted_group_without_burning_restarts(tmp_path):
     for rank in range(2):
         assert f"[proc {rank}] RESUMED OK" in r.stdout, r.stdout
     assert os.path.exists(marker)
+
+
+# ------------------------------------------------- GCE maintenance poller
+class TestGceMaintenancePoller:
+    """resilience/gce.py against a stub metadata server: the poller must
+    stay silent on benign values, fire `request_preemption()` exactly once
+    on a maintenance notice, and stay entirely off without
+    ATX_GCE_PREEMPT_POLL_SECS."""
+
+    @pytest.fixture
+    def metadata_server(self):
+        import http.server
+        import threading
+
+        values = {"maintenance-event": "NONE", "preempted": "FALSE"}
+        hits = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append((self.path, self.headers.get("Metadata-Flavor")))
+                name = self.path.rsplit("/", 1)[-1]
+                if name not in values:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = values[name].encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep pytest output clean
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}/computeMetadata/v1/instance"
+        try:
+            yield url, values, hits
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_benign_values_do_not_preempt(self, metadata_server):
+        url, values, hits = metadata_server
+        poller = resilience.MaintenancePoller(poll_secs=60, metadata_url=url)
+        assert poller.check_once() is None
+        assert poller.notice is None
+        assert not resilience.preemption_requested()
+        # Requests carried the mandatory metadata header.
+        assert hits and all(flavor == "Google" for _, flavor in hits)
+
+    def test_maintenance_event_fires_preemption_once(self, metadata_server):
+        url, values, _ = metadata_server
+        values["maintenance-event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+        fired = []
+        poller = resilience.MaintenancePoller(
+            poll_secs=0.05, metadata_url=url, on_preempt=lambda: fired.append(1)
+        )
+        poller.start()
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.01)
+        poller.stop()
+        assert fired == [1]  # fired exactly once, then the thread returned
+        assert poller.notice == "maintenance-event=TERMINATE_ON_HOST_MAINTENANCE"
+        assert not poller.running
+
+    def test_preempted_true_trips_default_callback(self, metadata_server):
+        url, values, _ = metadata_server
+        values["preempted"] = "TRUE"
+        poller = resilience.MaintenancePoller(poll_secs=60, metadata_url=url)
+        assert poller.check_once() == "preempted=TRUE"
+
+    def test_unreachable_server_is_benign(self):
+        poller = resilience.MaintenancePoller(
+            poll_secs=60, metadata_url="http://127.0.0.1:9/nope", request_timeout=0.2
+        )
+        assert poller.check_once() is None
+
+    def test_rejects_non_positive_poll_interval(self):
+        with pytest.raises(ValueError, match="poll_secs"):
+            resilience.MaintenancePoller(poll_secs=0)
+
+    def test_from_env_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("ATX_GCE_PREEMPT_POLL_SECS", raising=False)
+        assert resilience.maintenance_poller_from_env() is None
+        monkeypatch.setenv("ATX_GCE_PREEMPT_POLL_SECS", "not-a-number")
+        assert resilience.maintenance_poller_from_env() is None
+        monkeypatch.setenv("ATX_GCE_PREEMPT_POLL_SECS", "0")
+        assert resilience.maintenance_poller_from_env() is None
+
+    def test_from_env_starts_poller_and_requests_preemption(
+        self, metadata_server, monkeypatch
+    ):
+        url, values, _ = metadata_server
+        values["maintenance-event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+        monkeypatch.setenv("ATX_GCE_PREEMPT_POLL_SECS", "0.05")
+        monkeypatch.setenv("ATX_GCE_METADATA_URL", url)
+        poller = resilience.maintenance_poller_from_env()
+        assert poller is not None
+        try:
+            deadline = time.time() + 5.0
+            while not resilience.preemption_requested() and time.time() < deadline:
+                time.sleep(0.01)
+            assert resilience.preemption_requested()
+        finally:
+            poller.stop()
+
+    def test_accelerator_init_starts_poller_from_env(
+        self, metadata_server, monkeypatch, tmp_path
+    ):
+        url, _, _ = metadata_server
+        monkeypatch.setenv("ATX_GCE_PREEMPT_POLL_SECS", "30")
+        monkeypatch.setenv("ATX_GCE_METADATA_URL", url)
+        acc = _auto_acc(tmp_path)
+        try:
+            assert acc._gce_poller is not None and acc._gce_poller.running
+        finally:
+            acc._gce_poller.stop()
