@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-resilience lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -29,6 +29,19 @@ smoke-transfer:
 smoke-serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py tests/test_prefix_cache.py tests/test_generation.py -q -m 'not slow'
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint serving --severity error
+
+# CPU smoke for the multi-replica serving front-end (docs/serving.md,
+# "Multi-replica routing & drain"): 2-replica greedy outputs bit-identical
+# to a solo engine — including under an injected replica kill mid-decode
+# and a wedge caught by the per-replica watchdog — plus visible
+# queue-full rejects, deadline cancels mid-queue and mid-decode, and the
+# SIGTERM drain -> exit 75 subprocess contract; then the router_drain
+# host-loop replay under 2 simulated processes (error findings fail).
+smoke-router:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint router_drain --multihost 2 \
+		--severity error
 
 # Ahead-of-time step lint over the examples/ entry points (no training, no
 # weights): fails on any error-severity finding (docs/static_analysis.md).
@@ -59,5 +72,5 @@ smoke-resilience:
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-resilience
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience
 	python -m pytest tests/ -q --heavy

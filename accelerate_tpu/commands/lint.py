@@ -187,54 +187,65 @@ def _scenario_cv_example(**options: Any):
 
 
 def _scenario_serving(**options: Any):
-    """serving.Engine decode step: the hot path of the continuous-batching
-    engine (docs/serving.md). Lints the REAL slot-batched decode function
-    with the engine's own abstract call signature — donation of the slot
-    cache, no host syncs/callbacks in the compiled step, stable shapes.
-    When the prefix cache is on, the bucketed prefix-copy function is
-    linted the same way (donated destination cache, traced slot/row/cursor
-    indices)."""
+    """serving hot paths behind a 2-replica Router: EACH replica engine's
+    slot-batched decode function is linted with its own abstract call
+    signature (donation of the slot cache, no host syncs/callbacks in the
+    compiled step, stable shapes), and when the prefix cache is on, each
+    replica's bucketed prefix-copy function too — the per-replica device
+    programs the multi-replica front-end dispatches (docs/serving.md)."""
     import jax
     import jax.numpy as jnp
 
     from .. import analysis
     from ..generation import GenerationConfig
     from ..models import llama
-    from ..serving import Engine
+    from ..serving import Engine, Router
 
     config = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
     params = llama.init(jax.random.PRNGKey(0), config)
-    engine = Engine(
-        lambda p, t, c: llama.forward_with_cache(p, t, c, config),
-        lambda b, m: llama.init_cache(config, b, m),
-        params,
-        GenerationConfig(eos_token_id=0),
-        slots=4,
-        buckets=(16, 32),
-        max_len=96,
-    )
-    report = analysis.lint_step(
-        engine._decode_fn,
-        *engine.abstract_decode_args(),
-        donate_argnums=(3,),
-        target="serving.Engine.decode",
-        **options,
-    )
-    desc = f"serving decode step, {engine.n_slots} slots"
-    if engine.prefix_cache is not None:
-        copy_report = analysis.lint_step(
-            engine.copy_fn_for_bucket(engine.buckets[0]),
-            *engine.abstract_copy_args(),
-            donate_argnums=(0,),
-            target="serving.Engine.prefix_copy",
+
+    def mk_engine() -> Engine:
+        return Engine(
+            lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+            lambda b, m: llama.init_cache(config, b, m),
+            params,
+            GenerationConfig(eos_token_id=0),
+            slots=4,
+            buckets=(16, 32),
+            max_len=96,
+        )
+
+    # threads=False: nothing is dispatched here, so no replica threads —
+    # the router only names/owns the replica engines being linted.
+    router = Router([mk_engine(), mk_engine()], threads=False)
+    findings: list = []
+    for rep in router.replicas:
+        engine = rep.engine
+        report = analysis.lint_step(
+            engine._decode_fn,
+            *engine.abstract_decode_args(),
+            donate_argnums=(3,),
+            target=f"serving.Router.replica{rep.id}.decode",
             **options,
         )
-        report = analysis.Report(
-            findings=report.findings + copy_report.findings,
-            target="serving.Engine.decode+prefix_copy",
-        )
-        desc += f", prefix copy bucket {engine.buckets[0]}"
-    return desc, report
+        findings += report.findings
+        if engine.prefix_cache is not None:
+            copy_report = analysis.lint_step(
+                engine.copy_fn_for_bucket(engine.buckets[0]),
+                *engine.abstract_copy_args(),
+                donate_argnums=(0,),
+                target=f"serving.Router.replica{rep.id}.prefix_copy",
+                **options,
+            )
+            findings += copy_report.findings
+    n_slots = router.replicas[0].engine.n_slots
+    desc = (
+        f"2-replica router: decode + prefix copy per replica, "
+        f"{n_slots} slots each"
+    )
+    return desc, analysis.Report(
+        findings=findings, target="serving.Router.decode+prefix_copy"
+    )
 
 
 SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
@@ -341,9 +352,76 @@ def _mh_scenario_preemption_exit(processes: int = 2):
     )
 
 
+def _mh_scenario_router_drain(processes: int = 2):
+    """serving.Router drain + failover host loop (the ROADMAP follow-up
+    for serving's multi-host dispatch): a 2-replica inline router serves a
+    small trace while replica 0 is fault-injected dead mid-trace and a
+    preemption notice arrives — the dispatch/flag schedule every process
+    replays must stay identical (deterministic inline routing), and the
+    drain must finish every accepted request."""
+    from .. import analysis
+
+    def router_loop():
+        import jax
+        import numpy as np
+
+        from .. import resilience
+        from ..generation import GenerationConfig
+        from ..models import llama
+        from ..serving import Engine, Request, Router
+        from ..test_utils import faults
+        from ..utils.environment import patch_environment
+
+        config = llama.LlamaConfig.tiny(vocab_size=64, max_seq_len=64)
+        params = llama.init(jax.random.PRNGKey(0), config)
+
+        def mk_engine() -> Engine:
+            return Engine(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+                lambda b, m: llama.init_cache(config, b, m),
+                params,
+                GenerationConfig(
+                    max_new_tokens=4, eos_token_id=None, pad_token_id=0
+                ),
+                slots=2,
+                buckets=(8,),
+                max_len=32,
+                prefix_cache=False,
+            )
+
+        rng = np.random.RandomState(0)
+        reqs = [
+            Request(prompt=rng.randint(1, 64, (6,)).astype(np.int32), rid=i)
+            for i in range(4)
+        ]
+        faults._reset_counters()  # the @N counter must restart per process
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@2"):
+            router = Router([mk_engine(), mk_engine()], threads=False)
+            for r in reqs:
+                router.submit_request(r)
+            for _ in range(3):  # replica 0 dies on its second pumped step
+                router.poll()
+            resilience.request_preemption()
+            out = router.join()
+            router.close()
+        assert len(out) == len(reqs), f"drain lost requests: {len(out)}"
+        assert router.draining and router.drain_reason == "preemption"
+        assert router.stats["replicas_lost"] == 1
+
+    report = analysis.lint_host_loop(
+        router_loop, processes=processes, target="router_drain"
+    )
+    return (
+        f"2-replica router, replica-0 fault + preemption drain, "
+        f"{processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
+    "router_drain": _mh_scenario_router_drain,
 }
 
 

@@ -12,6 +12,13 @@ serve phase reports, runnable standalone on any host:
 ``--compare-b1`` additionally runs the same request set sequentially
 through batch-1 `generate()` and reports the speedup (the ISSUE-3
 acceptance bar is >= 3x on a real chip).
+
+``--replicas N`` (N >= 2) serves the trace through the multi-replica
+`serving.Router` instead — N identically configured engines behind
+prefix-affinity routing and a bounded admission queue (``--queue-depth``,
+``--affinity``); router fleet metrics join the JSON line as
+``serve_router_*`` keys, and a SIGTERM mid-trace drains gracefully and
+exits 75 (the elastic-launcher resume contract — docs/serving.md).
 """
 
 from __future__ import annotations
@@ -98,6 +105,27 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         action="store_true",
         help="also run the request set sequentially through batch-1 "
         "generate() and report the speedup",
+    )
+    p.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="serve through the multi-replica Router with N engine "
+        "replicas (1 = single engine, no router)",
+    )
+    p.add_argument(
+        "--queue-depth",
+        type=int,
+        default=None,
+        help="router admission-queue bound (ATX_SERVE_QUEUE_DEPTH; "
+        "default 4x total fleet slots)",
+    )
+    p.add_argument(
+        "--affinity",
+        choices=("prefix", "least-loaded"),
+        default="prefix",
+        help="router placement policy: prefix-affinity steering with "
+        "least-loaded fallback, or pure least-loaded",
     )
     p.set_defaults(func=run)
 
@@ -186,17 +214,33 @@ def run(args: argparse.Namespace) -> int:
         rounded = min((b for b in bs if b >= longest), default=None)
         top = rounded if rounded is not None else -(-longest // bs[-1]) * bs[-1]
         max_len = top + new_tokens[1]
-    engine = Engine(
-        apply_fn,
-        init_cache_fn,
-        params,
-        config,
-        slots=args.slots,
-        buckets=buckets,
-        max_len=max_len,
-        prefix_cache=args.prefix_cache,
-        prefix_cache_mib=args.prefix_cache_mib,
-    )
+    def mk_engine() -> Engine:
+        return Engine(
+            apply_fn,
+            init_cache_fn,
+            params,
+            config,
+            slots=args.slots,
+            buckets=buckets,
+            max_len=max_len,
+            prefix_cache=args.prefix_cache,
+            prefix_cache_mib=args.prefix_cache_mib,
+        )
+
+    router = None
+    if args.replicas > 1:
+        from .. import resilience
+        from ..serving import Router
+
+        # SIGTERM now means "drain, then exit 75" instead of dying mid-token.
+        resilience.install_preemption_handler()
+        engines = [mk_engine() for _ in range(args.replicas)]
+        engine = engines[0]
+        router = Router(
+            engines, queue_depth=args.queue_depth, affinity=args.affinity
+        )
+    else:
+        engine = mk_engine()
     if args.shared_prefix > 0:
         trace = shared_prefix_trace(
             args.requests,
@@ -220,13 +264,22 @@ def run(args: argparse.Namespace) -> int:
             stop_sequences=stop_sequences,
         )
     t0 = time.perf_counter()
-    completions = engine.serve(trace, realtime=args.realtime)
+    if router is not None:
+        completions = router.serve(trace, realtime=args.realtime)
+        router.close()
+    else:
+        completions = engine.serve(trace, realtime=args.realtime)
     wall = time.perf_counter() - t0
 
     total_new = sum(c.n_new for c in completions)
-    lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in completions)
-    ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in completions)
-    pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))]
+    # Latency stats over requests that actually finished (a drained or
+    # deadline-cancelled request has no meaningful TTFT/e2e).
+    finished = [
+        c for c in completions if c.finish_reason not in ("cancelled", "failed")
+    ] or completions
+    lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in finished)
+    ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in finished)
+    pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
     result = {
         "serve_requests": len(completions),
         "serve_tokens_per_sec": round(total_new / max(wall, 1e-9), 1),
@@ -268,5 +321,21 @@ def run(args: argparse.Namespace) -> int:
         b1_wall = time.perf_counter() - t0
         result["serve_b1_sequential_s"] = round(b1_wall, 2)
         result["serve_vs_b1_speedup"] = round(b1_wall / max(wall, 1e-9), 2)
+    if router is not None:
+        from .. import resilience
+
+        fleet = router.metrics()
+        per = fleet.pop("per_replica")
+        for key, val in fleet.items():
+            result["serve_router_" + key] = val
+        result["serve_router_occupancy"] = [p["occupancy"] for p in per]
+        result["serve_router_hit_rates"] = [p["prefix_hit_rate"] for p in per]
+        result["serve_router_quarantined"] = [p["quarantined"] for p in per]
+        print(json.dumps(result))
+        if router.draining and router.drain_reason == "preemption":
+            # The launcher resume contract (docs/fault_tolerance.md):
+            # in-flight work finished above; 75 = resume me, free of charge.
+            return resilience.PREEMPTION_EXIT_CODE
+        return 0
     print(json.dumps(result))
     return 0

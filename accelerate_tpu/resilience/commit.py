@@ -71,10 +71,16 @@ def _maybe_collective_log(kind: str, name: str) -> None:
 
 def fault_point(name: str) -> None:
     """Fault-injection hook. No-op (one dict lookup) unless the test harness
-    set ``ATX_FAULT_KILL_AT`` (simulated kill -9 via ``os._exit``) or
-    ``ATX_FAULT_RAISE_AT`` (in-process `FaultInjected`) — see
-    `test_utils/faults.py` for the points the save/commit path exposes."""
-    if "ATX_FAULT_KILL_AT" in os.environ or "ATX_FAULT_RAISE_AT" in os.environ:
+    set ``ATX_FAULT_KILL_AT`` (simulated kill -9 via ``os._exit``),
+    ``ATX_FAULT_RAISE_AT`` (in-process `FaultInjected`), or
+    ``ATX_FAULT_HANG_AT`` (park the thread — the wedge analog) — see
+    `test_utils/faults.py` for the instrumented points and the ``point@N``
+    fire-on-Nth-hit syntax."""
+    if (
+        "ATX_FAULT_KILL_AT" in os.environ
+        or "ATX_FAULT_RAISE_AT" in os.environ
+        or "ATX_FAULT_HANG_AT" in os.environ
+    ):
         from ..test_utils.faults import crash_point
 
         crash_point(name)

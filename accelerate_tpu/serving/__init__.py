@@ -1,7 +1,9 @@
 """Continuous-batching serving: slot-paged KV cache, bucketed chunked
-prefill, iteration-level scheduling, and automatic prefix caching
-(radix-tree KV reuse across requests). See `serving/engine.py`,
-`serving/prefix_cache.py`, and docs/serving.md."""
+prefill, iteration-level scheduling, automatic prefix caching
+(radix-tree KV reuse across requests), and a multi-replica front-end
+(prefix-affinity routing, bounded admission, graceful drain, replica
+failover). See `serving/engine.py`, `serving/prefix_cache.py`,
+`serving/router.py`, and docs/serving.md."""
 
 from .engine import (
     Completion,
@@ -12,6 +14,13 @@ from .engine import (
     shared_prefix_trace,
 )
 from .prefix_cache import PrefixCache
+from .router import (
+    AffinityIndex,
+    NoHealthyReplicaError,
+    QueueFullError,
+    Router,
+    RouterDraining,
+)
 
 __all__ = [
     "Engine",
@@ -21,4 +30,9 @@ __all__ = [
     "shared_prefix_trace",
     "default_buckets",
     "PrefixCache",
+    "Router",
+    "AffinityIndex",
+    "QueueFullError",
+    "RouterDraining",
+    "NoHealthyReplicaError",
 ]

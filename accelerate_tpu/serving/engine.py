@@ -106,6 +106,10 @@ class Request:
     arrival: float | None = None
     stream: Callable[[int, int, str | None], None] | None = None
     stop_sequences: Sequence[Sequence[int]] | None = None
+    # Deadline in seconds from submission, enforced by `serving.Router`
+    # (the engine itself never expires a request): on expiry the request
+    # is cancelled mid-queue or mid-decode with finish_reason="cancelled".
+    timeout: float | None = None
 
 
 @dataclasses.dataclass
@@ -115,7 +119,10 @@ class Completion:
     for the generated region, so bit-identity checks are a slice compare.
     Timestamps are absolute `time.perf_counter()` values. ``finish_reason``
     is ``"eos"`` / ``"stop"`` (a stop sequence matched; its tokens stay in
-    ``tokens``) / ``"length"`` (budget exhausted)."""
+    ``tokens``) / ``"length"`` (budget exhausted) / ``"cancelled"``
+    (`Engine.cancel` — deadline expiry or caller cancellation; ``tokens``
+    holds whatever was generated before the cancel) / ``"failed"``
+    (`serving.Router` only: replica deaths exhausted the retry budget)."""
 
     rid: int
     prompt: np.ndarray
@@ -177,6 +184,14 @@ class Engine:
     budget; ``prefix_cache_rows`` overrides the derived row count
     directly (tests / exact sizing). Greedy outputs are bit-identical
     with the cache on or off.
+
+    **Thread ownership**: an Engine is NOT thread-safe. Exactly one thread
+    may drive it — every `submit`/`submit_request`/`step`/`cancel`/`serve`
+    call must come from that same thread (the host-side scheduler state
+    and the device dispatch order both assume a single driver). The
+    multi-replica `serving.Router` honours this by giving each replica
+    engine its own dedicated thread and forwarding submissions and
+    cancellations through a per-replica inbox.
     """
 
     def __init__(
@@ -333,6 +348,7 @@ class Engine:
             "prefill_tokens_saved": 0,  # prompt tokens served by copy, not prefill
             "prefix_copy_chunks": 0,
             "prefix_promotions": 0,
+            "cancelled": 0,
         }
         self.actions: list[str] = []  # "prefill" / "decode", for tests/traces
 
@@ -364,7 +380,12 @@ class Engine:
         )
         return self.submit_request(req)
 
-    def submit_request(self, req: Request) -> int:
+    def validate_request(self, req: Request) -> Request:
+        """Resolve per-request defaults and validate against this engine's
+        capacity WITHOUT queueing anything (raises ValueError on a request
+        that could never run here). `submit_request` calls this; the
+        multi-replica Router calls it at admission so a bad request is
+        rejected at the front door instead of killing a replica thread."""
         if req.max_new_tokens is None:
             req.max_new_tokens = self.config.max_new_tokens
         if req.max_new_tokens < 1:
@@ -383,12 +404,78 @@ class Engine:
                 f"prompt ({S}) + max_new_tokens ({req.max_new_tokens}) exceeds "
                 f"the engine's per-slot KV capacity max_len={self.max_len}"
             )
+        # Bucket-padding fit: every prefill chunk writes a full BUCKET of KV
+        # positions (pad tail included), so the padded plan — not just the
+        # raw prompt — must fit max_len. Validate here, at submit time, so
+        # an oversized prompt raises a clear error instead of the padded
+        # final chunk's clamped cache write corrupting committed KV deep
+        # inside the prefill path.
+        self._chunk_plan(req.prompt)
+        return req
+
+    def submit_request(self, req: Request) -> int:
+        self.validate_request(req)
         if req.rid < 0:
             req.rid = self._next_rid
         self._next_rid = max(self._next_rid, req.rid) + 1
         req.submitted_at = time.perf_counter()  # type: ignore[attr-defined]
         self._queue.append(req)
         return req.rid
+
+    # ------------------------------------------------------------- cancel
+    def cancel(self, rid: int) -> Completion | None:
+        """Cancel a queued or in-flight request. Returns a `Completion`
+        with ``finish_reason="cancelled"`` carrying whatever tokens were
+        generated before the cancel (none for a still-queued request), or
+        None when ``rid`` is unknown or already finished. Must be called
+        from the engine-owning thread, between `step` calls (the Router's
+        per-replica inbox serializes this). The cancelled slot's committed
+        prefix is NOT promoted to the prefix cache — a partial request is
+        a poor reuse candidate and the slot is recycled immediately."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                self.stats["cancelled"] += 1
+                return self._cancelled_completion(
+                    req,
+                    np.full((req.max_new_tokens,), self.config.pad_token_id, np.int32),
+                    0,
+                    0.0,
+                )
+        for slot_id, slot in enumerate(self._slots):
+            if slot is not None and slot.req.rid == rid:
+                if slot.pending_copy is not None:
+                    self.prefix_cache.release(slot.pending_copy[0])
+                    slot.pending_copy = None
+                try:
+                    self._prefill_order.remove(slot_id)
+                except ValueError:
+                    pass  # already decoding
+                # The slot's partial KV is garbage to the next occupant:
+                # its first prefill chunk overwrites from cursor 0 (the
+                # same free-slot invariant every eviction relies on).
+                self._slots[slot_id] = None
+                self._free.append(slot_id)
+                self.stats["cancelled"] += 1
+                return self._cancelled_completion(
+                    slot.req, slot.out, slot.n_new, slot.first_token_at
+                )
+        return None
+
+    def _cancelled_completion(
+        self, req: Request, tokens: np.ndarray, n_new: int, first_token_at: float
+    ) -> Completion:
+        return Completion(
+            rid=req.rid,
+            prompt=req.prompt,
+            tokens=tokens,
+            n_new=n_new,
+            text=self.detokenize(tokens[:n_new].tolist()) if self.detokenize else None,
+            submitted_at=getattr(req, "submitted_at", 0.0),
+            first_token_at=first_token_at,
+            finished_at=time.perf_counter(),
+            finish_reason="cancelled",
+        )
 
     # ---------------------------------------------------------- scheduler
     @property
@@ -409,6 +496,14 @@ class Engine:
             else:
                 bucket = min(b for b in self.buckets if b >= rem)
             real = min(rem, bucket)
+            if pos + bucket > self.max_len:
+                raise ValueError(
+                    f"prompt length {S}: the prefill chunk covering positions "
+                    f"[{pos}, {pos + bucket}) (bucket {bucket}, buckets "
+                    f"{self.buckets}) pads past the per-slot KV capacity "
+                    f"max_len={self.max_len}; raise max_len or add a bucket "
+                    f"<= {self.max_len - pos} so bucket-padded prefill fits"
+                )
             buf = np.full((1, bucket), self.config.pad_token_id, np.int32)
             buf[0, :real] = prompt[pos : pos + real]
             chunks.append((buf, real))
@@ -430,9 +525,19 @@ class Engine:
                 node, matched = self.prefix_cache.match(
                     req.prompt, limit=len(req.prompt) - 1
                 )
+            try:
+                chunks = self._chunk_plan(req.prompt, start=matched)
+            except ValueError:
+                # The match-shifted plan can pad past max_len even when the
+                # start=0 plan (validated at submit) fits — a hit is an
+                # optimization, never a requirement, so fall back to a full
+                # prefill rather than rejecting the request.
+                self.prefix_cache.release(node)
+                node, matched = None, 0
+                chunks = self._chunk_plan(req.prompt)
             self._slots[slot_id] = _Slot(
                 req,
-                self._chunk_plan(req.prompt, start=matched),
+                chunks,
                 self.config.pad_token_id,
                 matched=matched,
                 pending_copy=(node, matched) if node is not None else None,

@@ -1,0 +1,832 @@
+"""Multi-replica serving front-end: routing, admission, drain, failover.
+
+One `Engine` saturates one device group; this module is the fleet layer
+above it, following the front-end/engine split of production LLM servers
+(Orca's request-level scheduler over execution engines; SGLang's
+cache-aware routing, which the PR-6 radix prefix cache was built to
+exploit). A `Router` owns a bounded admission queue and fans requests out
+to N engine **replicas** — locally each replica is an `Engine` over its
+own device subset; on a pod the same abstraction covers
+one-engine-per-host. Five mechanics:
+
+- **Prefix-affinity + least-loaded routing** — a host-side
+  `AffinityIndex` over recently dispatched prompts steers a request
+  sharing a cached prefix to the replica that owns that prefix KV
+  (maximizing per-replica prefix-cache hit rate), falling back to the
+  least-loaded replica. ``affinity_min_tokens`` (default: the smallest
+  prefill bucket — shorter matches can't be cache-aligned anyway) and
+  ``affinity_max_imbalance`` (how many extra in-flight requests affinity
+  may pile onto one replica before balance wins) set the trade-off;
+  ``affinity="least-loaded"`` disables steering entirely.
+- **Admission control & backpressure** — the queue of
+  accepted-but-undispatched requests is bounded (``queue_depth``, env
+  ``ATX_SERVE_QUEUE_DEPTH``, default 4x total fleet slots); a full queue
+  raises `QueueFullError` (a reject the caller SEES, counted in
+  ``stats["rejects"]``). Per-request deadlines (`Request.timeout`
+  seconds) cancel mid-queue or mid-decode with
+  ``finish_reason="cancelled"``; `Router.cancel` does the same on demand.
+- **Graceful drain** — every `poll` reads
+  ``resilience.preemption_requested()`` (SIGTERM / the GCE maintenance
+  poller); when set, the router stops admitting (`RouterDraining`),
+  finishes everything already accepted, and the caller exits with
+  ``resilience.PREEMPTION_EXIT_CODE`` (75) so an elastic launcher resumes
+  it (`atx serve --replicas` does exactly this).
+- **Replica failover** — a replica whose thread raises (including
+  `test_utils.faults` injection at the ``router.replica<i>.step`` crash
+  points) or wedges (per-replica `resilience.Watchdog` on step-entry
+  heartbeats; ``watchdog_secs`` / ``ATX_SERVE_REPLICA_WATCHDOG_SECS``) is
+  **quarantined**: its in-flight requests are re-dispatched to healthy
+  replicas (up to ``max_retries`` attempts, then
+  ``finish_reason="failed"``). Greedy outputs stay bit-identical to a
+  solo `Engine` regardless of routing, retries, or replica death: tokens
+  are a pure function of (prompt, seed, config, params), so a retry is a
+  replay — and per-ticket stream dedup delivers each token's callback
+  exactly once even when an attempt died mid-decode.
+- **Aggregate observability** — `Router.metrics()` snapshots fleet
+  counters (queue depth/peak, rejects, retries, cancels, drains,
+  TTFT/e2e p50/p99) plus per-replica occupancy, prefix hit rate, and
+  quarantine state; `atx serve` merges it into its one-line JSON.
+
+Execution modes:
+
+- ``threads=True`` (default): each replica engine runs on its OWN
+  dedicated thread (the one-thread-per-engine ownership rule in
+  `engine.py`), pumping submissions/cancellations from a per-replica
+  inbox; the caller's thread runs only router logic (`poll`/`serve`).
+- ``threads=False``: replicas are pumped inline on the caller's thread,
+  round-robin, one step per replica per `poll` — fully deterministic, no
+  thread scheduling in the dispatch order. This is the mode the `atx
+  lint router_drain` scenario replays through `analysis.lint_host_loop`
+  and the mode bit-identity tests use; wedge detection (a stuck step
+  would stall the caller itself) needs ``threads=True``.
+
+Replicas must be identically configured (same ``buckets`` / ``max_len``
+/ generation config): admission validates against replica 0 and failover
+replays on any healthy replica, so a request must fit all of them.
+See docs/serving.md ("Multi-replica routing & drain").
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+# Package-attribute access (not by-value import): `analysis.host_trace`
+# patches `resilience.preemption_requested` and `resilience.fault_point`
+# on the package during lint replay, so the router must read them through
+# the package or the router_drain scenario would dodge the simulation.
+from .. import resilience
+from ..utils.environment import get_int_from_env
+from .engine import Completion, Engine, Request
+
+__all__ = [
+    "Router",
+    "AffinityIndex",
+    "QueueFullError",
+    "RouterDraining",
+    "NoHealthyReplicaError",
+]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at ``queue_depth``: the request was REJECTED (never
+    queued). Callers retry with backoff or shed load — the visible
+    backpressure signal (`stats["rejects"]` counts these)."""
+
+
+class RouterDraining(RuntimeError):
+    """The router is draining (preemption or `Router.drain`): no new
+    admissions; everything already accepted still completes."""
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica is quarantined while requests are still outstanding —
+    the fleet cannot make progress."""
+
+
+class AffinityIndex:
+    """Host-side index of recently dispatched prompts per replica.
+
+    The router can't see inside each replica's device-resident prefix
+    cache, so it keeps its own LRU record of (prompt, replica) pairs at
+    dispatch time and scores candidates by longest shared prefix — the
+    same signal the per-engine radix tree keys on, approximated at the
+    fleet level. Bounded at ``cap`` entries (drop-oldest) so lookup cost
+    stays a few hundred short vector compares per admission."""
+
+    def __init__(self, cap: int = 512) -> None:
+        self.cap = cap
+        self._entries: deque[tuple[np.ndarray, int]] = deque()
+
+    def insert(self, prompt: np.ndarray, replica: int) -> None:
+        self._entries.append((np.asarray(prompt, np.int32), int(replica)))
+        while len(self._entries) > self.cap:
+            self._entries.popleft()
+
+    def remove_replica(self, replica: int) -> None:
+        """Forget a quarantined replica — its cached KV is unreachable, so
+        steering traffic at it would be pure imbalance."""
+        self._entries = deque((p, r) for p, r in self._entries if r != replica)
+
+    def best(self, prompt: np.ndarray) -> dict[int, int]:
+        """Longest shared-prefix length per replica for ``prompt``."""
+        prompt = np.asarray(prompt, np.int32)
+        best: dict[int, int] = {}
+        for toks, r in self._entries:
+            n = min(len(toks), len(prompt))
+            if n <= best.get(r, 0):
+                continue  # can't beat this replica's current best
+            neq = np.nonzero(toks[:n] != prompt[:n])[0]
+            m = int(neq[0]) if len(neq) else n
+            if m > best.get(r, 0):
+                best[r] = m
+        return best
+
+
+class _Ticket:
+    """Router-side bookkeeping for one accepted request."""
+
+    __slots__ = (
+        "req", "user_stream", "submitted_at", "deadline", "replica",
+        "attempts", "generation", "streamed", "cancel_sent", "done",
+    )
+
+    def __init__(self, req: Request) -> None:
+        self.req = req
+        self.user_stream = req.stream
+        self.submitted_at = time.perf_counter()
+        self.deadline = (
+            self.submitted_at + req.timeout if req.timeout is not None else None
+        )
+        self.replica: int | None = None
+        self.attempts = 0
+        # Bumped at every (re)dispatch and at resolution: a stream callback
+        # from a superseded attempt (a quarantined replica's thread still
+        # unwinding) sees a stale generation and drops itself.
+        self.generation = 0
+        self.streamed = 0  # tokens delivered to the user stream so far
+        self.cancel_sent = False
+        self.done = False
+
+
+class _Replica:
+    """One engine + (in threads mode) its dedicated driver thread.
+
+    The engine is single-threaded by contract; ALL interaction crosses a
+    locked inbox of ``("submit", Request)`` / ``("cancel", rid)`` /
+    ``("stop",)`` messages, applied between `step` calls by `pump` — which
+    is the same code path the thread loop and the inline mode run, so the
+    two modes differ only in who calls it."""
+
+    def __init__(
+        self,
+        id: int,
+        engine: Engine,
+        router: "Router",
+        *,
+        watchdog_secs: float | None = None,
+    ) -> None:
+        self.id = id
+        self.engine = engine
+        self.router = router
+        self.inbox: deque = deque()
+        self.inbox_lock = threading.Lock()
+        self.wake = threading.Event()
+        self.thread: threading.Thread | None = None
+        self.dead = False  # router-side quarantine flag (router thread only)
+        self.error: str | None = None
+        self.wedged = threading.Event()
+        self.inflight: set[int] = set()  # rids dispatched here (router thread)
+        self.dispatched = 0
+        self.completed = 0
+        self._stopping = False
+        self.watchdog: resilience.Watchdog | None = None
+        if watchdog_secs:
+            # The abort seam turns the watchdog's process-kill into a
+            # per-replica quarantine: the fleet survives one wedged engine.
+            self.watchdog = resilience.Watchdog(
+                watchdog_secs,
+                first_deadline_secs=watchdog_secs * 10.0,  # compile headroom
+                abort=self._wedge,
+            )
+
+    def _wedge(self) -> None:
+        self.wedged.set()
+        self.router._results.put((
+            "down", self.id,
+            f"wedged: step exceeded its {self.watchdog.deadline:.1f}s "
+            "deadline (ATX_SERVE_REPLICA_WATCHDOG_SECS)",
+        ))
+
+    def send(self, msg: tuple) -> None:
+        with self.inbox_lock:
+            self.inbox.append(msg)
+        self.wake.set()
+
+    def pump(self) -> list[Completion]:
+        """Apply queued messages, then run at most one engine step. Runs on
+        the replica thread (threads mode) or the caller (inline mode)."""
+        out: list[Completion] = []
+        with self.inbox_lock:
+            msgs = list(self.inbox)
+            self.inbox.clear()
+        for msg in msgs:
+            if msg[0] == "submit":
+                self.engine.submit_request(msg[1])
+            elif msg[0] == "cancel":
+                c = self.engine.cancel(msg[1])
+                if c is not None:
+                    out.append(c)
+            elif msg[0] == "stop":
+                self._stopping = True
+        if self.engine.busy:
+            if self.watchdog is not None:
+                self.watchdog.arm()
+            resilience.fault_point(f"router.replica{self.id}.step")
+            out.extend(self.engine.step())
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+        return out
+
+    def start(self) -> None:
+        self.thread = threading.Thread(
+            target=self._run, name=f"atx-replica{self.id}", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self) -> None:
+        try:
+            while True:
+                for c in self.pump():
+                    self.router._results.put(("done", self.id, c))
+                if self._stopping and not self.engine.busy and not self.inbox:
+                    return
+                if not self.engine.busy and not self.inbox:
+                    self.wake.wait(0.002)
+                    self.wake.clear()
+        except BaseException as e:  # any replica death is a quarantine event
+            self.router._results.put(
+                ("down", self.id, f"{type(e).__name__}: {e}")
+            )
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
+
+
+def _pct(xs: list[float], q: float) -> float | None:
+    if not xs:
+        return None
+    s = sorted(xs)
+    return round(s[min(len(s) - 1, int(q * len(s)))], 2)
+
+
+class Router:
+    """Bounded-admission front-end over N `Engine` replicas (module
+    docstring has the full design). Typical use::
+
+        with Router([engine_a, engine_b]) as router:
+            completions = router.serve(trace, realtime=True)
+
+    or incrementally: `submit`/`submit_request` -> `poll` (one tick) ->
+    `pop_completions`, with `join` to run everything outstanding down.
+    All Router methods must be called from ONE thread (the replicas have
+    their own); completions come back in finish order with
+    ``submitted_at`` rewritten to router admission time, so TTFT/e2e
+    latencies include queueing delay."""
+
+    def __init__(
+        self,
+        engines: Sequence[Engine],
+        *,
+        queue_depth: int | None = None,
+        affinity: str = "prefix",
+        affinity_min_tokens: int | None = None,
+        affinity_max_imbalance: int | None = None,
+        max_retries: int = 2,
+        watchdog_secs: float | None = None,
+        threads: bool = True,
+    ) -> None:
+        engines = list(engines)
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        ref = engines[0]
+        for i, e in enumerate(engines[1:], start=1):
+            if e.buckets != ref.buckets or e.max_len != ref.max_len:
+                raise ValueError(
+                    "replicas must be identically configured (admission "
+                    "validates against replica 0 and failover replays on any "
+                    f"healthy replica): replica {i} has buckets={e.buckets} "
+                    f"max_len={e.max_len}, replica 0 has buckets="
+                    f"{ref.buckets} max_len={ref.max_len}"
+                )
+        self._ref = ref
+        self.threads = threads
+        if queue_depth is None:
+            queue_depth = get_int_from_env(
+                ("ATX_SERVE_QUEUE_DEPTH",), 4 * sum(e.n_slots for e in engines)
+            )
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.queue_depth = queue_depth
+        if affinity not in ("prefix", "least-loaded"):
+            raise ValueError(
+                f"affinity must be 'prefix' or 'least-loaded', got {affinity!r}"
+            )
+        self.affinity = affinity
+        self.affinity_min_tokens = (
+            affinity_min_tokens
+            if affinity_min_tokens is not None
+            else ref.buckets[0]
+        )
+        self.affinity_max_imbalance = (
+            affinity_max_imbalance
+            if affinity_max_imbalance is not None
+            else max(1, ref.n_slots - 1)
+        )
+        self.max_retries = max_retries
+        if watchdog_secs is None:
+            raw = os.environ.get("ATX_SERVE_REPLICA_WATCHDOG_SECS", "")
+            try:
+                watchdog_secs = float(raw) if raw else None
+            except ValueError:
+                watchdog_secs = None
+        if watchdog_secs is not None and watchdog_secs <= 0:
+            watchdog_secs = None
+        self.replicas = [
+            # Inline mode gets no watchdog: a wedged step stalls the caller
+            # itself, so there is nobody left to act on the firing.
+            _Replica(i, e, self, watchdog_secs=watchdog_secs if threads else None)
+            for i, e in enumerate(engines)
+        ]
+        self._affinity = AffinityIndex()
+        self._results: queue.Queue = queue.Queue()
+        self._pending: deque[_Ticket] = deque()  # accepted, not yet dispatched
+        self._tickets: dict[int, _Ticket] = {}
+        self._completions: list[Completion] = []
+        self._next_rid = 0
+        self._outstanding = 0
+        self._draining = False
+        self.drain_reason: str | None = None
+        self._ttft_ms: list[float] = []
+        self._e2e_ms: list[float] = []
+        self.stats = {
+            "submitted": 0,
+            "rejects": 0,
+            "drain_rejected": 0,
+            "dispatched": 0,
+            "completed": 0,
+            "retries": 0,
+            "cancelled": 0,
+            "failed": 0,
+            "replicas_lost": 0,
+            "queue_peak": 0,
+        }
+        if threads:
+            for r in self.replicas:
+                r.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int | None = None,
+        *,
+        seed: int = 0,
+        stream: Callable[[int, int, str | None], None] | None = None,
+        arrival: float | None = None,
+        stop_sequences: Sequence[Sequence[int]] | None = None,
+        timeout: float | None = None,
+    ) -> int:
+        """Admit one request; returns its fleet-global request id. Raises
+        `QueueFullError` when the admission queue is at ``queue_depth``
+        and `RouterDraining` once drain has started. ``timeout`` is the
+        request's deadline in seconds from now."""
+        return self.submit_request(
+            Request(
+                prompt=np.asarray(prompt, np.int32).reshape(-1),
+                max_new_tokens=max_new_tokens,
+                seed=seed,
+                arrival=arrival,
+                stream=stream,
+                stop_sequences=stop_sequences,
+                timeout=timeout,
+            )
+        )
+
+    def submit_request(self, req: Request) -> int:
+        if self._draining:
+            self.stats["drain_rejected"] += 1
+            raise RouterDraining(
+                f"router is draining ({self.drain_reason}): "
+                "not admitting new requests"
+            )
+        if len(self._pending) >= self.queue_depth:
+            self.stats["rejects"] += 1
+            raise QueueFullError(
+                f"admission queue full ({len(self._pending)}/"
+                f"{self.queue_depth} pending; ATX_SERVE_QUEUE_DEPTH raises "
+                "the bound) — retry with backoff"
+            )
+        # Validate at the front door (engine capacity, bucket-padded plan
+        # fit) so a bad request raises HERE, not inside a replica thread.
+        self._ref.validate_request(req)
+        if req.rid < 0:
+            req.rid = self._next_rid
+        self._next_rid = max(self._next_rid, req.rid) + 1
+        t = _Ticket(req)
+        self._tickets[req.rid] = t
+        self._pending.append(t)
+        self._outstanding += 1
+        self.stats["submitted"] += 1
+        self.stats["queue_peak"] = max(
+            self.stats["queue_peak"], len(self._pending)
+        )
+        return req.rid
+
+    # ------------------------------------------------------------- cancel
+    def cancel(self, rid: int) -> bool:
+        """Cancel an accepted request (queued or dispatched). The
+        ``finish_reason="cancelled"`` completion surfaces through the
+        normal `poll`/`join` path; returns False for unknown/finished
+        rids."""
+        t = self._tickets.get(rid)
+        if t is None or t.done:
+            return False
+        self._cancel_ticket(t)
+        return True
+
+    def _cancel_ticket(self, t: _Ticket) -> None:
+        if t.replica is None:
+            self._pending.remove(t)
+            self._resolve(t, self._local_cancel_completion(t))
+        elif not t.cancel_sent:
+            t.cancel_sent = True
+            self.replicas[t.replica].send(("cancel", t.req.rid))
+
+    def _local_cancel_completion(self, t: _Ticket) -> Completion:
+        return self._ref._cancelled_completion(
+            t.req,
+            np.full(
+                (t.req.max_new_tokens,), self._ref.config.pad_token_id, np.int32
+            ),
+            0,
+            0.0,
+        )
+
+    # -------------------------------------------------------------- drain
+    def drain(self, reason: str = "manual") -> None:
+        """Flip to drain mode: stop admitting (`RouterDraining`), let
+        everything already accepted finish. `poll` calls this with
+        ``reason="preemption"`` when `resilience.preemption_requested()`
+        goes high; `atx serve` then exits 75 after `join` so the elastic
+        launcher resumes the process."""
+        if not self._draining:
+            self._draining = True
+            self.drain_reason = reason
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # --------------------------------------------------------------- tick
+    def poll(self, timeout: float = 0.0) -> None:
+        """One router tick: poll the preemption flag, quarantine dead
+        replicas, expire deadlines, dispatch what fits, ingest results
+        (blocking up to ``timeout`` seconds for the first one in threads
+        mode)."""
+        if not self._draining and resilience.preemption_requested():
+            self.drain("preemption")
+        if self.threads:
+            self._check_threads()
+        self._check_deadlines()
+        self._dispatch()
+        if self.threads:
+            self._pump_results(timeout)
+        else:
+            worked = self._pump_inline()
+            if not worked and timeout > 0:
+                time.sleep(timeout)
+        # Quarantine/ingest may have freed slots or requeued orphans.
+        self._dispatch()
+
+    def _check_threads(self) -> None:
+        for r in self.replicas:
+            if (
+                not r.dead
+                and not r._stopping
+                and r.thread is not None
+                and not r.thread.is_alive()
+            ):
+                self._quarantine(r.id, r.error or "replica thread exited")
+
+    def _check_deadlines(self) -> None:
+        now = time.perf_counter()
+        for t in list(self._pending):
+            if t.deadline is not None and now >= t.deadline:
+                self._pending.remove(t)
+                self._resolve(t, self._local_cancel_completion(t))
+        for r in self.replicas:
+            if r.dead:
+                continue
+            for rid in list(r.inflight):
+                t = self._tickets.get(rid)
+                if (
+                    t is not None
+                    and not t.done
+                    and not t.cancel_sent
+                    and t.deadline is not None
+                    and now >= t.deadline
+                ):
+                    t.cancel_sent = True
+                    r.send(("cancel", rid))
+
+    def _dispatch(self) -> None:
+        # Strict FIFO: only the head dispatches (no slot, no overtaking).
+        while self._pending:
+            r = self._pick_replica(self._pending[0].req)
+            if r is None:
+                return
+            self._dispatch_to(self._pending.popleft(), r)
+
+    def _pick_replica(self, req: Request) -> _Replica | None:
+        cands = [
+            r
+            for r in self.replicas
+            if not r.dead and len(r.inflight) < r.engine.n_slots
+        ]
+        if not cands:
+            return None
+        least = min(cands, key=lambda r: (len(r.inflight), r.id))
+        if self.affinity == "prefix":
+            matches = self._affinity.best(req.prompt)
+            best, best_m = None, 0
+            for r in cands:
+                m = matches.get(r.id, 0)
+                if m >= self.affinity_min_tokens and m > best_m:
+                    best, best_m = r, m
+            if (
+                best is not None
+                and len(best.inflight) - len(least.inflight)
+                <= self.affinity_max_imbalance
+            ):
+                return best
+        return least
+
+    def _dispatch_to(self, t: _Ticket, r: _Replica) -> None:
+        t.replica = r.id
+        t.attempts += 1
+        t.generation += 1
+        t.cancel_sent = False
+        t.req.stream = self._make_stream(t)
+        r.inflight.add(t.req.rid)
+        r.dispatched += 1
+        self.stats["dispatched"] += 1
+        if self.affinity == "prefix":
+            # Record at dispatch (not completion) so a burst of same-prefix
+            # requests steers together from the second one on.
+            self._affinity.insert(t.req.prompt, r.id)
+        r.send(("submit", t.req))
+
+    def _make_stream(
+        self, t: _Ticket
+    ) -> Callable[[int, int, str | None], None]:
+        """Exactly-once stream delivery across retries: greedy determinism
+        means a retried attempt replays the identical token sequence, so
+        the wrapper skips the ``t.streamed`` tokens the dead attempt
+        already delivered and drops callbacks from superseded attempts
+        (generation mismatch) entirely."""
+        gen = t.generation
+        count = 0
+
+        def stream(rid: int, tok: int, text: str | None) -> None:
+            nonlocal count
+            count += 1
+            if t.generation != gen:
+                return  # superseded attempt still unwinding
+            if count > t.streamed:
+                t.streamed = count
+                if t.user_stream is not None:
+                    t.user_stream(rid, tok, text)
+
+        return stream
+
+    def _pump_results(self, timeout: float) -> None:
+        block = timeout
+        while True:
+            try:
+                kind, rid, payload = (
+                    self._results.get(timeout=block)
+                    if block > 0
+                    else self._results.get_nowait()
+                )
+            except queue.Empty:
+                return
+            block = 0.0
+            if kind == "done":
+                self._ingest(rid, payload)
+            else:
+                self._quarantine(rid, payload)
+
+    def _pump_inline(self) -> bool:
+        worked = False
+        for r in self.replicas:  # fixed order: deterministic replay
+            if r.dead:
+                continue
+            try:
+                completions = r.pump()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:
+                self._quarantine(r.id, f"{type(e).__name__}: {e}")
+                worked = True
+                continue
+            for c in completions:
+                self._ingest(r.id, c)
+            worked = worked or bool(completions) or r.engine.busy
+        return worked
+
+    def _ingest(self, replica_id: int, c: Completion) -> None:
+        t = self._tickets.get(c.rid)
+        if t is None or t.done or t.replica != replica_id:
+            return  # stale: resolved elsewhere or reassigned after quarantine
+        self.replicas[replica_id].completed += 1
+        self._resolve(t, c)
+
+    def _resolve(self, t: _Ticket, c: Completion) -> None:
+        t.done = True
+        t.generation += 1  # silence any attempt still unwinding
+        if t.replica is not None:
+            self.replicas[t.replica].inflight.discard(t.req.rid)
+            t.replica = None
+        # Router admission time, so latency includes queueing delay.
+        c.submitted_at = t.submitted_at
+        if c.finish_reason == "cancelled":
+            self.stats["cancelled"] += 1
+        if c.finish_reason not in ("cancelled", "failed"):
+            if c.first_token_at:
+                self._ttft_ms.append(
+                    (c.first_token_at - t.submitted_at) * 1000.0
+                )
+            self._e2e_ms.append((c.finished_at - t.submitted_at) * 1000.0)
+        self.stats["completed"] += 1
+        self._outstanding -= 1
+        self._completions.append(c)
+
+    def _quarantine(self, replica_id: int, reason: str) -> None:
+        r = self.replicas[replica_id]
+        if r.dead:
+            return
+        r.dead = True
+        r.error = reason
+        self.stats["replicas_lost"] += 1
+        self._affinity.remove_replica(replica_id)
+        orphans = [
+            self._tickets[rid]
+            for rid in sorted(r.inflight)
+            if rid in self._tickets
+        ]
+        r.inflight.clear()
+        # Retries jump the queue (appendleft, original order preserved):
+        # they already waited once, and FIFO age order stays intact.
+        for t in reversed(orphans):
+            if t.done:
+                continue
+            t.replica = None
+            t.generation += 1
+            if t.attempts > self.max_retries:
+                self.stats["failed"] += 1
+                fc = self._local_cancel_completion(t)
+                fc.finish_reason = "failed"
+                self._resolve(t, fc)
+                continue
+            self.stats["retries"] += 1
+            self._pending.appendleft(t)
+
+    # ---------------------------------------------------------- lifecycle
+    def pop_completions(self) -> list[Completion]:
+        out, self._completions = self._completions, []
+        return out
+
+    def join(self, timeout: float | None = None) -> list[Completion]:
+        """Run until every accepted request resolves; returns completions
+        gathered since the last pop, in finish order. Raises
+        `NoHealthyReplicaError` when the whole fleet is quarantined with
+        work outstanding, `TimeoutError` past ``timeout`` seconds."""
+        t0 = time.perf_counter()
+        while self._outstanding > 0:
+            if all(r.dead for r in self.replicas):
+                errors = "; ".join(
+                    f"replica {r.id}: {r.error}" for r in self.replicas
+                )
+                raise NoHealthyReplicaError(
+                    f"{self._outstanding} request(s) outstanding with every "
+                    f"replica quarantined ({errors})"
+                )
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                raise TimeoutError(
+                    f"router join timed out after {timeout}s with "
+                    f"{self._outstanding} request(s) outstanding"
+                )
+            self.poll(0.002 if self.threads else 0.0)
+        return self.pop_completions()
+
+    def serve(
+        self, requests: Iterable[Request], *, realtime: bool = False
+    ) -> list[Completion]:
+        """Drive a whole trace through the fleet (the `Engine.serve`
+        contract at router level). ``realtime=True`` honours arrival
+        offsets and REJECTS on a full queue (the latency-measuring mode);
+        otherwise submission blocks on backpressure so every request is
+        eventually admitted. Drain (preemption or `drain()`) stops
+        admissions mid-trace — unsubmitted requests are counted in
+        ``stats["drain_rejected"]`` — then everything accepted runs to
+        completion, preserving the exit-75 resume contract."""
+        reqs = sorted(requests, key=lambda r: (r.arrival or 0.0))
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(reqs):
+            if self._draining:
+                self.stats["drain_rejected"] += len(reqs) - i
+                break
+            if realtime and (reqs[i].arrival or 0.0) > time.perf_counter() - t0:
+                self.poll(0.002)
+                continue
+            if not realtime and len(self._pending) >= self.queue_depth:
+                self.poll(0.002)  # backpressure: wait for queue space
+                continue
+            try:
+                self.submit_request(reqs[i])
+            except QueueFullError:
+                pass  # realtime: visible reject, request is shed
+            except RouterDraining:
+                continue  # top of loop accounts the rest as drain_rejected
+            i += 1
+        return self.join()
+
+    def close(self) -> None:
+        """Stop replica threads and watchdogs. Wedged threads (blocked
+        inside a stuck step) are daemons and are left behind."""
+        if self.threads:
+            for r in self.replicas:
+                if r.thread is not None:
+                    r.send(("stop",))
+            for r in self.replicas:
+                if r.thread is not None and not r.wedged.is_set():
+                    r.thread.join(timeout=5.0)
+        for r in self.replicas:
+            if r.watchdog is not None:
+                r.watchdog.stop()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ metrics
+    def metrics(self) -> dict:
+        """Point-in-time fleet snapshot: router counters, latency
+        percentiles (ms, None until data), and one dict per replica —
+        the payload `atx serve` flattens into its JSON line."""
+        per = []
+        for r in self.replicas:
+            es = r.engine.stats
+            pm = r.engine.prefix_metrics()
+            per.append(
+                {
+                    "replica": r.id,
+                    "dispatched": r.dispatched,
+                    "completed": r.completed,
+                    "inflight": len(r.inflight),
+                    "occupancy": round(
+                        es["decode_slot_steps"]
+                        / max(es["decode_steps"] * r.engine.n_slots, 1),
+                        3,
+                    ),
+                    "prefix_hit_rate": pm.get("prefix_hit_rate", 0.0),
+                    "quarantined": int(r.dead),
+                    "wedged": int(r.wedged.is_set()),
+                    "error": r.error,
+                }
+            )
+        m: dict = dict(self.stats)
+        m.update(
+            replicas=len(self.replicas),
+            replicas_alive=sum(1 for r in self.replicas if not r.dead),
+            queue_depth=len(self._pending),
+            queue_capacity=self.queue_depth,
+            draining=int(self._draining),
+            drain_reason=self.drain_reason,
+            ttft_p50_ms=_pct(self._ttft_ms, 0.50),
+            ttft_p99_ms=_pct(self._ttft_ms, 0.99),
+            e2e_p50_ms=_pct(self._e2e_ms, 0.50),
+            e2e_p99_ms=_pct(self._e2e_ms, 0.99),
+            per_replica=per,
+        )
+        return m

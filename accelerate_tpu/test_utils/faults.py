@@ -7,12 +7,21 @@ Two families of faults:
   manifest verification in `resilience/commit.py` must catch both before
   `load_state(resume="latest")` trusts a byte.
 - **Crash points** — named hooks compiled into the save/commit/offload
-  paths (`resilience.commit.fault_point`), normally a no-op. Setting
-  ``ATX_FAULT_KILL_AT=<point>`` makes the process ``os._exit(137)`` there
-  (the kill -9 analog: no atexit, no flush, no cleanup); setting
-  ``ATX_FAULT_RAISE_AT=<point>`` raises `FaultInjected` instead, for
-  in-process tests (e.g. the delayed-rename scenario: a save whose tmp dir
-  is fully written but never renamed).
+  paths and the serving replica loop (`resilience.commit.fault_point`),
+  normally a no-op. Setting ``ATX_FAULT_KILL_AT=<point>`` makes the
+  process ``os._exit(137)`` there (the kill -9 analog: no atexit, no
+  flush, no cleanup); ``ATX_FAULT_RAISE_AT=<point>`` raises
+  `FaultInjected` instead, for in-process tests (e.g. the delayed-rename
+  scenario, or killing ONE router replica thread without taking the
+  process); ``ATX_FAULT_HANG_AT=<point>`` parks the calling thread
+  forever — the wedged-collective analog the per-replica watchdog must
+  convert into a quarantine.
+
+Any spec may carry a hit count, ``<point>@N``: the fault fires on the
+Nth time execution reaches that point (process-wide counter) and never
+again — e.g. ``ATX_FAULT_RAISE_AT=router.replica0.step@5`` kills replica
+0 mid-decode, after it has already streamed tokens. Tests that reuse a
+counted spec in-process must call `_reset_counters()` between runs.
 
 Instrumented points:
 
@@ -25,6 +34,9 @@ Instrumented points:
 ``commit.before_marker``        renamed to final, ``COMMIT`` marker NOT written
 ``disk.after_sentinel``         disk-offload dirty sentinel written, moments
                                 NOT yet mutated/flushed
+``router.replica<i>.step``      router replica ``i``'s loop, after inbox
+                                messages are applied, BEFORE the engine step
+                                (`serving/router.py` failover injection)
 ==============================  =================================================
 """
 
@@ -32,6 +44,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from contextlib import contextmanager
 from typing import Iterator
 
@@ -41,18 +54,49 @@ KILL_EXIT_CODE = 137  # what a real `kill -9` reports (128 + SIGKILL)
 
 KILL_AT_ENV = "ATX_FAULT_KILL_AT"
 RAISE_AT_ENV = "ATX_FAULT_RAISE_AT"
+HANG_AT_ENV = "ATX_FAULT_HANG_AT"
+
+# Hits seen per counted spec ("point@N"); plain specs never touch this.
+_HIT_COUNTS: dict[str, int] = {}
 
 
 class FaultInjected(RuntimeError):
     """Raised at a crash point when ``ATX_FAULT_RAISE_AT`` names it."""
 
 
+def _reset_counters() -> None:
+    """Forget ``point@N`` hit counts (in-process tests reusing a spec)."""
+    _HIT_COUNTS.clear()
+
+
+def _should_fire(spec: str | None, name: str) -> bool:
+    """Does ``spec`` (``"point"`` or ``"point@N"``) fire at this visit of
+    ``name``? Counted specs fire exactly on the Nth visit."""
+    if spec is None:
+        return False
+    if spec == name:
+        return True
+    if spec.startswith(name + "@"):
+        try:
+            n = int(spec.rsplit("@", 1)[1])
+        except ValueError:
+            return False
+        _HIT_COUNTS[spec] = _HIT_COUNTS.get(spec, 0) + 1
+        return _HIT_COUNTS[spec] == n
+    return False
+
+
 def crash_point(name: str) -> None:
     """The hook body `resilience.commit.fault_point` dispatches to once a
     fault env var is present."""
-    if os.environ.get(RAISE_AT_ENV) == name:
+    if _should_fire(os.environ.get(RAISE_AT_ENV), name):
         raise FaultInjected(f"injected fault at crash point {name!r}")
-    if os.environ.get(KILL_AT_ENV) == name:
+    if _should_fire(os.environ.get(HANG_AT_ENV), name):
+        sys.stderr.write(f"[faults] wedge analog at crash point {name!r}\n")
+        sys.stderr.flush()
+        while True:  # park this thread forever — only a watchdog sees it
+            time.sleep(3600)
+    if _should_fire(os.environ.get(KILL_AT_ENV), name):
         sys.stderr.write(f"[faults] kill -9 analog at crash point {name!r}\n")
         sys.stderr.flush()
         os._exit(KILL_EXIT_CODE)

@@ -487,7 +487,12 @@ def _bench_serve(config) -> dict:
     shared-prefix trace (two 128-token system prompts) is served with the
     prefix cache on vs off: `serve_prefix_hit_rate`/`serve_prefill_saved`
     quantify the radix-tree KV reuse and the TTFT p50 pair shows the
-    time-to-first-token win (ISSUE-6)."""
+    time-to-first-token win (ISSUE-6). A last router phase replays the
+    same traces through the multi-replica front-end (`serving.Router`) at
+    replicas=2 vs 1 (`serve_router_scaling_efficiency`, TTFT p99) and
+    with prefix-affinity routing on vs off
+    (`serve_router_affinity_hit_delta`) — judge the scaling on TPU
+    (ISSUE-8)."""
     import dataclasses
 
     from accelerate_tpu import serving
@@ -618,6 +623,52 @@ def _bench_serve(config) -> dict:
     prefix_eng = prefix_results["prefix"][0]
     pm = prefix_eng.prefix_metrics()
 
+    # Router phase (ISSUE-8): the same Poisson trace through the
+    # multi-replica front-end at replicas=1 vs replicas=2 for aggregate
+    # tokens/sec + TTFT p99 scaling (each replica engine is warmed
+    # separately; on a shared-CPU host the two replica loops contend for
+    # the same cores, so judge `serve_router_scaling_efficiency` on TPU —
+    # this lane smoke-checks the path). Then the shared-prefix trace with
+    # prefix-affinity routing on vs off: the fleet hit-rate delta is what
+    # cache-aware placement buys over pure least-loaded.
+    def warm_router_engines(n: int, **kw) -> list:
+        engines = []
+        for _ in range(n):
+            e = fresh_engine(**kw)
+            e.serve(
+                serving.Request(
+                    prompt=rng.randint(
+                        0, gen_config.vocab_size, (S,)
+                    ).astype(np.int32),
+                    max_new_tokens=2,
+                    rid=3000 + S,
+                )
+                for S in buckets
+            )
+            engines.append(e)
+        return engines
+
+    router_tps, router_ttft_p99 = {}, {}
+    for n_rep in (1, 2):
+        with serving.Router(warm_router_engines(n_rep)) as router:
+            t0 = time.perf_counter()
+            done = router.serve([dataclasses.replace(r) for r in trace])
+            wall = max(time.perf_counter() - t0, 1e-9)
+        router_tps[n_rep] = sum(c.n_new for c in done) / wall
+        tt = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in done)
+        router_ttft_p99[n_rep] = pick(tt, 0.99)
+
+    affinity_hit_rate = {}
+    for label, policy in (("affinity", "prefix"), ("noaffinity", "least-loaded")):
+        engines = warm_router_engines(
+            2, prefix_cache=True, max_len=prefix_max_len
+        )
+        with serving.Router(engines, affinity=policy) as router:
+            router.serve([dataclasses.replace(r) for r in prefix_trace])
+        hits = sum(e.stats["prefix_hits"] for e in engines)
+        lookups = sum(e.prefix_cache.stats["lookups"] for e in engines)
+        affinity_hit_rate[label] = hits / max(lookups, 1)
+
     return {
         "serve_requests": n_requests,
         "serve_tokens_per_sec": round(serve_tps, 1),
@@ -643,6 +694,20 @@ def _bench_serve(config) -> dict:
         "serve_nocache_ttft_p50_ms": round(prefix_results["nocache"][1], 1),
         "serve_prefix_ttft_speedup": round(
             prefix_results["nocache"][1] / max(prefix_results["prefix"][1], 1e-9), 2
+        ),
+        "serve_router_r1_tokens_per_sec": round(router_tps[1], 1),
+        "serve_router_r2_tokens_per_sec": round(router_tps[2], 1),
+        "serve_router_scaling_efficiency": round(
+            router_tps[2] / max(2 * router_tps[1], 1e-9), 3
+        ),
+        "serve_router_r1_ttft_p99_ms": round(router_ttft_p99[1], 1),
+        "serve_router_r2_ttft_p99_ms": round(router_ttft_p99[2], 1),
+        "serve_router_affinity_hit_rate": round(affinity_hit_rate["affinity"], 3),
+        "serve_router_noaffinity_hit_rate": round(
+            affinity_hit_rate["noaffinity"], 3
+        ),
+        "serve_router_affinity_hit_delta": round(
+            affinity_hit_rate["affinity"] - affinity_hit_rate["noaffinity"], 3
         ),
     }
 

@@ -1,0 +1,124 @@
+"""Subprocess driver for the router SIGTERM drain test (tests/test_router.py).
+
+Serves a continuous stream of requests through a 2-replica `Router`
+(threads mode, preemption handler installed) until the parent delivers
+SIGTERM. The handler flips the preemption flag; the next `poll` drains:
+no new admissions, everything in flight finishes. The driver then
+re-checks a sample of completions token-for-token against a solo engine,
+writes a JSON report to argv[1], and exits `PREEMPTION_EXIT_CODE` (75) —
+the elastic-launcher resume contract (docs/fault_tolerance.md).
+
+Usage: python router_drain.py /path/to/report.json
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+import jax
+import numpy as np
+
+from accelerate_tpu import resilience, serving
+from accelerate_tpu.generation import GenerationConfig
+from accelerate_tpu.models import llama
+from accelerate_tpu.serving import Router, RouterDraining
+
+CFG = llama.LlamaConfig.tiny(vocab_size=61, max_seq_len=128, num_heads=4, num_kv_heads=2)
+MAX_NEW = 6
+
+
+def _apply(p, t, c):
+    return llama.forward_with_cache(p, t, c, CFG)
+
+
+def _init_cache(b, m):
+    return llama.init_cache(CFG, b, m)
+
+
+def _engine(params):
+    return serving.Engine(
+        _apply, _init_cache, params, GenerationConfig(),
+        slots=2, buckets=(8,), max_len=24, prefix_cache=False,
+    )
+
+
+def main() -> int:
+    report_path = sys.argv[1]
+    params = llama.init(jax.random.PRNGKey(0), CFG)
+    resilience.install_preemption_handler()
+    router = Router([_engine(params), _engine(params)])
+    rng = np.random.RandomState(0)
+    seeds: dict[int, int] = {}
+
+    def submit_one() -> int | None:
+        prompt = rng.randint(0, 61, (7,)).astype(np.int32)
+        seed = rng.randint(0, 2**31 - 1)
+        try:
+            rid = router.submit(prompt, MAX_NEW, seed=int(seed))
+        except (RouterDraining, serving.QueueFullError):
+            return None
+        seeds[rid] = int(seed)
+        return rid
+
+    # Warm both replicas (prefill + decode compiles) before announcing, so
+    # the parent's SIGTERM lands in steady-state serving, not a compile.
+    for _ in range(4):
+        submit_one()
+    router.join()
+    print("SERVING", flush=True)
+
+    deadline = time.time() + 90.0
+    while not router.draining:
+        if time.time() > deadline:
+            print("no SIGTERM within 90s", flush=True)
+            return 1
+        if len(router._pending) < router.queue_depth:
+            submit_one()
+        router.poll(0.002)
+    completions = router.pop_completions() + router.join()
+
+    # Drain must refuse new work.
+    admitted_after_drain = 0
+    try:
+        router.submit(np.arange(7, dtype=np.int32), MAX_NEW)
+        admitted_after_drain = 1
+    except RouterDraining:
+        pass
+    router.close()
+
+    # Bit-identity spot check: every completion is a pure function of
+    # (prompt, seed); replay a bounded sample through a solo engine.
+    solo = _engine(params)
+    sample = completions[:12] + completions[-12:] if len(completions) > 24 else completions
+    mismatches = 0
+    for c in sample:
+        solo.submit(c.prompt, MAX_NEW, seed=seeds[c.rid])
+        (want,) = solo.run_until_idle()
+        if not np.array_equal(c.tokens, want.tokens):
+            mismatches += 1
+
+    report = {
+        "completions": len(completions),
+        "submitted": router.stats["submitted"],
+        "drain_reason": router.drain_reason,
+        "verified": len(sample),
+        "mismatches": mismatches,
+        "admitted_after_drain": admitted_after_drain,
+    }
+    with open(report_path, "w") as f:
+        json.dump(report, f)
+    print(json.dumps(report), flush=True)
+    if mismatches or admitted_after_drain or not completions:
+        return 1
+    if router.drain_reason == "preemption":
+        return resilience.PREEMPTION_EXIT_CODE
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

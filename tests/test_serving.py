@@ -561,3 +561,62 @@ class TestStopAndBudget:
         eng = _engine(params)
         with pytest.raises(ValueError, match="empty"):
             eng.submit(np.arange(4, dtype=np.int32), 4, stop_sequences=[()])
+
+
+class TestCancelAndValidation:
+    """`Engine.cancel` (the Router's deadline/cancel primitive) and
+    submit-time validation of the bucket-padded plan (ISSUE-8)."""
+
+    def test_cancel_queued_request(self, params):
+        eng = _engine(params, slots=1)
+        blocker = np.arange(7, dtype=np.int32)
+        eng.submit(blocker, 6, seed=0)
+        victim = eng.submit(np.arange(5, dtype=np.int32), 6, seed=1)
+        c = eng.cancel(victim)
+        assert c is not None and c.finish_reason == "cancelled" and c.n_new == 0
+        assert eng.stats["cancelled"] == 1
+        (done,) = eng.run_until_idle()
+        np.testing.assert_array_equal(done.tokens, _solo(params, blocker, 6))
+
+    def test_cancel_mid_decode_keeps_partial_tokens_and_frees_slot(self, params):
+        eng = _engine(params, slots=1)
+        prompt = (np.arange(9, dtype=np.int32) * 5) % 61
+        rid = eng.submit(prompt, 12, seed=0)
+        for _ in range(5):  # prefill + a few decode steps
+            eng.step()
+        c = eng.cancel(rid)
+        assert c is not None and c.finish_reason == "cancelled"
+        assert 0 < c.n_new < 12
+        # The partial stream is a prefix of the solo run (determinism holds
+        # right up to the cancel)...
+        np.testing.assert_array_equal(
+            c.tokens[: c.n_new], _solo(params, prompt, 12)[: c.n_new]
+        )
+        # ...and the freed slot serves the next request bit-identically.
+        other = np.arange(6, dtype=np.int32)
+        eng.submit(other, 5, seed=3)
+        (done,) = eng.run_until_idle()
+        np.testing.assert_array_equal(done.tokens, _solo(params, other, 5))
+
+    def test_cancel_unknown_or_finished_rid_returns_none(self, params):
+        eng = _engine(params)
+        rid = eng.submit(np.arange(4, dtype=np.int32), 3)
+        eng.run_until_idle()
+        assert eng.cancel(rid) is None
+        assert eng.cancel(12345) is None
+        assert eng.stats["cancelled"] == 0
+
+    def test_padded_plan_overflow_rejected_at_submit(self, params):
+        """A prompt whose BUCKET-PADDED prefill plan exceeds max_len is
+        rejected at submit even when raw prompt + budget would fit: every
+        chunk writes a full bucket of KV positions, pad included."""
+        eng = _engine(params, buckets=(16,), max_len=42)
+        with pytest.raises(ValueError, match="bucket-padded"):
+            eng.submit(np.arange(36, dtype=np.int32) % 61, 6)
+        # Raw fit check still reads as before.
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit(np.arange(40, dtype=np.int32) % 61, 6)
+        # Control: an exact-bucket prompt with the same budget is fine.
+        rid = eng.submit(np.arange(32, dtype=np.int32) % 61, 6)
+        (c,) = eng.run_until_idle()
+        assert c.rid == rid and c.n_new == 6
