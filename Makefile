@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -69,8 +69,21 @@ lint-multihost:
 smoke-resilience:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -m 'not slow'
 
+# CPU replication lane (docs/fault_tolerance.md, "Checkpoint replication &
+# remote restore"): LocalObjectStore round-trip (save -> background upload
+# -> delete local root -> restore-from-remote, bit-identical), the
+# fault-injection subset (kill -9 mid-upload resumes skipping completed
+# parts; transient-error backoff bounded + jittered), then the
+# replicated_save host-loop replay under 2 simulated processes proving
+# replication adds NO collectives (error findings fail).
+smoke-replication:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_replication.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint replicated_save --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication
 	python -m pytest tests/ -q --heavy

@@ -257,6 +257,10 @@ class Accelerator:
         from . import resilience as _resilience
 
         self._gce_poller = _resilience.maintenance_poller_from_env()
+        # Durable checkpoint replication (resilience/replicate.py): opt-in
+        # via ATX_REPLICATE_URL — a background thread mirrors each committed
+        # checkpoint into the object store; None when replication is off.
+        self._replicator = _resilience.replicator_from_env()
         self._preemption_exit_started = False
         self._preemption_sync_calls = 0
         self._flag_tensor: jax.Array | None = None
@@ -1229,6 +1233,17 @@ class Accelerator:
         if wd is not None:
             wd.stop()
         checkpointing.wait_for_checkpoint()
+        if self._replicator is not None:
+            # The final checkpoint just landed in the queue (async saves
+            # joined above): give its upload the drain window, then stop.
+            from .resilience import replicate as _replicate
+
+            if not self._replicator.stop(_replicate.drain_secs_from_env()):
+                _replicate.logger.warning(
+                    "checkpoint replication queue did not drain before "
+                    "end_training returned; the last checkpoint may not be "
+                    "durable remotely (raise ATX_REPLICATE_DRAIN_SECS)"
+                )
 
     # -------------------------------------------------------------- triggers
     def set_trigger(self) -> None:
@@ -1333,6 +1348,26 @@ class Accelerator:
         from . import checkpointing
 
         path = checkpointing.save_state(self, None, state, async_save=False)
+        if self._replicator is not None:
+            # The emergency checkpoint is only preemption-proof once it is
+            # durable OFF this VM: flush the upload queue, bounded by
+            # ATX_REPLICATE_DRAIN_SECS so a dead store cannot eat the whole
+            # grace window (a SIGKILL mid-drain still leaves the local
+            # commit + any fully-uploaded parts for the next attempt).
+            from .resilience import replicate as _replicate
+
+            drain_secs = _replicate.drain_secs_from_env()
+            _sys.stderr.write(
+                "[accelerate_tpu] flushing checkpoint replication queue "
+                f"(up to {drain_secs:.0f}s) before preemption exit\n"
+            )
+            if not self._replicator.stop(drain_secs):
+                _sys.stderr.write(
+                    "[accelerate_tpu] replication queue did not drain in "
+                    "time; the emergency checkpoint may not be durable "
+                    "remotely (already-uploaded parts will be skipped on "
+                    "the next attempt)\n"
+                )
         _sys.stderr.write(
             f"[accelerate_tpu] emergency checkpoint committed at {path}; "
             f"exiting with code {resilience.PREEMPTION_EXIT_CODE} (elastic "

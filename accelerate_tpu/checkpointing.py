@@ -43,6 +43,7 @@ import random as _py_random
 import re
 import shutil
 import threading
+import time
 import warnings
 from typing import TYPE_CHECKING, Any, Iterable
 
@@ -52,6 +53,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .resilience import commit as _commit
+from .resilience import replicate as _replicate
 from .resilience.commit import CheckpointIntegrityWarning, fault_point as _fault_point
 from .utils.environment import get_int_from_env
 
@@ -757,8 +759,10 @@ def _barrier_and_commit(
         # Each node commits its own local directory carrying ONE manifest;
         # flag it so verify_checkpoint's completeness check (manifest count
         # vs num_processes) knows not to demand all of them here.
+        _commit.write_aggregate_manifest(tmp_dir)
         _commit.commit_dir(tmp_dir, final_dir, {**meta, "save_on_each_node": True})
         _rotate_after_commit(accelerator, final_dir)
+        _notify_replicator(accelerator, final_dir, proc, nproc, each_node=True)
         return
     if nproc > 1:
         if file_barrier:
@@ -772,12 +776,131 @@ def _barrier_and_commit(
         else:
             accelerator.process_state.wait_for_everyone()
     if proc == 0:
+        # Every peer's manifest is visible after the barrier: collapse them
+        # into MANIFEST.agg.json so the committed directory is verifiable
+        # even where peers' manifest files aren't (per-node filesystems,
+        # object-store replicas) — pure file IO, no collective.
+        _commit.write_aggregate_manifest(tmp_dir)
         _commit.commit_dir(tmp_dir, final_dir, meta)
         _rotate_after_commit(accelerator, final_dir)
+        _notify_replicator(accelerator, final_dir, proc, nproc, each_node=False)
     if nproc > 1 and not file_barrier:
         # Sync saves return only once the committed dir is visible to every
         # rank (callers immediately load/inspect the returned path).
         accelerator.process_state.wait_for_everyone()
+
+
+def _notify_replicator(
+    accelerator: "Accelerator",
+    final_dir: str,
+    proc: int,
+    nproc: int,
+    *,
+    each_node: bool,
+) -> None:
+    """Hand the freshly committed checkpoint to the background Replicator
+    (when ``ATX_REPLICATE_URL`` configured one). Runs only on the committing
+    process, does no IO itself (one queue put), and therefore adds nothing
+    to the collective schedule. Only automatic-naming checkpoints replicate:
+    the remote layout (and remote rotation) keys on ``checkpoint_<n>``."""
+    replicator = getattr(accelerator, "_replicator", None)
+    if replicator is None or not accelerator.project_config.automatic_checkpoint_naming:
+        return
+    replicator.enqueue(
+        final_dir,
+        process_index=proc,
+        num_processes=nproc,
+        each_node=each_node,
+        total_limit=accelerator.project_config.total_limit,
+    )
+
+
+def _backfill_replicator(accelerator: "Accelerator", final_dir: str) -> None:
+    """A checkpoint that committed locally right before a crash may never
+    have finished uploading (a kill -9 mid-upload leaves parts but no remote
+    ``COMMIT``). On resume, re-enqueue the checkpoint being resumed from:
+    the Replicator skips parts — and whole checkpoints — already durable
+    remotely, so this converges to one full remote commit instead of
+    leaving the newest checkpoint local-only forever."""
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    each_node = bool(accelerator.project_config.save_on_each_node)
+    if proc != 0 and not each_node:
+        return
+    _notify_replicator(accelerator, final_dir, proc, nproc, each_node=each_node)
+
+
+_REMOTE_RESTORE_SENTINEL = ".remote_restore_done"
+
+
+def _remote_restore(accelerator: "Accelerator", root: str) -> str | None:
+    """``resume="latest"`` fallback: when the local checkpoints root holds
+    nothing usable, download the newest remote *committed* checkpoint
+    (``ATX_REPLICATE_URL``) into ``root``. Returns the committed, verified
+    local path or None (no store configured / nothing durable remotely).
+
+    No collectives: on a shared filesystem process 0 downloads and then
+    records its verdict in a ``.remote_restore_done`` sentinel; peers poll
+    for the sentinel (and re-verify the directory it names) instead of
+    barriering — resume happens at startup, where a fresh collective would
+    change the schedule the ATX5xx lint pins. ``save_on_each_node`` roots
+    are per-process, so every process restores its own node directory.
+    """
+    # Prefer the store the Accelerator armed at construction time (the env
+    # may have changed since); fall back to the env for restore-only setups
+    # where replication uploads were never enabled.
+    replicator = getattr(accelerator, "_replicator", None)
+    store = replicator.store if replicator is not None else _replicate.store_from_env()
+    if store is None:
+        return None
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    each_node = bool(accelerator.project_config.save_on_each_node)
+    if each_node or nproc == 1:
+        return _replicate.restore_latest(
+            store,
+            root,
+            process_index=proc,
+            num_processes=nproc,
+            each_node=each_node,
+        )
+    sentinel = os.path.join(root, _REMOTE_RESTORE_SENTINEL)
+    if proc == 0:
+        os.makedirs(root, exist_ok=True)
+        try:
+            os.remove(sentinel)
+        except FileNotFoundError:
+            pass
+        restored = None
+        try:
+            restored = _replicate.restore_latest(store, root)
+        finally:
+            with open(sentinel, "w") as f:
+                f.write(os.path.basename(restored) if restored else "")
+                f.flush()
+                os.fsync(f.fileno())
+        return restored
+    deadline = time.monotonic() + _replicate._env_float(
+        "ATX_REPLICATE_TIMEOUT_SECS", 600.0
+    )
+    while time.monotonic() < deadline:
+        if os.path.exists(sentinel):
+            with open(sentinel) as f:
+                name = f.read().strip()
+            if not name:
+                return None  # process 0 found nothing usable remotely
+            candidate = os.path.join(root, name)
+            if _commit.is_committed(candidate) and not _commit.verify_checkpoint(
+                candidate
+            ):
+                return candidate
+            return None
+        time.sleep(0.25)
+    logger.warning(
+        "timed out waiting for process 0's remote checkpoint restore under %s",
+        root,
+    )
+    return None
 
 
 class _HostShardSnapshot:
@@ -852,6 +975,19 @@ def _load_state_impl(
         root = input_dir if input_dir is not None else checkpoint_root(accelerator)
         candidates = _commit.committed_checkpoints(root)
         if not candidates:
+            # Empty/lost local root (preempted VM, fresh node): fall back to
+            # the newest remote committed checkpoint when replication is on.
+            restored = _remote_restore(accelerator, root)
+            if restored is not None:
+                logger.info(
+                    "local root %s has no committed checkpoint; resuming "
+                    "from remote-restored %s",
+                    root,
+                    restored,
+                )
+                return _load_state_dir(
+                    accelerator, restored, state, dataloaders=dataloaders
+                )
             raise FileNotFoundError(
                 f"no committed checkpoint under {root!r} (directories without "
                 f"a {_commit.COMMIT_MARKER} marker are incomplete saves and "
@@ -871,8 +1007,22 @@ def _load_state_impl(
                 failures.append(f"{candidate}: {'; '.join(errors[:3])}")
                 continue
             logger.info("resuming from committed checkpoint %s", candidate)
+            _backfill_replicator(accelerator, candidate)
             return _load_state_dir(
                 accelerator, candidate, state, dataloaders=dataloaders
+            )
+        # Every local checkpoint is corrupt: a remote replica may still be
+        # intact (restore_latest re-downloads over corrupt local copies).
+        restored = _remote_restore(accelerator, root)
+        if restored is not None:
+            warnings.warn(
+                f"every committed checkpoint under {root!r} failed integrity "
+                f"verification; resuming from remote-restored {restored}",
+                CheckpointIntegrityWarning,
+                stacklevel=2,
+            )
+            return _load_state_dir(
+                accelerator, restored, state, dataloaders=dataloaders
             )
         raise ValueError(
             f"every committed checkpoint under {root!r} failed integrity "

@@ -115,6 +115,15 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "times after a worker death (torch-elastic max_restarts analog); "
         "default 0 = fail on first death",
     )
+    p.add_argument(
+        "--replicate_url",
+        default=None,
+        help="Object-store URL for durable checkpoint replication "
+        "(sets ATX_REPLICATE_URL in every worker: file:///path or a plain "
+        "path for the filesystem store, other schemes via "
+        "resilience.replicate.register_store_scheme — "
+        "docs/fault_tolerance.md)",
+    )
     p.add_argument("--dry_run", action="store_true", help="Print commands, don't run")
     p.add_argument("script", help="Training script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER, help="Script arguments")
@@ -152,6 +161,11 @@ def _merge_config(args: argparse.Namespace) -> LaunchConfig:
     for key, value in overrides.items():
         if value is not None:
             setattr(cfg, key, value)
+    if getattr(args, "replicate_url", None):
+        # Replication is plain env contract (workers read ATX_REPLICATE_URL
+        # in Accelerator.__init__); extra_env is applied last in
+        # build_child_env so the flag also wins over a config-file value.
+        cfg.extra_env = {**cfg.extra_env, "ATX_REPLICATE_URL": args.replicate_url}
     return cfg
 
 

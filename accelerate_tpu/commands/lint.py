@@ -58,7 +58,8 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         metavar="N",
         help="also verify multi-host SPMD consistency (ATX5xx) by replaying "
         "each scenario under N simulated processes; adds the host-loop "
-        "scenarios (save_path, preemption_exit) to the default set",
+        "scenarios (save_path, preemption_exit, router_drain, "
+        "replicated_save) to the default set",
     )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
@@ -418,10 +419,75 @@ def _mh_scenario_router_drain(processes: int = 2):
     )
 
 
+def _mh_scenario_replicated_save(processes: int = 2):
+    """checkpointing.save_state WITH checkpoint replication enabled
+    (ATX_REPLICATE_URL): the collective schedule must be IDENTICAL to the
+    plain save path — replication is queue + background object IO on the
+    committing process only, so turning it on must add zero collectives
+    (the acceptance gate for resilience/replicate.py). The loop also
+    drains the replicator and asserts the committing process actually
+    uploaded a remote-committed checkpoint."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis, checkpointing
+    from ..accelerator import Accelerator, TrainState
+    from ..state import AcceleratorState
+    from ..utils.dataclasses import ProjectConfiguration
+    from ..utils.environment import patch_environment
+
+    def replicated_save_loop():
+        AcceleratorState._reset_state()
+        root = tempfile.mkdtemp(prefix="atx_lint_mh_repl_")
+        store_root = tempfile.mkdtemp(prefix="atx_lint_mh_repl_store_")
+        with patch_environment(ATX_REPLICATE_URL=store_root):
+            acc = Accelerator(
+                seed=0,
+                project_config=ProjectConfiguration(
+                    project_dir=root, automatic_checkpoint_naming=True
+                ),
+            )
+            assert acc._replicator is not None, "replication did not arm"
+            params = {
+                "w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)
+            }
+            state = acc.prepare_train_state(
+                TrainState.create(params=params, tx=optax.sgd(1e-2))
+            )
+            step = acc.make_train_step(
+                lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+            )
+            state, _ = step(state, {"x": np.ones((8, 8), np.float32)})
+            checkpointing.save_state(acc, None, state, async_save=False)
+            assert acc._replicator.drain(60.0), "replication queue stuck"
+            if jax.process_index() == 0:
+                from ..resilience import replicate
+
+                assert acc._replicator.failures == 0, acc._replicator.last_error
+                remote = replicate.remote_committed_checkpoints(
+                    acc._replicator.store
+                )
+                assert remote, "committing process uploaded no remote commit"
+
+    report = analysis.lint_host_loop(
+        replicated_save_loop, processes=processes, target="replicated_save"
+    )
+    return (
+        f"train step + synchronous save_state with replication armed, "
+        f"{processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
     "router_drain": _mh_scenario_router_drain,
+    "replicated_save": _mh_scenario_replicated_save,
 }
 
 

@@ -23,6 +23,12 @@ notice, or a hung step a *recoverable* event:
   wedges, it dumps every Python thread's stack and aborts the process with
   ``WATCHDOG_EXIT_CODE`` so the elastic restart fires instead of the pod
   hanging forever.
+- :mod:`~accelerate_tpu.resilience.replicate` — durable checkpoint
+  replication: a background `Replicator` mirrors every committed
+  checkpoint into a pluggable `ObjectStore` (``ATX_REPLICATE_URL``) with
+  resumable part uploads, retry/backoff, and a remote ``COMMIT`` marker
+  written last; `restore_latest` brings the newest remote committed
+  checkpoint back when the local root is lost.
 
 Fault-injection hooks (`commit.fault_point`) are no-ops unless one of the
 ``ATX_FAULT_{KILL,RAISE}_AT`` env vars is set; the test harness that drives
@@ -30,6 +36,7 @@ them lives in `test_utils/faults.py`. See docs/fault_tolerance.md.
 """
 
 from .commit import (
+    AGG_MANIFEST,
     COMMIT_MARKER,
     TMP_SUFFIX,
     CheckpointIntegrityWarning,
@@ -40,9 +47,22 @@ from .commit import (
     latest_committed,
     remove_stale_tmp,
     verify_checkpoint,
+    write_aggregate_manifest,
     write_manifest,
 )
 from .gce import MaintenancePoller, maintenance_poller_from_env
+from .replicate import (
+    LocalObjectStore,
+    ObjectStore,
+    ObjectStoreError,
+    Replicator,
+    register_store_scheme,
+    remote_committed_checkpoints,
+    replicator_from_env,
+    restore_latest,
+    store_for_url,
+    store_from_env,
+)
 from .preemption import (
     PREEMPTION_EXIT_CODE,
     clear_preemption,
@@ -53,11 +73,16 @@ from .preemption import (
 from .watchdog import WATCHDOG_EXIT_CODE, Watchdog, dump_all_stacks, watchdog_from_env
 
 __all__ = [
+    "AGG_MANIFEST",
     "COMMIT_MARKER",
     "TMP_SUFFIX",
     "CheckpointIntegrityWarning",
+    "LocalObjectStore",
     "MaintenancePoller",
+    "ObjectStore",
+    "ObjectStoreError",
     "PREEMPTION_EXIT_CODE",
+    "Replicator",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "clear_preemption",
@@ -70,9 +95,16 @@ __all__ = [
     "is_committed",
     "latest_committed",
     "preemption_requested",
+    "register_store_scheme",
+    "remote_committed_checkpoints",
     "remove_stale_tmp",
+    "replicator_from_env",
     "request_preemption",
+    "restore_latest",
+    "store_for_url",
+    "store_from_env",
     "verify_checkpoint",
     "watchdog_from_env",
+    "write_aggregate_manifest",
     "write_manifest",
 ]

@@ -38,6 +38,7 @@ from typing import Any
 COMMIT_MARKER = "COMMIT"
 TMP_SUFFIX = ".tmp"
 MANIFEST_FILE = "manifest_{proc}.json"
+AGG_MANIFEST = "MANIFEST.agg.json"
 PRECOMMIT_FILE = ".precommit_{proc}"
 _MANIFEST_PATTERN = re.compile(r"^manifest_(\d+)\.json$")
 _CKPT_PATTERN = re.compile(r"^checkpoint_(\d+)$")
@@ -73,13 +74,15 @@ def fault_point(name: str) -> None:
     """Fault-injection hook. No-op (one dict lookup) unless the test harness
     set ``ATX_FAULT_KILL_AT`` (simulated kill -9 via ``os._exit``),
     ``ATX_FAULT_RAISE_AT`` (in-process `FaultInjected`), or
-    ``ATX_FAULT_HANG_AT`` (park the thread — the wedge analog) — see
-    `test_utils/faults.py` for the instrumented points and the ``point@N``
-    fire-on-Nth-hit syntax."""
+    ``ATX_FAULT_HANG_AT`` (park the thread — the wedge analog), or
+    ``ATX_FAULT_DELAY_AT`` (inject ``ATX_FAULT_DELAY_SECS`` of latency —
+    the slow-transport analog) — see `test_utils/faults.py` for the
+    instrumented points and the ``point@N`` fire-on-Nth-hit syntax."""
     if (
         "ATX_FAULT_KILL_AT" in os.environ
         or "ATX_FAULT_RAISE_AT" in os.environ
         or "ATX_FAULT_HANG_AT" in os.environ
+        or "ATX_FAULT_DELAY_AT" in os.environ
     ):
         from ..test_utils.faults import crash_point
 
@@ -128,6 +131,55 @@ def write_manifest(
     return out
 
 
+def write_aggregate_manifest(directory: str) -> str | None:
+    """Collapse every ``manifest_<proc>.json`` in ``directory`` into one
+    ``MANIFEST.agg.json``.
+
+    Written by process 0 AFTER the commit barrier (every peer's manifest is
+    visible then) and BEFORE ``commit_dir``, so the aggregate rides inside
+    the committed directory. It exists for filesystems that are per-node
+    rather than shared: a replica downloaded onto (or verified on) a node
+    that never held peers' ``manifest_<proc>.json`` files can still answer
+    "which processes wrote this checkpoint, with which files, at which
+    step" — `verify_checkpoint` falls back to it for any process whose
+    per-proc manifest is absent. Returns the path, or None when there are
+    no manifests to aggregate (pre-manifest legacy directories)."""
+    processes: dict[str, Any] = {}
+    for mpath in _manifest_paths(directory):
+        proc = _MANIFEST_PATTERN.match(os.path.basename(mpath)).group(1)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        entry: dict[str, Any] = {"files": manifest["files"]}
+        if manifest.get("step") is not None:
+            entry["step"] = int(manifest["step"])
+        processes[proc] = entry
+    if not processes:
+        return None
+    out = os.path.join(directory, AGG_MANIFEST)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"version": 1, "num_processes": len(processes), "processes": processes},
+            f,
+        )
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+def _read_aggregate(directory: str) -> dict[int, dict[str, Any]]:
+    """``{proc: {"files": ..., "step": ...}}`` from ``MANIFEST.agg.json``,
+    or ``{}`` when absent. Raises ValueError on a present-but-unparseable
+    aggregate (corruption, not legacy)."""
+    path = os.path.join(directory, AGG_MANIFEST)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        payload = json.load(f)
+    return {int(proc): entry for proc, entry in payload["processes"].items()}
+
+
 def _manifest_paths(directory: str) -> list[str]:
     if not os.path.isdir(directory):
         return []
@@ -156,6 +208,16 @@ def verify_checkpoint(directory: str) -> list[str]:
     with no manifest and no ``COMMIT`` marker is treated as a pre-manifest
     legacy checkpoint and passes vacuously; a *committed* directory with no
     manifest is an error (the protocol writes manifests before the marker).
+
+    **Aggregate fallback** (per-node filesystems): a process whose
+    ``manifest_<proc>.json`` is absent but which appears in
+    ``MANIFEST.agg.json`` is verified from the aggregate instead. If NONE
+    of that process's files exist locally the checkpoint is per-node (the
+    peer's shards live on its own disk) and the process passes; if SOME
+    exist, the partial set is corruption and every absent file is an error.
+    Completeness counts aggregate-covered processes as writers, so losing a
+    peer's manifest no longer amputates the checkpoint — while legacy
+    directories (no aggregate) verify exactly as before.
     """
     if not os.path.isdir(directory):
         return [f"{directory} is not a directory"]
@@ -166,32 +228,35 @@ def verify_checkpoint(directory: str) -> list[str]:
         except (ValueError, OSError) as e:
             return [f"unreadable {COMMIT_MARKER} marker: {e}"]
     manifests = _manifest_paths(directory)
-    if not manifests:
+    try:
+        aggregate = _read_aggregate(directory)
+    except (ValueError, KeyError, OSError) as e:
+        return [f"unreadable {AGG_MANIFEST}: {e}"]
+    if not manifests and not aggregate:
         if is_committed(directory):
             return [f"committed checkpoint {directory} has no manifest files"]
         return []
     errors: list[str] = []
+    on_disk_procs = {
+        int(_MANIFEST_PATTERN.match(os.path.basename(p)).group(1))
+        for p in manifests
+    }
+    covered_procs = on_disk_procs | set(aggregate)
     recorded_procs = marker.get("num_processes")
     if recorded_procs is not None and not marker.get("save_on_each_node"):
-        if len(manifests) != int(recorded_procs):
+        if len(covered_procs) != int(recorded_procs):
             errors.append(
-                f"manifest count mismatch: {len(manifests)} manifest file(s) "
-                f"on disk but the {COMMIT_MARKER} marker records "
+                f"manifest count mismatch: {len(covered_procs)} writer "
+                f"process(es) covered by manifests on disk + {AGG_MANIFEST} "
+                f"but the {COMMIT_MARKER} marker records "
                 f"{recorded_procs} writer process(es)"
             )
     steps: dict[int, list[str]] = {}
-    for mpath in manifests:
-        try:
-            with open(mpath) as f:
-                manifest = json.load(f)
-            entries = manifest["files"]
-        except (ValueError, KeyError) as e:
-            errors.append(f"unreadable manifest {os.path.basename(mpath)}: {e}")
-            continue
-        if manifest.get("step") is not None:
-            steps.setdefault(int(manifest["step"]), []).append(
-                os.path.basename(mpath)
-            )
+
+    def _check_entries(entries: dict[str, Any], *, require_all: bool) -> None:
+        present = [rel for rel in entries if os.path.exists(os.path.join(directory, rel))]
+        if not require_all and not present:
+            return  # per-node checkpoint: this process's files live elsewhere
         for rel, info in entries.items():
             path = os.path.join(directory, rel)
             if not os.path.exists(path):
@@ -206,6 +271,27 @@ def verify_checkpoint(directory: str) -> list[str]:
                 continue
             if file_sha256(path) != info["sha256"]:
                 errors.append(f"sha256 mismatch for {rel}")
+
+    for mpath in manifests:
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            entries = manifest["files"]
+        except (ValueError, KeyError) as e:
+            errors.append(f"unreadable manifest {os.path.basename(mpath)}: {e}")
+            continue
+        if manifest.get("step") is not None:
+            steps.setdefault(int(manifest["step"]), []).append(
+                os.path.basename(mpath)
+            )
+        _check_entries(entries, require_all=True)
+    for proc in sorted(set(aggregate) - on_disk_procs):
+        entry = aggregate[proc]
+        if entry.get("step") is not None:
+            steps.setdefault(int(entry["step"]), []).append(
+                f"{AGG_MANIFEST}[{proc}]"
+            )
+        _check_entries(entry["files"], require_all=False)
     if len(steps) > 1:
         errors.append(
             "cross-process step mismatch: "

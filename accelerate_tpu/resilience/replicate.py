@@ -1,0 +1,673 @@
+"""Durable checkpoint replication to object storage.
+
+A committed local checkpoint (`resilience/commit.py`) survives a kill -9 —
+but not the loss of the node it lives on: a preempted TPU VM takes its
+local disk with it. The :class:`Replicator` closes that gap by mirroring
+every committed checkpoint into an :class:`ObjectStore` in the background:
+
+- **Resumable, part-based uploads.** Each manifest-listed file is one
+  *part*, content-addressed by the SHA-256 the PR-4 manifests already
+  record. Before uploading a part the remote object is stat'ed; a part
+  whose remote size (and hash, when the store can report one) matches the
+  manifest is skipped — so a replication attempt killed mid-upload resumes
+  where it left off instead of re-shipping gigabytes.
+- **Remote COMMIT marker last.** The remote directory follows the exact
+  local commit protocol: data parts, then the per-process manifests, then
+  ``MANIFEST.agg.json``, then the ``COMMIT`` marker — a remote checkpoint
+  is *durable* if and only if its marker exists, and a crash at any upload
+  instant leaves debris the restore path ignores.
+- **Bounded retry with jittered exponential backoff** on transport errors
+  (``ATX_REPLICATE_RETRIES``, per-checkpoint deadline
+  ``ATX_REPLICATE_TIMEOUT_SECS``), plus an optional bandwidth throttle
+  (``ATX_REPLICATE_BANDWIDTH_MIB_S``) so replication never starves the
+  training job's network.
+- **Graceful degradation.** Replication runs on a daemon worker thread and
+  issues NO collectives; a permanently failing store logs a warning and
+  training continues — durability is best-effort, the step loop is not.
+
+Restore: `restore_latest` walks remote *committed* checkpoints newest
+first, downloads into a local ``.tmp`` dir, republishes it with the local
+commit protocol (marker written last), and `verify_checkpoint`s the result
+— `checkpointing.load_state(resume="latest")` falls back to it when the
+local checkpoint root is empty or entirely corrupt.
+
+Like `resilience/commit.py`, this module is dependency-free (no jax) so
+the launcher and tests can import it cheaply. Enable with
+``ATX_REPLICATE_URL=<store url>`` (``file:///path`` or a plain path for
+the filesystem store; other schemes via `register_store_scheme`); force
+off with ``ATX_REPLICATE=0``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import random
+import re
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..utils.environment import get_int_from_env, parse_flag_from_env
+from . import commit as _commit
+from .commit import fault_point
+
+logger = logging.getLogger(__name__)
+
+REPLICATE_URL_ENV = "ATX_REPLICATE_URL"
+REPLICATE_ENV = "ATX_REPLICATE"
+
+
+class ObjectStoreError(RuntimeError):
+    """A store operation failed (transport errors raise subclasses or any
+    exception the backing client uses — the Replicator retries them all)."""
+
+
+class TransientStoreError(ObjectStoreError):
+    """A retryable transport failure (timeouts, 5xx, connection resets)."""
+
+
+@dataclass
+class ObjectStat:
+    """Metadata for a stored object. ``sha256`` is None when the store
+    cannot report a content hash cheaply (the skip check then falls back to
+    size-only and the final verify_checkpoint still catches corruption)."""
+
+    size: int
+    sha256: str | None = None
+
+
+class ObjectStore:
+    """Minimal object-store interface the Replicator uploads through.
+
+    Contract: ``put_file``/``put_bytes`` must be **atomic** — a reader may
+    observe the object fully written or not at all, never a partial body
+    (every real object store and the tmp+rename filesystem implementation
+    below satisfy this). Keys are ``/``-separated paths; there are no
+    directories, only prefixes.
+    """
+
+    def put_file(self, local_path: str, key: str) -> None:
+        raise NotImplementedError
+
+    def put_bytes(self, data: bytes, key: str) -> None:
+        raise NotImplementedError
+
+    def get_file(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def get_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        return self.stat(key) is not None
+
+    def stat(self, key: str) -> ObjectStat | None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        """All keys under ``prefix`` (recursive), sorted."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> int:
+        n = 0
+        for key in self.list(prefix):
+            self.delete(key)
+            n += 1
+        return n
+
+
+class LocalObjectStore(ObjectStore):
+    """Filesystem-backed store (tests, CI, and NFS/FUSE-mounted buckets).
+
+    Writes are atomic (tempfile + ``os.replace``), `stat` reports a real
+    SHA-256 (files are hashed on demand), so the resumable-upload skip
+    check is exact here."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, key))
+        if not path.startswith(self.root + os.sep) and path != self.root:
+            raise ObjectStoreError(f"key {key!r} escapes store root {self.root!r}")
+        return path
+
+    def put_file(self, local_path: str, key: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + f".put.{os.getpid()}"
+        shutil.copyfile(local_path, tmp)
+        os.replace(tmp, dst)
+
+    def put_bytes(self, data: bytes, key: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + f".put.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dst)
+
+    def get_file(self, key: str, local_path: str) -> None:
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no object {key!r} in {self.root}")
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        shutil.copyfile(src, local_path)
+
+    def get_bytes(self, key: str) -> bytes:
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no object {key!r} in {self.root}")
+        with open(src, "rb") as f:
+            return f.read()
+
+    def stat(self, key: str) -> ObjectStat | None:
+        path = self._path(key)
+        if not os.path.isfile(path):
+            return None
+        return ObjectStat(
+            size=os.path.getsize(path), sha256=_commit.file_sha256(path)
+        )
+
+    def list(self, prefix: str = "") -> list[str]:
+        out: list[str] = []
+        for dirpath, _, filenames in os.walk(self.root):
+            for name in filenames:
+                if name.endswith(f".put.{os.getpid()}"):
+                    continue  # in-flight atomic writes are not objects yet
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def __repr__(self) -> str:
+        return f"LocalObjectStore({self.root!r})"
+
+
+# ------------------------------------------------------------ scheme registry
+_SCHEME_REGISTRY: dict[str, Callable[[str], ObjectStore]] = {}
+
+
+def register_store_scheme(scheme: str, factory: Callable[[str], ObjectStore]) -> None:
+    """Register ``factory(url) -> ObjectStore`` for ``<scheme>://`` URLs —
+    how a deployment plugs in GCS/S3/etc. without this package depending on
+    any cloud SDK."""
+    _SCHEME_REGISTRY[scheme.lower()] = factory
+
+
+def store_for_url(url: str) -> ObjectStore:
+    """Resolve a store URL. ``file:///path`` and bare paths map to
+    `LocalObjectStore`; other schemes must have been registered via
+    `register_store_scheme` (``gs://`` ships a stub that explains how)."""
+    m = re.match(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://(.*)$", url)
+    if not m:
+        return LocalObjectStore(url)
+    scheme, rest = m.group(1).lower(), m.group(2)
+    factory = _SCHEME_REGISTRY.get(scheme)
+    if factory is None:
+        raise ObjectStoreError(
+            f"no ObjectStore registered for scheme {scheme!r} (url {url!r}); "
+            "call resilience.replicate.register_store_scheme("
+            f"{scheme!r}, factory) first — known schemes: "
+            f"{sorted(_SCHEME_REGISTRY)}"
+        )
+    return factory(url if scheme not in ("file",) else rest)
+
+
+def _file_store(path: str) -> ObjectStore:
+    # file://HOST/path has an empty host for local URLs: file:///a/b -> /a/b
+    return LocalObjectStore("/" + path.lstrip("/") if path.startswith("/") else path)
+
+
+def _gcs_store(url: str) -> ObjectStore:
+    raise ObjectStoreError(
+        f"the built-in gs:// handler is a placeholder ({url!r}): install a "
+        "GCS client and register a real store, e.g.\n"
+        "    from accelerate_tpu.resilience import replicate\n"
+        "    replicate.register_store_scheme('gs', MyGcsStore.from_url)\n"
+        "— or mount the bucket (gcsfuse) and point ATX_REPLICATE_URL at the "
+        "mount path to use the filesystem store."
+    )
+
+
+register_store_scheme("file", _file_store)
+register_store_scheme("gs", _gcs_store)
+
+
+# ----------------------------------------------------------------- replicator
+@dataclass
+class _Job:
+    directory: str
+    process_index: int
+    num_processes: int
+    each_node: bool
+    total_limit: int | None
+
+
+def _env_float(key: str, default: float) -> float:
+    try:
+        return float(os.environ.get(key, "") or default)
+    except ValueError:
+        return default
+
+
+class Replicator:
+    """Background uploader: `enqueue` committed checkpoint directories, a
+    daemon worker mirrors them into ``store`` with the remote commit
+    protocol. Failure NEVER propagates to the caller — a checkpoint that
+    could not be replicated is logged (`failures` counter) and training
+    continues; the next enqueue retries nothing retroactively (the next
+    checkpoint supersedes it anyway).
+    """
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        *,
+        retries: int | None = None,
+        timeout_secs: float | None = None,
+        bandwidth_mib_s: float | None = None,
+    ) -> None:
+        self.store = store
+        self.retries = (
+            retries
+            if retries is not None
+            else get_int_from_env(("ATX_REPLICATE_RETRIES",), 5)
+        )
+        self.timeout_secs = (
+            timeout_secs
+            if timeout_secs is not None
+            else _env_float("ATX_REPLICATE_TIMEOUT_SECS", 600.0)
+        )
+        self.bandwidth_mib_s = (
+            bandwidth_mib_s
+            if bandwidth_mib_s is not None
+            else _env_float("ATX_REPLICATE_BANDWIDTH_MIB_S", 0.0)
+        )
+        self._queue: "queue.Queue[_Job]" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stopped = False
+        self._lock = threading.Lock()
+        # Observability counters (read by tests and the drain log line).
+        self.parts_uploaded = 0
+        self.parts_skipped = 0
+        self.checkpoints_replicated = 0
+        self.failures = 0
+        self.last_error: str | None = None
+
+    # ------------------------------------------------------------- lifecycle
+    def enqueue(
+        self,
+        directory: str,
+        *,
+        process_index: int = 0,
+        num_processes: int = 1,
+        each_node: bool = False,
+        total_limit: int | None = None,
+    ) -> None:
+        """Queue a *committed* checkpoint directory for upload. Called by
+        the committing process right after local rotation; cheap (no IO)."""
+        if self._stopped:
+            return
+        self._idle.clear()
+        self._queue.put(
+            _Job(directory, process_index, num_processes, each_node, total_limit)
+        )
+        self._ensure_thread()
+
+    def drain(self, timeout_secs: float) -> bool:
+        """Block until every queued upload finished (or failed), up to the
+        deadline. Returns True when the queue fully drained — the
+        emergency-save flush before a preemption exit."""
+        deadline = time.monotonic() + max(0.0, timeout_secs)
+        while time.monotonic() < deadline:
+            if self._idle.is_set() and self._queue.empty():
+                return True
+            time.sleep(0.05)
+        return self._idle.is_set() and self._queue.empty()
+
+    def stop(self, drain_secs: float = 0.0) -> bool:
+        """Stop accepting work; optionally drain first. Returns the drain
+        verdict (True when nothing was pending)."""
+        drained = self.drain(drain_secs) if drain_secs > 0 else (
+            self._idle.is_set() and self._queue.empty()
+        )
+        self._stopped = True
+        return drained
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="atx-replicator", daemon=True
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            try:
+                job = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                self._idle.set()
+                continue
+            try:
+                self._replicate(job)
+                self.checkpoints_replicated += 1
+            except BaseException as e:  # NEVER crash the step loop
+                self.failures += 1
+                self.last_error = f"{type(e).__name__}: {e}"
+                logger.warning(
+                    "checkpoint replication of %s failed (%s) — training "
+                    "continues; this checkpoint is NOT durable in %r",
+                    job.directory,
+                    self.last_error,
+                    self.store,
+                )
+            finally:
+                self._queue.task_done()
+                if self._queue.empty():
+                    self._idle.set()
+
+    # ----------------------------------------------------------------- upload
+    def _remote_prefix(self, job: _Job) -> str:
+        # save_on_each_node commits one directory per process; namespace the
+        # remote copies per node so they never collide.
+        name = os.path.basename(os.path.abspath(job.directory))
+        if job.each_node and job.num_processes > 1:
+            return f"node_{job.process_index}/{name}"
+        return name
+
+    def _replicate(self, job: _Job) -> None:
+        directory = job.directory
+        if not _commit.is_committed(directory):
+            raise ObjectStoreError(
+                f"{directory} is not a committed checkpoint (no "
+                f"{_commit.COMMIT_MARKER} marker) — refusing to replicate"
+            )
+        deadline = time.monotonic() + self.timeout_secs
+        prefix = self._remote_prefix(job)
+        if self.store.exists(f"{prefix}/{_commit.COMMIT_MARKER}"):
+            # Already durable (a backfill re-enqueue after resume, or a
+            # duplicate notify): nothing to do — remote commits are final.
+            return
+        t0 = time.monotonic()
+        uploaded0, skipped0 = self.parts_uploaded, self.parts_skipped
+        # 1. data parts: every manifest-listed file, content-addressed by
+        #    the manifest's SHA-256 (skip parts already durable remotely).
+        manifests = sorted(
+            n
+            for n in os.listdir(directory)
+            if _commit._MANIFEST_PATTERN.match(n)
+        )
+        if not manifests:
+            raise ObjectStoreError(
+                f"{directory} has no manifests; pre-manifest legacy "
+                "checkpoints are not replicated"
+            )
+        for mname in manifests:
+            with open(os.path.join(directory, mname)) as f:
+                manifest = json.load(f)
+            for rel, info in manifest["files"].items():
+                self._upload_part(directory, prefix, rel, info, deadline)
+        # 2. the manifests themselves, then the aggregate — a restore needs
+        #    them to verify, so they precede the marker.
+        for mname in manifests:
+            self._upload_part(directory, prefix, mname, None, deadline)
+        if os.path.exists(os.path.join(directory, _commit.AGG_MANIFEST)):
+            self._upload_part(directory, prefix, _commit.AGG_MANIFEST, None, deadline)
+        # 3. remote COMMIT marker LAST: the remote durability point.
+        fault_point("replicate.before_marker")
+        marker = os.path.join(directory, _commit.COMMIT_MARKER)
+        self._with_retries(
+            f"{prefix}/{_commit.COMMIT_MARKER}",
+            lambda: self.store.put_file(marker, f"{prefix}/{_commit.COMMIT_MARKER}"),
+            deadline,
+        )
+        logger.info(
+            "replicated %s -> %r (%d parts uploaded, %d already durable, "
+            "%.1fs)",
+            directory,
+            self.store,
+            self.parts_uploaded - uploaded0,
+            self.parts_skipped - skipped0,
+            time.monotonic() - t0,
+        )
+        # 4. remote rotation mirrors the local total_limit — only AFTER the
+        #    new remote commit landed, and never the checkpoint just written.
+        if job.total_limit is not None:
+            self._rotate_remote(job, prefix)
+
+    def _upload_part(
+        self,
+        directory: str,
+        prefix: str,
+        rel: str,
+        info: dict[str, Any] | None,
+        deadline: float,
+    ) -> None:
+        local = os.path.join(directory, rel)
+        key = f"{prefix}/{rel.replace(os.sep, '/')}"
+        if info is not None:
+            remote = self._with_retries(key, lambda: self.store.stat(key), deadline)
+            if (
+                remote is not None
+                and remote.size == info["size"]
+                and (remote.sha256 is None or remote.sha256 == info["sha256"])
+            ):
+                self.parts_skipped += 1
+                return
+        self._throttle(os.path.getsize(local))
+        self._with_retries(key, lambda: self.store.put_file(local, key), deadline)
+        self.parts_uploaded += 1
+        fault_point("replicate.part_uploaded")
+
+    def _throttle(self, nbytes: int) -> None:
+        """Pace uploads to ATX_REPLICATE_BANDWIDTH_MIB_S by sleeping the
+        difference between real elapsed time and the budgeted transfer
+        time — a token-bucket without burst credit, so a background
+        replication cannot saturate the NIC the training collectives use."""
+        if self.bandwidth_mib_s <= 0:
+            return
+        budget = nbytes / (self.bandwidth_mib_s * (1 << 20))
+        now = time.monotonic()
+        ready_at = max(getattr(self, "_next_send_at", now), now)
+        self._next_send_at = ready_at + budget
+        wait = ready_at - now
+        if wait > 0:
+            time.sleep(wait)
+
+    def _with_retries(self, desc: str, fn: Callable[[], Any], deadline: float) -> Any:
+        """Bounded exponential backoff + full jitter (the coordinator-init
+        policy from `state.py`): 0.5s -> 1s -> 2s ... capped at 30s, each
+        multiplied by 1+U(0,1); gives up on the retry budget OR the
+        per-checkpoint deadline, whichever comes first."""
+        delay = 0.5
+        failures = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                failures += 1
+                if failures > self.retries or time.monotonic() >= deadline:
+                    raise
+                sleep_for = min(delay * (1.0 + random.random()), 30.0)
+                logger.warning(
+                    "transient store error on %s (attempt %d/%d): %s — "
+                    "retrying in %.1fs",
+                    desc,
+                    failures,
+                    self.retries,
+                    e,
+                    sleep_for,
+                )
+                self._sleep(sleep_for)
+                delay = min(delay * 2.0, 30.0)
+
+    def _sleep(self, secs: float) -> None:  # test seam
+        time.sleep(secs)
+
+    # --------------------------------------------------------------- rotation
+    def _rotate_remote(self, job: _Job, current_prefix: str) -> None:
+        root = f"node_{job.process_index}/" if (job.each_node and job.num_processes > 1) else ""
+        committed = remote_committed_checkpoints(self.store, node_prefix=root)
+        keep = max(0, len(committed) - int(job.total_limit))
+        for n, prefix in committed[:keep]:
+            if prefix == current_prefix:
+                continue
+            try:
+                self.store.delete_prefix(prefix + "/")
+            except Exception as e:  # rotation is best-effort housekeeping
+                logger.warning("remote rotation of %s failed: %s", prefix, e)
+
+
+# ------------------------------------------------------------------- restore
+def remote_committed_checkpoints(
+    store: ObjectStore, *, node_prefix: str = ""
+) -> list[tuple[int, str]]:
+    """``(iteration, remote_prefix)`` for every remote checkpoint whose
+    ``COMMIT`` marker exists, sorted oldest -> newest — the remote analog of
+    `commit.committed_checkpoints` (uncommitted upload debris is invisible
+    by construction)."""
+    out: list[tuple[int, str]] = []
+    for key in store.list(node_prefix):
+        rel = key[len(node_prefix):]
+        m = re.match(r"^checkpoint_(\d+)/" + re.escape(_commit.COMMIT_MARKER) + "$", rel)
+        if m:
+            out.append((int(m.group(1)), node_prefix + f"checkpoint_{m.group(1)}"))
+    return sorted(out)
+
+
+def restore_latest(
+    store: ObjectStore,
+    local_root: str,
+    *,
+    process_index: int = 0,
+    num_processes: int = 1,
+    each_node: bool = False,
+) -> str | None:
+    """Download the newest remote *committed* checkpoint into
+    ``local_root`` and republish it under the local commit protocol.
+
+    Walks remote committed checkpoints newest first; each candidate is
+    downloaded into ``<final>.tmp`` (invisible to resume), renamed, its
+    ``COMMIT`` marker written LAST (so a crash mid-download leaves only
+    debris the next save's rotation reclaims), then `verify_checkpoint`'d —
+    a candidate whose downloaded bytes fail verification is deleted and the
+    next older one is tried. Returns the committed local path, or None when
+    the store holds nothing usable. No collectives: multi-host callers
+    coordinate by letting process 0 download onto the shared filesystem
+    while peers poll for the committed directory to appear.
+    """
+    node_prefix = f"node_{process_index}/" if (each_node and num_processes > 1) else ""
+    candidates = remote_committed_checkpoints(store, node_prefix=node_prefix)
+    for n, prefix in reversed(candidates):
+        final = os.path.join(local_root, f"checkpoint_{n}")
+        if _commit.is_committed(final) and not _commit.verify_checkpoint(final):
+            return final  # already present AND intact locally
+        # absent — or committed locally but corrupt: re-download over it
+        tmp = final + _commit.TMP_SUFFIX
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(final, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            keys = store.list(prefix + "/")
+            marker_key = f"{prefix}/{_commit.COMMIT_MARKER}"
+            for key in keys:
+                rel = key[len(prefix) + 1 :]
+                if key == marker_key:
+                    continue
+                store.get_file(key, os.path.join(tmp, rel.replace("/", os.sep)))
+            marker_bytes = store.get_bytes(marker_key)
+        except Exception as e:
+            logger.warning(
+                "download of remote checkpoint %s failed: %s — trying the "
+                "previous one",
+                prefix,
+                e,
+            )
+            shutil.rmtree(tmp, ignore_errors=True)
+            continue
+        os.rename(tmp, final)
+        # Local COMMIT written last, atomically — same ordering as commit_dir.
+        marker_path = os.path.join(final, _commit.COMMIT_MARKER)
+        mtmp = marker_path + ".tmp"
+        with open(mtmp, "wb") as f:
+            f.write(marker_bytes)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, marker_path)
+        _commit._fsync_dir(final)
+        errors = _commit.verify_checkpoint(final)
+        if errors:
+            logger.warning(
+                "remote checkpoint %s failed verification after download "
+                "(%s) — trying the previous one",
+                prefix,
+                "; ".join(errors[:3]),
+            )
+            shutil.rmtree(final, ignore_errors=True)
+            continue
+        logger.info("restored %s from %r -> %s", prefix, store, final)
+        return final
+    return None
+
+
+# ------------------------------------------------------------------ from env
+def replication_enabled() -> bool:
+    """Replication is ON iff a store URL is configured and ``ATX_REPLICATE``
+    is not explicitly 0 — default-off without a URL, default-on with one."""
+    if not os.environ.get(REPLICATE_URL_ENV):
+        return False
+    return parse_flag_from_env(REPLICATE_ENV, True)
+
+
+def store_from_env() -> ObjectStore | None:
+    if not replication_enabled():
+        return None
+    return store_for_url(os.environ[REPLICATE_URL_ENV])
+
+
+def replicator_from_env() -> Replicator | None:
+    """The Replicator configured by ``ATX_REPLICATE_URL`` (None when
+    replication is off). Called from ``Accelerator.__init__``; a bad URL or
+    unregistered scheme warns and disables rather than failing training."""
+    if not replication_enabled():
+        return None
+    try:
+        store = store_for_url(os.environ[REPLICATE_URL_ENV])
+    except Exception as e:
+        logger.warning(
+            "ATX_REPLICATE_URL=%r is unusable (%s) — checkpoint replication "
+            "disabled",
+            os.environ.get(REPLICATE_URL_ENV),
+            e,
+        )
+        return None
+    return Replicator(store)
+
+
+def drain_secs_from_env() -> float:
+    """How long a preemption exit / end_training waits for pending uploads
+    (``ATX_REPLICATE_DRAIN_SECS``, default 120s — inside the typical
+    preemption grace window, after the emergency save itself)."""
+    return _env_float("ATX_REPLICATE_DRAIN_SECS", 120.0)

@@ -15,7 +15,10 @@ Two families of faults:
   scenario, or killing ONE router replica thread without taking the
   process); ``ATX_FAULT_HANG_AT=<point>`` parks the calling thread
   forever — the wedged-collective analog the per-replica watchdog must
-  convert into a quarantine.
+  convert into a quarantine; ``ATX_FAULT_DELAY_AT=<point>`` sleeps
+  ``ATX_FAULT_DELAY_SECS`` (default 1.0) there and continues — the
+  slow-transport analog, for testing watchdog interaction, replication
+  drain deadlines, and kill-during-upload races deterministically.
 
 Any spec may carry a hit count, ``<point>@N``: the fault fires on the
 Nth time execution reaches that point (process-wide counter) and never
@@ -37,6 +40,13 @@ Instrumented points:
 ``router.replica<i>.step``      router replica ``i``'s loop, after inbox
                                 messages are applied, BEFORE the engine step
                                 (`serving/router.py` failover injection)
+``replicate.part_uploaded``     one checkpoint part landed in the object
+                                store, next part NOT yet sent
+                                (`resilience/replicate.py` — combine with
+                                ``@N`` to die after exactly N parts)
+``replicate.before_marker``     every part + manifest uploaded, remote
+                                ``COMMIT`` marker NOT yet written (the
+                                remote durability boundary)
 ==============================  =================================================
 """
 
@@ -55,6 +65,8 @@ KILL_EXIT_CODE = 137  # what a real `kill -9` reports (128 + SIGKILL)
 KILL_AT_ENV = "ATX_FAULT_KILL_AT"
 RAISE_AT_ENV = "ATX_FAULT_RAISE_AT"
 HANG_AT_ENV = "ATX_FAULT_HANG_AT"
+DELAY_AT_ENV = "ATX_FAULT_DELAY_AT"
+DELAY_SECS_ENV = "ATX_FAULT_DELAY_SECS"
 
 # Hits seen per counted spec ("point@N"); plain specs never touch this.
 _HIT_COUNTS: dict[str, int] = {}
@@ -89,6 +101,17 @@ def _should_fire(spec: str | None, name: str) -> bool:
 def crash_point(name: str) -> None:
     """The hook body `resilience.commit.fault_point` dispatches to once a
     fault env var is present."""
+    if _should_fire(os.environ.get(DELAY_AT_ENV), name):
+        try:
+            delay = float(os.environ.get(DELAY_SECS_ENV, "") or 1.0)
+        except ValueError:
+            delay = 1.0
+        sys.stderr.write(
+            f"[faults] injecting {delay:.3g}s latency at crash point {name!r}\n"
+        )
+        sys.stderr.flush()
+        time.sleep(delay)
+        # fall through: a delay composes with the other fault families
     if _should_fire(os.environ.get(RAISE_AT_ENV), name):
         raise FaultInjected(f"injected fault at crash point {name!r}")
     if _should_fire(os.environ.get(HANG_AT_ENV), name):
@@ -107,6 +130,17 @@ def raise_at(point: str) -> Iterator[None]:
     """In-process fault: `FaultInjected` is raised when execution reaches
     ``point`` inside the block."""
     with patch_environment(**{RAISE_AT_ENV: point}):
+        yield
+
+
+@contextmanager
+def delay_at(point: str, secs: float = 1.0) -> Iterator[None]:
+    """In-process latency fault: execution sleeps ``secs`` each time it
+    reaches ``point`` inside the block (``point@N`` delays only the Nth
+    hit)."""
+    with patch_environment(
+        **{DELAY_AT_ENV: point, DELAY_SECS_ENV: repr(secs)}
+    ):
         yield
 
 

@@ -1,0 +1,110 @@
+"""Replication subprocess driver (tests/test_replication.py).
+
+Deterministic tiny training run with checkpoint replication armed
+(``ATX_REPLICATE_URL`` is set by the parent test). Appends
+``<step> <loss.hex()>`` lines to ``--loss_file`` — the bit-identity oracle
+for restore-from-remote. Modes:
+
+- ``--save_at K``: synchronous save after step K, then DRAIN the
+  replication queue before continuing. With ``ATX_FAULT_KILL_AT=
+  replicate.part_uploaded@N`` in the env, the background uploader
+  ``os._exit(137)``s mid-upload during that drain — the kill -9 analog
+  that leaves a locally-committed checkpoint with a partial remote copy
+  (parts but no remote COMMIT marker).
+- ``--resume``: ``load_state(resume="latest")`` — falls back to the
+  newest REMOTE committed checkpoint when the parent deleted the local
+  checkpoints root, and backfills a partially-uploaded checkpoint
+  (skipping already-durable parts) when resuming from a local one.
+- ``--final_save``: save once more after the last step.
+
+Always ends with ``end_training()`` (drains replication) and prints a
+``[replicate_train] STATS uploaded=<n> skipped=<n> replicated=<n>
+failures=<n>`` line the parent parses to assert part-level resume.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--loss_file", required=True)
+    ap.add_argument("--save_at", type=int, default=None)
+    ap.add_argument("--final_save", action="store_true")
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    acc = atx.Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir,
+            automatic_checkpoint_naming=True,
+            total_limit=3,
+        ),
+        seed=0,
+    )
+    assert acc._replicator is not None, "ATX_REPLICATE_URL must be set"
+
+    def init_fn(rng):
+        return {
+            "w": jax.random.normal(rng, (8, 8), jnp.float32) * 0.1,
+            "b": jnp.zeros((8,), jnp.float32),
+        }
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    state = acc.create_train_state(init_fn, optax.adam(1e-2))
+    step = acc.make_train_step(loss_fn)
+
+    start = 0
+    if args.resume:
+        state = acc.load_state(None, state, resume="latest")
+        start = int(jax.device_get(state.step))
+        print(f"[replicate_train] resumed at step {start}", flush=True)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1234 + i)
+        return {
+            "x": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+            "y": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        }
+
+    with open(args.loss_file, "a") as out:
+        for i in range(start, args.steps):
+            state, metrics = step(state, make_batch(i))
+            out.write(f"{i} {float(jax.device_get(metrics['loss'])).hex()}\n")
+            out.flush()
+            if args.save_at is not None and i == args.save_at:
+                acc.save_state(None, state)
+                # Under ATX_FAULT_KILL_AT=replicate.part_uploaded@N the
+                # process dies HERE, mid-upload, deterministically.
+                acc._replicator.drain(120.0)
+    if args.final_save:
+        acc.save_state(None, state)
+    rep = acc._replicator
+    acc.end_training()
+    print(
+        f"[replicate_train] STATS uploaded={rep.parts_uploaded} "
+        f"skipped={rep.parts_skipped} replicated={rep.checkpoints_replicated} "
+        f"failures={rep.failures}",
+        flush=True,
+    )
+    print("[replicate_train] DONE", flush=True)
+
+
+main()

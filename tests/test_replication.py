@@ -1,0 +1,610 @@
+"""Checkpoint-replication tests (docs/fault_tolerance.md, "Checkpoint
+replication & remote restore").
+
+Three layers of proof:
+
+- **unit**: the `ObjectStore` contract (`LocalObjectStore` atomicity, key
+  hygiene, the scheme registry), env gating (default-off without a URL,
+  ``ATX_REPLICATE=0`` force-off, unusable URLs degrade to off), the
+  bounded+jittered retry/backoff policy, and the bandwidth throttle;
+- **fault-injected**: an upload killed after N parts resumes by SKIPPING
+  the already-durable parts; a failure before the remote ``COMMIT`` marker
+  leaves the remote checkpoint invisible to restore; a permanently failing
+  store degrades to a warning — training never crashes; the aggregated
+  ``MANIFEST.agg.json`` lets `verify_checkpoint` pass per-node layouts
+  while still catching partial deletions;
+- **subprocess**: real kill -9 mid-upload (exit 137), resume backfills the
+  partial remote copy part-by-part, then the parent deletes the ENTIRE
+  local checkpoints root and the next ``resume="latest"`` restores from
+  the remote store with a loss trajectory bit-identical to an
+  uninterrupted reference run.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
+import accelerate_tpu as atx
+from accelerate_tpu import checkpointing, resilience
+from accelerate_tpu.resilience import commit as commit_mod
+from accelerate_tpu.resilience import replicate
+from accelerate_tpu.test_utils import faults
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+from accelerate_tpu.utils.environment import patch_environment
+
+from tests.launch_helpers import REPO_ROOT, clean_env
+
+SCRIPTS = os.path.join(REPO_ROOT, "tests", "scripts")
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    yield
+    resilience.clear_preemption()
+    faults._reset_counters()
+
+
+def _auto_acc(tmp_path, store_dir, **cfg):
+    """Accelerator with replication armed at ``store_dir``."""
+    with patch_environment(ATX_REPLICATE_URL=str(store_dir)):
+        return atx.Accelerator(
+            project_config=ProjectConfiguration(
+                project_dir=str(tmp_path), automatic_checkpoint_naming=True, **cfg
+            ),
+            seed=0,
+        )
+
+
+def _w_state(acc, offset=0.0):
+    return acc.create_train_state({"w": jnp.arange(8.0) + offset}, optax.sgd(0.1))
+
+
+def _committed_dir(tmp_path, n_files=3, step=7):
+    """A minimal committed checkpoint directory (manifest + agg + marker)."""
+    d = str(tmp_path / "checkpoint_0")
+    os.makedirs(d, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        rel = f"part_{i}.bin"
+        with open(os.path.join(d, rel), "wb") as f:
+            f.write(bytes([i]) * (100 + i))
+        files.append(rel)
+    commit_mod.write_manifest(d, 0, files, step=step)
+    commit_mod.write_aggregate_manifest(d)
+    marker = os.path.join(d, commit_mod.COMMIT_MARKER)
+    import json
+
+    with open(marker, "w") as f:
+        json.dump({"version": 1, "step": step, "num_processes": 1}, f)
+    assert commit_mod.verify_checkpoint(d) == []
+    return d
+
+
+# ================================================================ ObjectStore
+class TestLocalObjectStore:
+    def test_put_get_stat_list_delete(self, tmp_path):
+        s = replicate.LocalObjectStore(str(tmp_path / "store"))
+        s.put_bytes(b"hello", "a/b/c.txt")
+        assert s.get_bytes("a/b/c.txt") == b"hello"
+        st = s.stat("a/b/c.txt")
+        assert st.size == 5 and len(st.sha256) == 64
+        assert s.stat("nope") is None and not s.exists("nope")
+        s.put_bytes(b"x", "a/d.txt")
+        assert s.list("a/b/") == ["a/b/c.txt"]
+        assert s.list() == ["a/b/c.txt", "a/d.txt"]
+        assert s.delete_prefix("a/") == 2
+        assert s.list() == []
+        s.delete("gone")  # idempotent
+
+    def test_put_file_round_trip(self, tmp_path):
+        s = replicate.LocalObjectStore(str(tmp_path / "store"))
+        src = tmp_path / "src.bin"
+        src.write_bytes(b"payload" * 50)
+        s.put_file(str(src), "k.bin")
+        dst = tmp_path / "dst.bin"
+        s.get_file("k.bin", str(dst))
+        assert dst.read_bytes() == src.read_bytes()
+
+    def test_key_escape_rejected(self, tmp_path):
+        s = replicate.LocalObjectStore(str(tmp_path / "store"))
+        with pytest.raises(replicate.ObjectStoreError, match="escapes"):
+            s.put_bytes(b"x", "../../etc/passwd")
+
+    def test_missing_object_raises(self, tmp_path):
+        s = replicate.LocalObjectStore(str(tmp_path / "store"))
+        with pytest.raises(replicate.ObjectStoreError):
+            s.get_bytes("missing")
+
+
+class TestSchemeRegistry:
+    def test_bare_path_and_file_url(self, tmp_path):
+        bare = replicate.store_for_url(str(tmp_path / "s1"))
+        assert isinstance(bare, replicate.LocalObjectStore)
+        url = replicate.store_for_url(f"file://{tmp_path}/s2")
+        assert url.root == str(tmp_path / "s2")
+
+    def test_gs_placeholder_raises_with_hint(self):
+        with pytest.raises(replicate.ObjectStoreError, match="register_store_scheme"):
+            replicate.store_for_url("gs://bucket/prefix")
+
+    def test_unknown_scheme_lists_known(self):
+        with pytest.raises(replicate.ObjectStoreError, match="no ObjectStore registered"):
+            replicate.store_for_url("s3://bucket/x")
+
+    def test_custom_scheme_registration(self, tmp_path):
+        try:
+            replicate.register_store_scheme(
+                "memtest", lambda url: replicate.LocalObjectStore(str(tmp_path / "m"))
+            )
+            s = replicate.store_for_url("memtest://anything")
+            s.put_bytes(b"v", "k")
+            assert s.get_bytes("k") == b"v"
+        finally:
+            replicate._SCHEME_REGISTRY.pop("memtest", None)
+
+
+class TestEnvGating:
+    def test_default_off(self):
+        assert replicate.replicator_from_env() is None
+        assert replicate.store_from_env() is None
+
+    def test_url_arms(self, tmp_path):
+        with patch_environment(ATX_REPLICATE_URL=str(tmp_path)):
+            rep = replicate.replicator_from_env()
+            assert rep is not None and isinstance(rep.store, replicate.LocalObjectStore)
+
+    def test_force_off(self, tmp_path):
+        with patch_environment(ATX_REPLICATE_URL=str(tmp_path), ATX_REPLICATE="0"):
+            assert replicate.replicator_from_env() is None
+
+    def test_unusable_url_degrades_to_off(self):
+        with patch_environment(ATX_REPLICATE_URL="bogus://nope"):
+            assert replicate.replicator_from_env() is None  # warns, no raise
+
+    def test_accelerator_without_url_has_no_replicator(self, tmp_path):
+        acc = atx.Accelerator(
+            project_config=ProjectConfiguration(
+                project_dir=str(tmp_path), automatic_checkpoint_naming=True
+            ),
+            seed=0,
+        )
+        assert acc._replicator is None
+
+
+# ============================================================ retry / backoff
+class TestBackoff:
+    def _failing_retries(self, retries):
+        store = replicate.LocalObjectStore("/tmp/unused_backoff_store")
+        rep = replicate.Replicator(store, retries=retries, timeout_secs=600)
+        sleeps = []
+        rep._sleep = sleeps.append
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise OSError("transient")
+
+        with pytest.raises(OSError):
+            rep._with_retries("k", fn, deadline=time.monotonic() + 600)
+        return calls, sleeps
+
+    def test_bounded_attempts(self):
+        calls, sleeps = self._failing_retries(retries=3)
+        assert len(calls) == 4  # first try + 3 retries
+        assert len(sleeps) == 3
+
+    def test_exponential_and_jittered(self):
+        _, sleeps = self._failing_retries(retries=4)
+        # base delays 0.5, 1, 2, 4 with up to +100% jitter, capped at 30
+        for base, s in zip([0.5, 1.0, 2.0, 4.0], sleeps):
+            assert base <= s < base * 2, sleeps
+        _, sleeps2 = self._failing_retries(retries=4)
+        assert sleeps != sleeps2  # full jitter: two runs virtually never equal
+
+    def test_deadline_cuts_retries_short(self):
+        store = replicate.LocalObjectStore("/tmp/unused_backoff_store")
+        rep = replicate.Replicator(store, retries=100, timeout_secs=600)
+        rep._sleep = lambda s: None
+        with pytest.raises(OSError):
+            rep._with_retries(
+                "k",
+                lambda: (_ for _ in ()).throw(OSError("x")),
+                deadline=time.monotonic() - 1,  # already expired
+            )
+
+    def test_throttle_paces_uploads(self, tmp_path):
+        store = replicate.LocalObjectStore(str(tmp_path))
+        rep = replicate.Replicator(store, bandwidth_mib_s=8.0)
+        t0 = time.monotonic()
+        rep._throttle(1 << 20)  # first send spends the budget...
+        rep._throttle(1 << 20)  # ...second must wait ~1/8 s
+        assert time.monotonic() - t0 >= 0.1
+
+
+# ===================================================== upload fault injection
+class TestUploadFaults:
+    def test_partial_upload_then_backfill_skips_parts(self, tmp_path):
+        d = _committed_dir(tmp_path, n_files=4)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0, timeout_secs=60)
+        faults._reset_counters()
+        with faults.raise_at("replicate.part_uploaded@2"):
+            rep.enqueue(d)
+            assert rep.drain(60)
+        assert rep.failures == 1 and "FaultInjected" in rep.last_error
+        assert rep.parts_uploaded == 2
+        # no remote COMMIT -> the partial copy is invisible to restore
+        assert replicate.remote_committed_checkpoints(store) == []
+        faults._reset_counters()
+        rep.enqueue(d)
+        assert rep.drain(60)
+        assert rep.failures == 1  # no new failure
+        assert rep.parts_skipped >= 2  # resumed upload skipped durable parts
+        assert replicate.remote_committed_checkpoints(store) == [(0, "checkpoint_0")]
+
+    def test_failure_before_marker_leaves_remote_uncommitted(self, tmp_path):
+        d = _committed_dir(tmp_path)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0, timeout_secs=60)
+        with faults.raise_at("replicate.before_marker"):
+            rep.enqueue(d)
+            assert rep.drain(60)
+        assert rep.failures == 1
+        # every part + manifest landed, but without the marker the remote
+        # checkpoint does not exist as far as restore is concerned
+        assert store.exists("checkpoint_0/part_0.bin")
+        assert not store.exists(f"checkpoint_0/{commit_mod.COMMIT_MARKER}")
+        assert replicate.remote_committed_checkpoints(store) == []
+        assert replicate.restore_latest(store, str(tmp_path / "fresh")) is None
+
+    def test_permanently_failing_store_degrades_gracefully(self, tmp_path):
+        d = _committed_dir(tmp_path)
+
+        class DeadStore(replicate.ObjectStore):
+            def stat(self, key):
+                raise OSError("store unreachable")
+
+            def put_file(self, local_path, key):
+                raise OSError("store unreachable")
+
+        rep = replicate.Replicator(DeadStore(), retries=1, timeout_secs=60)
+        rep._sleep = lambda s: None
+        rep.enqueue(d)
+        assert rep.drain(60)  # drains by FAILING, never wedges the caller
+        assert rep.failures == 1 and "unreachable" in rep.last_error
+        assert rep.checkpoints_replicated == 0
+
+    def test_uncommitted_dir_refused(self, tmp_path):
+        d = str(tmp_path / "checkpoint_0")
+        os.makedirs(d)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0)
+        rep.enqueue(d)
+        assert rep.drain(60)
+        assert rep.failures == 1 and "not a committed checkpoint" in rep.last_error
+
+    def test_enqueue_after_stop_is_noop(self, tmp_path):
+        d = _committed_dir(tmp_path)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0)
+        assert rep.stop()
+        rep.enqueue(d)
+        assert rep.drain(1)
+        assert rep.checkpoints_replicated == 0
+
+    def test_delay_fault_injects_latency(self):
+        t0 = time.monotonic()
+        with faults.delay_at("replication.test.point", 0.25):
+            commit_mod.fault_point("replication.test.point")
+        assert time.monotonic() - t0 >= 0.25
+
+    def test_delay_fault_nth_hit_composable(self):
+        faults._reset_counters()
+        with faults.delay_at("replication.test.nth@2", 0.25):
+            t0 = time.monotonic()
+            commit_mod.fault_point("replication.test.nth")  # hit 1: no delay
+            first = time.monotonic() - t0
+            t1 = time.monotonic()
+            commit_mod.fault_point("replication.test.nth")  # hit 2: delayed
+            second = time.monotonic() - t1
+        assert first < 0.2 and second >= 0.25
+
+
+# ==================================================== aggregate manifest / agg
+class TestAggregateManifest:
+    def _two_proc_dir(self, tmp_path):
+        d = str(tmp_path / "checkpoint_0")
+        os.makedirs(d)
+        proc_files = {}
+        for proc in (0, 1):
+            files = []
+            for i in range(2):
+                rel = f"shard_{proc}_{i}.bin"
+                with open(os.path.join(d, rel), "wb") as f:
+                    f.write(bytes([proc * 16 + i]) * 64)
+                files.append(rel)
+            commit_mod.write_manifest(d, proc, files, step=3)
+            proc_files[proc] = files
+        commit_mod.write_aggregate_manifest(d)
+        import json
+
+        with open(os.path.join(d, commit_mod.COMMIT_MARKER), "w") as f:
+            json.dump({"version": 1, "step": 3, "num_processes": 2}, f)
+        return d, proc_files
+
+    def test_agg_written_and_clean(self, tmp_path):
+        d, _ = self._two_proc_dir(tmp_path)
+        assert os.path.exists(os.path.join(d, commit_mod.AGG_MANIFEST))
+        assert commit_mod.verify_checkpoint(d) == []
+
+    def test_per_node_layout_passes_with_agg(self, tmp_path):
+        # Per-node filesystem: peer's manifest AND all its files absent —
+        # the aggregate keeps completeness AND per-file verification sound.
+        d, proc_files = self._two_proc_dir(tmp_path)
+        os.remove(os.path.join(d, "manifest_1.json"))
+        for rel in proc_files[1]:
+            os.remove(os.path.join(d, rel))
+        assert commit_mod.verify_checkpoint(d) == []
+
+    def test_partial_peer_files_fail_with_agg(self, tmp_path):
+        # SOME of the peer's files present = corruption, not per-node layout.
+        d, proc_files = self._two_proc_dir(tmp_path)
+        os.remove(os.path.join(d, "manifest_1.json"))
+        os.remove(os.path.join(d, proc_files[1][0]))
+        errors = commit_mod.verify_checkpoint(d)
+        assert any("missing file" in e for e in errors), errors
+
+    def test_agg_present_peer_corruption_still_caught(self, tmp_path):
+        d, proc_files = self._two_proc_dir(tmp_path)
+        os.remove(os.path.join(d, "manifest_1.json"))
+        faults.flip_bit(os.path.join(d, proc_files[1][0]))
+        errors = commit_mod.verify_checkpoint(d)
+        assert any("sha256 mismatch" in e for e in errors), errors
+
+    def test_legacy_dir_without_agg_unchanged(self, tmp_path):
+        # No aggregate: losing a peer's manifest still fails completeness
+        # exactly as before (the PR-4 behavior).
+        d, proc_files = self._two_proc_dir(tmp_path)
+        os.remove(os.path.join(d, commit_mod.AGG_MANIFEST))
+        os.remove(os.path.join(d, "manifest_1.json"))
+        for rel in proc_files[1]:
+            os.remove(os.path.join(d, rel))
+        errors = commit_mod.verify_checkpoint(d)
+        assert any("manifest count mismatch" in e for e in errors), errors
+
+    def test_corrupt_agg_is_an_error(self, tmp_path):
+        d, _ = self._two_proc_dir(tmp_path)
+        with open(os.path.join(d, commit_mod.AGG_MANIFEST), "w") as f:
+            f.write("{not json")
+        errors = commit_mod.verify_checkpoint(d)
+        assert any(commit_mod.AGG_MANIFEST in e for e in errors), errors
+
+
+# ======================================================== accelerator round-trip
+class TestReplicatedCheckpointing:
+    def test_save_replicates_and_rotates_remotely(self, tmp_path):
+        store_dir = tmp_path / "remote"
+        acc = _auto_acc(tmp_path / "proj", store_dir, total_limit=2)
+        assert acc._replicator is not None
+        state = _w_state(acc)
+        for _ in range(3):
+            acc.save_state(None, state)
+        assert acc._replicator.drain(120)
+        assert acc._replicator.failures == 0, acc._replicator.last_error
+        store = replicate.LocalObjectStore(str(store_dir))
+        remote = replicate.remote_committed_checkpoints(store)
+        # total_limit=2 is mirrored remotely: checkpoint_0 rotated away
+        assert [n for n, _ in remote] == [1, 2]
+        assert store.exists(f"checkpoint_2/{commit_mod.AGG_MANIFEST}")
+
+    def test_restore_latest_round_trip_and_remote_fallback(self, tmp_path):
+        store_dir = tmp_path / "remote"
+        acc = _auto_acc(tmp_path / "proj", store_dir)
+        state = _w_state(acc, offset=5.0)
+        acc.save_state(None, state)
+        assert acc._replicator.drain(120)
+        root = checkpointing.checkpoint_root(acc)
+        shutil.rmtree(root)  # the preempted-VM case: local disk gone
+        loaded = acc.load_state(None, _w_state(acc, offset=0.0), resume="latest")
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(loaded.params["w"])), np.arange(8.0) + 5.0
+        )
+        # the restored dir is a real committed checkpoint again
+        latest = commit_mod.latest_committed(root)
+        assert latest is not None and commit_mod.verify_checkpoint(latest) == []
+
+    def test_restore_skips_corrupt_remote_and_falls_back(self, tmp_path):
+        store_dir = tmp_path / "remote"
+        acc = _auto_acc(tmp_path / "proj", store_dir)
+        acc.save_state(None, _w_state(acc, offset=1.0))
+        acc.save_state(None, _w_state(acc, offset=2.0))
+        assert acc._replicator.drain(120)
+        store = replicate.LocalObjectStore(str(store_dir))
+        # silently corrupt the NEWEST remote copy's shard bytes
+        shard_keys = [
+            k for k in store.list("checkpoint_1/") if k.endswith(".npz")
+        ]
+        assert shard_keys
+        faults.flip_bit(store._path(shard_keys[0]))
+        restored = replicate.restore_latest(store, str(tmp_path / "fresh"))
+        assert restored is not None and restored.endswith("checkpoint_0")
+        assert commit_mod.verify_checkpoint(restored) == []
+
+    def test_restore_replaces_corrupt_local_copy(self, tmp_path):
+        store_dir = tmp_path / "remote"
+        acc = _auto_acc(tmp_path / "proj", store_dir)
+        acc.save_state(None, _w_state(acc, offset=3.0))
+        assert acc._replicator.drain(120)
+        root = checkpointing.checkpoint_root(acc)
+        local = commit_mod.latest_committed(root)
+        shard = next(
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(local)
+            for f in fs
+            if f.endswith(".npz")
+        )
+        faults.flip_bit(shard)
+        assert commit_mod.verify_checkpoint(local) != []
+        store = replicate.LocalObjectStore(str(store_dir))
+        restored = replicate.restore_latest(store, root)
+        assert restored == local
+        assert commit_mod.verify_checkpoint(restored) == []
+
+    def test_non_automatic_naming_not_replicated(self, tmp_path):
+        store_dir = tmp_path / "remote"
+        with patch_environment(ATX_REPLICATE_URL=str(store_dir)):
+            acc = atx.Accelerator(
+                project_config=ProjectConfiguration(project_dir=str(tmp_path / "p")),
+                seed=0,
+            )
+        state = _w_state(acc)
+        acc.save_state(str(tmp_path / "explicit_ckpt"), state)
+        assert acc._replicator.drain(30)
+        assert acc._replicator.checkpoints_replicated == 0
+        store = replicate.LocalObjectStore(str(store_dir))
+        assert store.list() == []
+
+
+# ================================================================== launch CLI
+def test_launch_replicate_url_flag_sets_env():
+    import argparse
+
+    from accelerate_tpu.commands import launch as launch_cmd
+
+    p = argparse.ArgumentParser()
+    launch_cmd.register(p.add_subparsers())
+    args = p.parse_args(
+        ["launch", "--replicate_url", "file:///durable/ckpts", "train.py"]
+    )
+    cfg = launch_cmd._merge_config(args)
+    env = launch_cmd.build_child_env(cfg, None)
+    assert env["ATX_REPLICATE_URL"] == "file:///durable/ckpts"
+
+
+# ============================================================ subprocess proof
+def _child_env(store_dir, extra=None):
+    env = clean_env({"JAX_PLATFORMS": "cpu"})
+    env["ATX_REPLICATE_URL"] = str(store_dir)
+    env.update(extra or {})
+    return env
+
+
+def _run_driver(*argv, env, timeout=240):
+    return subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "replicate_train.py"), *argv],
+        cwd=REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def _losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            step, loss = line.split()
+            out[int(step)] = loss
+    return out
+
+
+def _stats(stdout):
+    m = re.search(
+        r"STATS uploaded=(\d+) skipped=(\d+) replicated=(\d+) failures=(\d+)",
+        stdout,
+    )
+    assert m, stdout
+    return {
+        "uploaded": int(m.group(1)),
+        "skipped": int(m.group(2)),
+        "replicated": int(m.group(3)),
+        "failures": int(m.group(4)),
+    }
+
+
+def test_kill9_mid_upload_backfill_and_remote_restore_bitidentical(tmp_path):
+    """The acceptance scenario end to end, against a REFERENCE run:
+
+    B) kill -9 (exit 137) fires on the replication thread after exactly 2
+       uploaded parts — local commit intact, remote copy partial;
+    C) resume: the partially-uploaded checkpoint is backfilled SKIPPING the
+       already-durable parts, training continues, a newer checkpoint
+       replicates fully;
+    D) the parent deletes the ENTIRE local checkpoints root; resume falls
+       back to the remote store, re-verifies, and the remaining loss
+       trajectory is bit-identical to the uninterrupted reference run A.
+    """
+    store = str(tmp_path / "remote")
+    ref_losses = str(tmp_path / "ref_losses.txt")
+    losses = str(tmp_path / "losses.txt")
+
+    # A: uninterrupted reference
+    proj_a = str(tmp_path / "proj_ref")
+    r = _run_driver(
+        "--project_dir", proj_a, "--steps", "10", "--save_at", "4",
+        "--final_save", "--loss_file", ref_losses,
+        env=_child_env(tmp_path / "remote_ref"),
+    )
+    assert r.returncode == 0, r.stderr
+    ref = _losses(ref_losses)
+    assert sorted(ref) == list(range(10))
+
+    # B: killed mid-upload after exactly 2 parts
+    proj = str(tmp_path / "proj")
+    r = _run_driver(
+        "--project_dir", proj, "--steps", "10", "--save_at", "4",
+        "--loss_file", losses,
+        env=_child_env(
+            store, {"ATX_FAULT_KILL_AT": "replicate.part_uploaded@2"}
+        ),
+    )
+    assert r.returncode == faults.KILL_EXIT_CODE, (r.returncode, r.stderr)
+    s = replicate.LocalObjectStore(store)
+    assert replicate.remote_committed_checkpoints(s) == []  # no remote COMMIT
+    assert len(s.list("checkpoint_0/")) == 2  # exactly the 2 parts
+    root = os.path.join(proj, "checkpoints")
+    local = commit_mod.latest_committed(root)
+    assert local is not None  # the LOCAL commit preceded the upload
+
+    # C: resume backfills the partial upload, skipping durable parts
+    r = _run_driver(
+        "--project_dir", proj, "--steps", "8", "--final_save",
+        "--resume", "--loss_file", losses,
+        env=_child_env(store),
+    )
+    assert r.returncode == 0, r.stderr
+    assert "resumed at step 5" in r.stdout, r.stdout
+    stats = _stats(r.stdout)
+    assert stats["failures"] == 0
+    assert stats["skipped"] >= 2, stats  # the 2 killed-run parts re-used
+    assert stats["replicated"] == 2  # backfilled checkpoint_0 + new checkpoint_1
+    remote = replicate.remote_committed_checkpoints(s)
+    assert [n for n, _ in remote] == [0, 1]
+
+    # D: local root deleted entirely -> restore from remote, bit-identical
+    shutil.rmtree(root)
+    r = _run_driver(
+        "--project_dir", proj, "--steps", "10",
+        "--resume", "--loss_file", losses,
+        env=_child_env(store),
+    )
+    assert r.returncode == 0, r.stderr
+    assert "resumed at step 8" in r.stdout, r.stdout
+    latest = commit_mod.latest_committed(root)
+    assert latest is not None and commit_mod.verify_checkpoint(latest) == []
+    got = _losses(losses)
+    assert sorted(got) == list(range(10))
+    for step_i in range(10):
+        assert got[step_i] == ref[step_i], f"loss diverged at step {step_i}"
