@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -82,8 +82,24 @@ smoke-replication:
 		python -m accelerate_tpu.commands.cli lint replicated_save --multihost 2 \
 		--severity error
 
+# CPU elastic-resume lane (docs/fault_tolerance.md, "Elastic resume &
+# resharding restore"): reshard-on-restore round trips (save under an
+# 8-device FSDP mesh, restore bit-identical under 4 and 2 — optimizer
+# moments included), peer-shard fetch from the object store with manifest
+# verification (corrupt bytes rejected, kill -9 mid-fetch leaves the
+# checkpoint untouched), the peer-health watchdog, the ATX_NAN_GUARD
+# skip/abort budget, and the 8-dev -> SIGTERM -> 4-dev resume subprocess
+# acceptance; then the elastic_restore host-loop replay under 2 simulated
+# processes proving the restore path adds NO collectives (error findings
+# fail).
+smoke-elastic:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint elastic_restore --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic
 	python -m pytest tests/ -q --heavy

@@ -9,7 +9,12 @@ checkpointing, trackers) mirrors the reference's feature set.
 
 __version__ = "0.1.0"
 
-from .accelerator import Accelerator, DynamicLossScale, TrainState
+from .accelerator import (
+    Accelerator,
+    DynamicLossScale,
+    NonFiniteGuardError,
+    TrainState,
+)
 from . import analysis
 from .analysis import AnalysisWarning, LintError, lint_step, lint_training
 from .big_modeling import (

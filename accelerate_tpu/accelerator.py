@@ -90,6 +90,16 @@ def _warn_fp8_noop() -> None:
     )
 
 
+class NonFiniteGuardError(RuntimeError):
+    """``ATX_NAN_GUARD`` ran out of patience: the training step produced a
+    non-finite loss or gradients for ``ATX_NAN_GUARD_MAX_CONSECUTIVE``
+    consecutive steps. Each bad step's optimizer update was *skipped* inside
+    the compiled step (params/opt-state untouched), so the state this error
+    leaves behind is the last finite one — checkpoint it, lower the LR /
+    inspect the data, and resume. A budget-exceeded streak almost always
+    means divergence, not a transient batch."""
+
+
 _UNPINNED_WARNED: set[str] = set()
 
 
@@ -261,6 +271,26 @@ class Accelerator:
         # via ATX_REPLICATE_URL — a background thread mirrors each committed
         # checkpoint into the object store; None when replication is off.
         self._replicator = _resilience.replicator_from_env()
+        # Peer-health watchdog (resilience/health.py): opt-in via
+        # ATX_HEALTH_BEAT_SECS — collective-free heartbeats through the
+        # checkpoint root (or the replicate store) flag a dead peer in
+        # seconds and route the survivors onto the emergency-save +
+        # exit-75 elastic path. None when disabled.
+        self._health = None
+        try:
+            from . import checkpointing as _ckpt
+
+            _health_root = _ckpt.checkpoint_root(self)
+        except Exception:
+            _health_root = None
+        self._health = _resilience.health_from_env(
+            root=_health_root,
+            store=self._replicator.store if self._replicator is not None else None,
+            process_index=self.process_index,
+            num_processes=self.num_processes,
+        )
+        if self._health is not None:
+            self._health.start()
         self._preemption_exit_started = False
         self._preemption_sync_calls = 0
         self._flag_tensor: jax.Array | None = None
@@ -733,6 +763,25 @@ class Accelerator:
                 "supported (the overflow-skip select would have to span "
                 "memory spaces); use bf16 mixed precision."
             )
+        # Non-finite training guard (opt-in, ATX_NAN_GUARD): the compiled
+        # step skips the optimizer update via a pure lax.cond whenever the
+        # loss or any gradient is non-finite — no host sync on the happy
+        # path. The host side counts consecutive skips off the returned
+        # metrics (drained only when .is_ready(), so dispatch stays async)
+        # and aborts with NonFiniteGuardError after
+        # ATX_NAN_GUARD_MAX_CONSECUTIVE (default 3) bad steps in a row.
+        from .utils.environment import get_int_from_env, parse_flag_from_env
+
+        nan_guard = parse_flag_from_env("ATX_NAN_GUARD", False)
+        nan_guard_budget = max(
+            1, get_int_from_env(("ATX_NAN_GUARD_MAX_CONSECUTIVE",), 3)
+        )
+        if nan_guard and opt_host_shardings is not None:
+            raise ValueError(
+                "ATX_NAN_GUARD with offload_optimizer is not supported (the "
+                "skip-update cond would have to span memory spaces, like the "
+                "fp16 overflow select); disable one of the two."
+            )
 
         def _pin(tree: Any, spec_tree: Any) -> Any:
             """Constrain `tree` to its planned shardings; skipped when no
@@ -849,6 +898,15 @@ class Accelerator:
                 if policy.output_dtype is None
                 else loss.astype(policy.output_dtype)
             }
+            guard_finite = None
+            if nan_guard and not use_scaler:
+                # Raw loss + grads, BEFORE clipping: a clip can turn inf into
+                # a large finite number and mask the divergence signal.
+                guard_finite = jnp.isfinite(loss) & jnp.all(
+                    jnp.stack(
+                        [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+                    )
+                )
             if use_scaler:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 finite = jnp.all(
@@ -856,6 +914,11 @@ class Accelerator:
                         [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
                     )
                 )
+                if nan_guard:
+                    # The scaler's select already skips on non-finite grads;
+                    # the guard adds the loss itself (a NaN loss with finite
+                    # grads is still divergence) and the abort budget.
+                    guard_finite = finite & jnp.isfinite(loss)
                 # Zero non-finite grads so the (discarded) optimizer update
                 # below computes on clean numbers either way.
                 grads = jax.tree.map(
@@ -895,11 +958,33 @@ class Accelerator:
                     self.mesh,
                     grad_scale=grad_scale,
                 )
+                new_params = optax.apply_updates(state.params, updates)
+            elif nan_guard:
+                # Guarded update: a pure lax.cond keeps the whole optimizer
+                # update off the trace when the step is bad — params and
+                # opt-state pass through IDENTICALLY (no 0-update applied,
+                # so stateful transforms like Adam moments don't advance on
+                # garbage). The predicate is a device scalar; no host sync.
+                def _apply_update(operand):
+                    g, p, o = operand
+                    upd, new_o = state.tx.update(g, o, p)
+                    return optax.apply_updates(p, upd), new_o
+
+                def _skip_update(operand):
+                    _, p, o = operand
+                    return p, o
+
+                new_params, new_opt_state = jax.lax.cond(
+                    guard_finite,
+                    _apply_update,
+                    _skip_update,
+                    (grads, state.params, state.opt_state),
+                )
             else:
                 updates, new_opt_state = state.tx.update(
                     grads, state.opt_state, state.params
                 )
-            new_params = optax.apply_updates(state.params, updates)
+                new_params = optax.apply_updates(state.params, updates)
             new_loss_scale = state.loss_scale
             if use_scaler:
                 # Overflow: keep params/opt untouched, back the scale off.
@@ -924,6 +1009,8 @@ class Accelerator:
                 )
                 metrics["loss_scale"] = new_scale
                 metrics["grads_finite"] = finite
+            if nan_guard:
+                metrics["nonfinite_skipped"] = (~guard_finite).astype(jnp.int32)
             # Pin the updated params/opt-state to their PLANNED shardings.
             # Without this, jit is free to return them in whatever layout the
             # partitioner found cheapest for this program (e.g. ZERO1's
@@ -968,6 +1055,13 @@ class Accelerator:
                     "disk offload_optimizer with fp16 dynamic loss scaling "
                     "is not supported (the overflow-skip select would span "
                     "the host update); use bf16 mixed precision."
+                )
+            if nan_guard:
+                raise ValueError(
+                    "ATX_NAN_GUARD is not supported with disk-offloaded "
+                    "optimizers (the update streams through the host outside "
+                    "the compiled step, so there is no in-jit skip point); "
+                    "disable one of the two."
                 )
             if not all(
                 l.is_fully_addressable for l in jax.tree.leaves(state.params)
@@ -1061,10 +1155,51 @@ class Accelerator:
                 metrics.update(extra_metrics_fn(new_state, aux))
             return new_state, metrics
 
+        # NaN-guard host state: `pending` holds the nonfinite_skipped metric
+        # of in-flight steps (device scalars, appended in dispatch order);
+        # entries are folded into the consecutive-skip streak only once
+        # .is_ready(), so the guard never blocks the async dispatch pipeline.
+        _guard = {"pending": [], "streak": 0, "skipped_total": 0}
+
+        def _drain_guard(block: bool = False) -> None:
+            pending = _guard["pending"]
+            while pending and (block or pending[0].is_ready()):
+                skipped = int(jax.device_get(pending.pop(0)))
+                _guard["skipped_total"] += skipped
+                _guard["streak"] = _guard["streak"] + 1 if skipped else 0
+                if _guard["streak"] >= nan_guard_budget:
+                    raise NonFiniteGuardError(
+                        f"ATX_NAN_GUARD: {_guard['streak']} consecutive "
+                        "training steps produced a non-finite loss or "
+                        "gradients (budget ATX_NAN_GUARD_MAX_CONSECUTIVE="
+                        f"{nan_guard_budget}; {_guard['skipped_total']} "
+                        "skipped in total this run). Every bad step's "
+                        "optimizer update was skipped, so the current state "
+                        "is the last finite one — checkpoint it, then lower "
+                        "the learning rate / inspect the input pipeline "
+                        "before resuming."
+                    )
+
+        # Health-beat step hint: a host-side counter (seeded once from the
+        # state, then incremented) so note_step never forces a device sync.
+        _host_step = {"n": None}
+
         def run_step(state: TrainState, batch: Any):
             from . import resilience
             from .parallel.disk_offload import DiskOffloadedAdamW
 
+            if nan_guard:
+                _drain_guard()
+                # Bound the undrained window so detection can't lag forever
+                # behind a deep dispatch queue.
+                if len(_guard["pending"]) > max(8, 2 * nan_guard_budget):
+                    _drain_guard(block=True)
+            if self._health is not None:
+                if _host_step["n"] is None:
+                    _host_step["n"] = int(jax.device_get(state.step))
+                else:
+                    _host_step["n"] += 1
+                self._health.note_step(_host_step["n"])
             # Preemption boundary check at ENTRY, before any compute: the
             # input state is exactly the last completed step's output (whose
             # metrics the caller already has), so the emergency checkpoint
@@ -1091,7 +1226,10 @@ class Accelerator:
             # activation constraints (parallel.mesh.constrain_batch) bind
             # to this Accelerator's axes.
             with use_mesh(self.mesh):
-                return jitted(state, batch)
+                new_state, metrics = jitted(state, batch)
+            if nan_guard:
+                _guard["pending"].append(metrics["nonfinite_skipped"])
+            return new_state, metrics
 
         def lower(*args: Any, **kwargs: Any):
             with use_mesh(self.mesh):
@@ -1100,6 +1238,12 @@ class Accelerator:
         # Keep the jit surface the HLO-verification tooling relies on.
         run_step.lower = lower
         run_step._cache_size = jitted._cache_size
+        # NaN-guard introspection: counters for tests/metrics, and a blocking
+        # drain so a loop's last steps are judged before it declares success.
+        run_step._nan_guard = _guard if nan_guard else None
+        run_step.drain_nan_guard = (
+            (lambda: _drain_guard(block=True)) if nan_guard else (lambda: None)
+        )
         self._train_steps[id(run_step)] = jitted
         return run_step
 
@@ -1232,7 +1376,10 @@ class Accelerator:
         wd = resilience.watchdog_from_env()
         if wd is not None:
             wd.stop()
+        if self._health is not None:
+            self._health.stop()
         checkpointing.wait_for_checkpoint()
+        self._ship_collective_log()
         if self._replicator is not None:
             # The final checkpoint just landed in the queue (async saves
             # joined above): give its upload the drain window, then stop.
@@ -1368,6 +1515,9 @@ class Accelerator:
                     "remotely (already-uploaded parts will be skipped on "
                     "the next attempt)\n"
                 )
+        # Post-mortem shipping: the collective log (when armed) rides out on
+        # the same store before the VM disappears. Best-effort by design.
+        self._ship_collective_log()
         _sys.stderr.write(
             f"[accelerate_tpu] emergency checkpoint committed at {path}; "
             f"exiting with code {resilience.PREEMPTION_EXIT_CODE} (elastic "
@@ -1375,6 +1525,32 @@ class Accelerator:
         )
         _sys.stderr.flush()
         raise SystemExit(resilience.PREEMPTION_EXIT_CODE)
+
+    def _ship_collective_log(self) -> None:
+        """Ship this process's collective log off-host (best effort).
+
+        Fires only when ``ATX_COLLECTIVE_LOG=1`` recorded a log AND a
+        replicate store is armed — the log is a post-mortem aid, so failures
+        here must never mask the exit path that called us."""
+        try:
+            from .analysis import collective_log as _cl
+
+            if not _cl.enabled():
+                return
+            store = self._replicator.store if self._replicator is not None else None
+            if store is None:
+                from .resilience import replicate as _replicate
+
+                store = _replicate.store_from_env()
+            if store is None:
+                return
+            _cl.ship_log(store, process_index=self.process_index)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "collective-log shipping failed (post-mortem aid only): %s", e
+            )
 
     # ------------------------------------------------------------ checkpoint
     def register_for_checkpointing(self, *objects: Any) -> None:

@@ -115,6 +115,42 @@ def read_logs(directory: str) -> dict[int, list[dict[str, Any]]]:
     return logs
 
 
+STORE_PREFIX = "collective_logs/"
+
+
+def ship_log(
+    store, *, process_index: int | None = None, prefix: str = STORE_PREFIX
+) -> str | None:
+    """Upload this process's collective log to a replicate `ObjectStore`
+    (key ``collective_logs/collective_log_<proc>.jsonl``), so the runtime
+    schedule survives the VM on exit/preemption. Returns the key, or None
+    when there is no log to ship. Raises on store errors — the caller
+    (`Accelerator._ship_collective_log`) owns the best-effort swallow."""
+    proc = _process_index() if process_index is None else process_index
+    path = log_path(proc)
+    if not os.path.exists(path):
+        return None
+    key = prefix + LOG_FILE.format(proc=proc)
+    store.put_file(path, key)
+    return key
+
+
+def fetch_logs(store, directory: str, *, prefix: str = STORE_PREFIX) -> list[str]:
+    """Download every shipped collective log under ``prefix`` into
+    ``directory`` (named so `read_logs`/`verify_agreement` work on it
+    directly). Returns the local paths fetched."""
+    os.makedirs(directory, exist_ok=True)
+    fetched: list[str] = []
+    for key in store.list(prefix):
+        fname = os.path.basename(key)
+        if not (fname.startswith("collective_log_") and fname.endswith(".jsonl")):
+            continue
+        local = os.path.join(directory, fname)
+        store.get_file(key, local)
+        fetched.append(local)
+    return fetched
+
+
 def verify_agreement(directory: str) -> list[str]:
     """Align the recorded per-process logs; return human-readable mismatch
     descriptions (empty = every process issued the same collective schedule).

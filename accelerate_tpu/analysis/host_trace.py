@@ -596,6 +596,24 @@ def _patched_world(rec: _Recorder) -> Iterator[None]:
         patch(_pkg, name, value)
     patch(ProcessState, "wait_for_everyone", _stub_wait_for_everyone(rec))
     patch(jax, "jit", _patched_jit(jax.jit))
+    # Async checkpoint saves: the real _AsyncSaver runs the shard write +
+    # precommit barrier on a worker thread, which the sequential replay
+    # cannot interleave. The stub records the submission as an annotation
+    # and runs the job INLINE, so the job's own mark_precommit /
+    # wait_for_precommit calls land in this process's collective log in
+    # submission order — exactly the schedule the async file-barrier
+    # produces (every process submits at the same step).
+    from .. import checkpointing as _ckpt
+
+    class _SyncSaverStub:
+        def submit(self, fn: Callable, *args: Any) -> None:
+            rec.record("async_submit", "async_save", collective=False)
+            fn(*args)
+
+        def wait(self) -> None:
+            pass
+
+    patch(_ckpt, "_ASYNC_SAVER", _SyncSaverStub())
 
     prev_recorder = _ACTIVE_RECORDER
     _ACTIVE_RECORDER = rec

@@ -52,9 +52,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .parallel import mesh as _mesh
 from .resilience import commit as _commit
 from .resilience import replicate as _replicate
-from .resilience.commit import CheckpointIntegrityWarning, fault_point as _fault_point
+from .resilience.commit import (
+    CheckpointIntegrityWarning,
+    CheckpointShardCoverageError,
+    fault_point as _fault_point,
+)
 from .utils.environment import get_int_from_env
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -91,6 +96,26 @@ def _shard_entry_key(leaf_key: str, starts: tuple[int, ...]) -> str:
     return f"{leaf_key}|{','.join(map(str, starts))}"
 
 
+def _serialize_spec(sharding: Any) -> list | None:
+    """JSON-serializable PartitionSpec (None | axis name | list of names per
+    dim) for a NamedSharding, or None when the sharding carries no spec.
+    Recorded per leaf in the index so an elastic restore knows how each
+    array was laid out at save time (diagnostics + future layout planning);
+    the restore itself re-lays onto the TARGET's current shardings."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out: list = []
+    for entry in spec:
+        if entry is None or entry is PartitionSpec.UNCONSTRAINED:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
 def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) -> None:
     """Write the addressable (replica-0) shards of a pytree of jax.Arrays
     (or pre-snapshotted `_HostShardSnapshot` leaves — the async path).
@@ -116,6 +141,8 @@ def save_pytree(tree: Any, directory: str, *, process_index: int | None = None) 
                 "dtype": str(leaf.dtype),
                 "shards": [],
             }
+            if leaf.spec is not None:
+                entry["spec"] = leaf.spec
             for starts, data in leaf.shards:
                 shard_data[_shard_entry_key(key, starts)] = data
                 entry["shards"].append({"starts": list(starts), "shape": list(data.shape)})
@@ -222,9 +249,12 @@ class _ShardReader:
             out[dst_idx] = src[src_idx]
             covered[dst_idx] = True
         if not covered.all():
-            raise ValueError(
+            raise CheckpointShardCoverageError(
                 f"Checkpoint shards for {key!r} do not cover requested slice {idx} "
-                f"({int(covered.sum())}/{int(np.prod(req_shape))} elements covered)"
+                f"({int(covered.sum())}/{int(np.prod(req_shape))} elements covered) "
+                "— a shard file another process wrote is missing from this "
+                "directory (per-node checkpoint restored at a different "
+                "topology without a replicate store, or deleted shard files)"
             )
         return out
 
@@ -687,12 +717,16 @@ def _save_state_impl(
                 pickle.dump(obj.state_dict(), f)
             written.append(CUSTOM_FILE.format(i=i))
         with open(os.path.join(tmp_dir, METADATA_FILE), "w") as f:
+            # v2 records the full topology signature (mesh axis sizes,
+            # process count, device count) so a restore can detect that the
+            # pod came back at a different size and engage the elastic
+            # reshard path. v1 readers ignore the extra key; v1 checkpoints
+            # (no num_devices) compare permissively on the recorded fields.
             json.dump(
                 {
                     "step": step_value,
-                    "mesh": dict(accelerator.mesh.shape),
-                    "num_processes": jax.process_count(),
-                    "version": 1,
+                    **_mesh.topology_signature(accelerator.mesh),
+                    "version": 2,
                 },
                 f,
             )
@@ -754,7 +788,10 @@ def _barrier_and_commit(
     """
     proc = jax.process_index()
     nproc = jax.process_count()
-    meta = {"step": step_value, "num_processes": nproc}
+    # The marker carries the topology signature too: it is the first file a
+    # restore reads, and save_on_each_node directories have no metadata.json
+    # from every process — the signature must survive in the per-node copy.
+    meta = {"step": step_value, **_mesh.topology_signature(accelerator.mesh)}
     if accelerator.project_config.save_on_each_node:
         # Each node commits its own local directory carrying ONE manifest;
         # flag it so verify_checkpoint's completeness check (manifest count
@@ -912,6 +949,7 @@ class _HostShardSnapshot:
         self.shape = tuple(arr.shape)
         self.dtype = np.dtype(arr.dtype)
         self.ndim = arr.ndim
+        self.spec = _serialize_spec(getattr(arr, "sharding", None))
         self.shards: list[tuple[tuple[int, ...], np.ndarray]] = []
         any_replica0 = False
         for shard in arr.addressable_shards:
@@ -925,6 +963,165 @@ class _HostShardSnapshot:
             # some topologies; main process persists replicated leaves.
             self.shards.append(((0,) * arr.ndim, np.asarray(arr)))
 
+
+
+def saved_topology(input_dir: str) -> dict | None:
+    """The topology signature a checkpoint was saved under — from the
+    ``COMMIT`` marker first (present in every committed directory, including
+    per-node copies), ``metadata.json`` as fallback. None for legacy
+    pre-metadata checkpoints (which then load permissively, exactly as
+    before this metadata existed)."""
+    sources: list[dict[str, Any]] = []
+    if _commit.is_committed(input_dir):
+        try:
+            sources.append(_commit.read_commit_marker(input_dir))
+        except (ValueError, OSError):
+            pass
+    meta_path = os.path.join(input_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                sources.append(json.load(f))
+        except (ValueError, OSError):
+            pass
+    for src in sources:
+        sig = {
+            k: src[k]
+            for k in ("mesh", "num_processes", "num_devices")
+            if src.get(k) is not None
+        }
+        if sig:
+            return sig
+    return None
+
+
+def _ensure_shard_coverage(
+    accelerator: "Accelerator", input_dir: str, saved: dict | None
+) -> None:
+    """Elastic-restore prelude: make every saved process's shard files
+    reachable from THIS directory before `load_pytree` assembles globals.
+
+    On a shared filesystem all ``index_<p>.json``/``shards_<p>.npz`` files
+    are already local and this is a no-op. With ``save_on_each_node`` (or a
+    partially-lost root) the peers' files live under the replicate store —
+    ``node_<p>/<name>/`` prefixes, or the flat ``<name>/`` prefix the
+    shared-fs Replicator uploads everything under. Fetches are atomic
+    (``.fetch`` tmp + rename) and verified against the peer's remote
+    manifest when one exists; anything still missing surfaces later as
+    `CheckpointShardCoverageError` (never a silent partial reshard).
+    """
+    model_dir = os.path.join(input_dir, MODEL_DIR)
+    want = int((saved or {}).get("num_processes") or 0)
+    if want <= 1:
+        return
+    have: set[int] = set()
+    if os.path.isdir(model_dir):
+        for name in os.listdir(model_dir):
+            m = re.match(r"^index_(\d+)\.json$", name)
+            # A proc counts as covered only with BOTH files: a fetch killed
+            # between index and shards must be retried, not trusted.
+            if m and os.path.exists(
+                os.path.join(model_dir, SHARDS_FILE.format(proc=int(m.group(1))))
+            ):
+                have.add(int(m.group(1)))
+    missing = [p for p in range(want) if p not in have]
+    if not missing:
+        return
+    replicator = getattr(accelerator, "_replicator", None)
+    store = replicator.store if replicator is not None else _replicate.store_from_env()
+    if store is None:
+        logger.warning(
+            "elastic restore of %s: %d saved process(es) have no shard files "
+            "here and no replicate store is configured (ATX_REPLICATE_URL) — "
+            "the restore fails with CheckpointShardCoverageError if any leaf "
+            "needs them",
+            input_dir,
+            len(missing),
+        )
+        return
+    name = os.path.basename(os.path.abspath(input_dir))
+    for p in missing:
+        rels = [
+            f"{MODEL_DIR}/{INDEX_FILE.format(proc=p)}",
+            f"{MODEL_DIR}/{SHARDS_FILE.format(proc=p)}",
+        ]
+        fetched = False
+        for prefix in (f"node_{p}/{name}", name):
+            if not store.exists(f"{prefix}/{rels[0]}"):
+                continue
+            # Download + verify into ``.fetch`` siblings first; the committed
+            # directory only changes in the final all-or-nothing rename pass,
+            # so a crash mid-fetch leaves the checkpoint exactly as it was.
+            pending: list[tuple[str, str, str]] = []
+            try:
+                for rel in rels:
+                    dst = os.path.join(input_dir, rel.replace("/", os.sep))
+                    tmp = dst + ".fetch"
+                    store.get_file(f"{prefix}/{rel}", tmp)
+                    pending.append((rel, tmp, dst))
+                    _fault_point("restore.peer_shard_fetched")
+                _verify_fetched_shards(store, prefix, p, pending)
+                for _, tmp, dst in pending:
+                    os.replace(tmp, dst)
+                fetched = True
+                break
+            except Exception as e:
+                for _, tmp, _ in pending:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                logger.warning(
+                    "elastic restore of %s: fetching process %d's shard "
+                    "files from %r/%s failed: %s",
+                    input_dir,
+                    p,
+                    store,
+                    prefix,
+                    e,
+                )
+        if fetched:
+            logger.info(
+                "elastic restore of %s: fetched process %d's shard files "
+                "from %r",
+                input_dir,
+                p,
+                store,
+            )
+        else:
+            logger.warning(
+                "elastic restore of %s: process %d's shard files are not in "
+                "%r either — the restore fails with "
+                "CheckpointShardCoverageError if any leaf needs them",
+                input_dir,
+                p,
+                store,
+            )
+
+
+def _verify_fetched_shards(
+    store: Any, prefix: str, proc: int, pending: list[tuple[str, str, str]]
+) -> None:
+    """Best-effort hash check of just-downloaded peer shard files (still at
+    their ``.fetch`` tmp paths) against the peer's remote manifest. A
+    mismatch raises BEFORE anything is renamed into the committed directory;
+    a store with no manifest passes — `read_slice` coverage is the backstop."""
+    try:
+        manifest = json.loads(
+            store.get_bytes(
+                f"{prefix}/{_commit.MANIFEST_FILE.format(proc=proc)}"
+            ).decode()
+        )
+    except Exception:
+        return
+    for rel, tmp, _ in pending:
+        info = manifest.get("files", {}).get(rel)
+        if info is None or not os.path.exists(tmp):
+            continue
+        if _commit.file_sha256(tmp) != info["sha256"]:
+            raise ValueError(
+                f"fetched {rel} does not match process {proc}'s remote manifest"
+            )
 
 
 def load_state(
@@ -1008,9 +1205,22 @@ def _load_state_impl(
                 continue
             logger.info("resuming from committed checkpoint %s", candidate)
             _backfill_replicator(accelerator, candidate)
-            return _load_state_dir(
-                accelerator, candidate, state, dataloaders=dataloaders
-            )
+            try:
+                return _load_state_dir(
+                    accelerator, candidate, state, dataloaders=dataloaders
+                )
+            except CheckpointShardCoverageError as e:
+                # A partial reshard would silently resume on garbage;
+                # fall back to the previous committed checkpoint instead.
+                warnings.warn(
+                    f"committed checkpoint {candidate} cannot be fully "
+                    f"assembled at the current topology ({e}); falling back "
+                    "to the previous committed checkpoint",
+                    CheckpointIntegrityWarning,
+                    stacklevel=2,
+                )
+                failures.append(f"{candidate}: {e}")
+                continue
         # Every local checkpoint is corrupt: a remote replica may still be
         # intact (restore_latest re-downloads over corrupt local copies).
         restored = _remote_restore(accelerator, root)
@@ -1038,7 +1248,20 @@ def _load_state_impl(
             "load_state(..., resume='latest') on the checkpoints root to "
             "fall back automatically)"
         )
-    return _load_state_dir(accelerator, input_dir, state, dataloaders=dataloaders)
+    try:
+        return _load_state_dir(accelerator, input_dir, state, dataloaders=dataloaders)
+    except CheckpointShardCoverageError as e:
+        saved = saved_topology(input_dir)
+        raise CheckpointShardCoverageError(
+            f"checkpoint at {input_dir!r} cannot be fully assembled at the "
+            "current topology "
+            f"({_mesh.describe_topology(_mesh.topology_signature(accelerator.mesh))}); "
+            f"it was saved under {_mesh.describe_topology(saved)}. {e} — "
+            "fixes: arm ATX_REPLICATE_URL so missing peer shard files are "
+            "fetched from the replicate store, restore at the saved "
+            "topology, or use resume='latest' on the checkpoints root to "
+            "fall back to an older checkpoint automatically"
+        ) from e
 
 
 def _load_state_dir(
@@ -1048,6 +1271,23 @@ def _load_state_dir(
     *,
     dataloaders: Iterable[Any] | None = None,
 ) -> "TrainState":
+    saved = saved_topology(input_dir)
+    if not _mesh.topology_matches(saved, accelerator.mesh):
+        # Elastic reshard-on-restore: the pod came back at a different
+        # size/slice. The on-disk format is already topology-independent
+        # (global shape + shard table per leaf; load_pytree reassembles any
+        # slice) — what changes here is reach: peers' shard files may live
+        # on nodes that no longer exist, so pull them from the replicate
+        # store first, and say loudly what is happening.
+        logger.warning(
+            "checkpoint %s was saved under %s; restoring onto %s — elastic "
+            "reshard-on-restore engaged (every leaf is reassembled from the "
+            "saved shard files and re-laid onto the current mesh)",
+            input_dir,
+            _mesh.describe_topology(saved),
+            _mesh.describe_topology(_mesh.topology_signature(accelerator.mesh)),
+        )
+        _ensure_shard_coverage(accelerator, input_dir, saved)
     model_dir = os.path.join(input_dir, MODEL_DIR)
     target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
     if state.loss_scale is not None and _index_has_prefix(model_dir, "loss_scale"):

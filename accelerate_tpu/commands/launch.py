@@ -124,6 +124,16 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "resilience.replicate.register_store_scheme — "
         "docs/fault_tolerance.md)",
     )
+    p.add_argument(
+        "--elastic_devices_file",
+        default=None,
+        help="Path to a file holding one integer: the --host_devices value "
+        "to use for each worker-group (re)start. Re-read before every "
+        "group launch, so an elastic restart (preemption exit-75, health "
+        "escalation) can come back at a SMALLER simulated device count and "
+        "the workers reshard their checkpoint on restore "
+        "(docs/fault_tolerance.md, elastic resume)",
+    )
     p.add_argument("--dry_run", action="store_true", help="Print commands, don't run")
     p.add_argument("script", help="Training script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER, help="Script arguments")
@@ -278,6 +288,38 @@ def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
             p.kill()
 
 
+def _apply_elastic_devices(args) -> None:
+    """Re-read ``--elastic_devices_file`` (when given) before a worker-group
+    (re)start: the file holds the ``--host_devices`` value for the NEXT
+    group, so an external controller (or a test) can shrink the simulated
+    topology between an emergency exit and the elastic resume. Unreadable /
+    non-integer content keeps the previous value — a live elastic loop must
+    not die on a torn write."""
+    path = getattr(args, "elastic_devices_file", None)
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            devices = int(f.read().strip())
+    except (OSError, ValueError) as e:
+        print(
+            f"[accelerate-tpu launch] could not read --elastic_devices_file "
+            f"{path!r} ({e}); keeping host_devices={args.host_devices}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return
+    if devices > 0 and devices != args.host_devices:
+        print(
+            f"[accelerate-tpu launch] elastic devices file: next worker "
+            f"group starts with host_devices={devices} "
+            f"(was {args.host_devices})",
+            file=sys.stderr,
+            flush=True,
+        )
+        args.host_devices = devices
+
+
 def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
     """Spawn num_processes children on this machine (rendezvous over
     localhost) — the CPU-simulation / single-host-multi-proc path that the
@@ -312,6 +354,7 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
         else:
             cfg.coordinator_address = f"127.0.0.1:{_free_port()}"
         first_group = False
+        _apply_elastic_devices(args)
         exit_code = _run_worker_group(cfg, cmd, args)
         if exit_code == 0:
             return 0
@@ -508,6 +551,7 @@ def run(args: argparse.Namespace) -> int:
             "not restarted.",
             file=sys.stderr,
         )
+    _apply_elastic_devices(args)
     env = build_child_env(cfg, None, host_devices=args.host_devices)
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in cmd))

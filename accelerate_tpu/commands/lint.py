@@ -59,7 +59,7 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="also verify multi-host SPMD consistency (ATX5xx) by replaying "
         "each scenario under N simulated processes; adds the host-loop "
         "scenarios (save_path, preemption_exit, router_drain, "
-        "replicated_save) to the default set",
+        "replicated_save, elastic_restore) to the default set",
     )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
@@ -265,9 +265,12 @@ SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
 
 
 def _mh_scenario_save_path(processes: int = 2):
-    """checkpointing.save_state: train one step then save synchronously —
-    the precommit markers, commit barrier, and final-dir broadcast must
-    issue an identical collective schedule on every process."""
+    """checkpointing.save_state: train one step then save synchronously,
+    then another step and an ASYNC save — the precommit markers, commit
+    barrier, and final-dir broadcast must issue an identical collective
+    schedule on every process, in both save modes (the replay models the
+    async writer by running the submitted job inline, so its precommit
+    file-barrier schedule is checked too)."""
     import tempfile
 
     import jax
@@ -298,11 +301,18 @@ def _mh_scenario_save_path(processes: int = 2):
         )
         state, _ = step(state, {"x": np.ones((8, 8), np.float32)})
         checkpointing.save_state(acc, None, state, async_save=False)
+        state, _ = step(state, {"x": np.ones((8, 8), np.float32)})
+        checkpointing.save_state(acc, None, state, async_save=True)
+        checkpointing.wait_for_checkpoint()
 
     report = analysis.lint_host_loop(
         save_loop, processes=processes, target="save_path"
     )
-    return f"train step + synchronous save_state, {processes} processes", report
+    return (
+        f"train step + sync save_state + async save_state, "
+        f"{processes} processes",
+        report,
+    )
 
 
 def _mh_scenario_preemption_exit(processes: int = 2):
@@ -483,11 +493,98 @@ def _mh_scenario_replicated_save(processes: int = 2):
     )
 
 
+def _mh_scenario_elastic_restore(processes: int = 2):
+    """Elastic reshard-on-restore: save a committed checkpoint, doctor its
+    recorded topology signature so the restore sees a world-size mismatch,
+    then ``load_state(resume="latest")``. The whole restore — discovery,
+    verification, topology detection, peer-shard coverage probing, shard
+    assembly — must be COLLECTIVE-FREE (sentinel polling + file IO only):
+    a SMALLER surviving group restores without the dead ranks, so any
+    collective here would hang the resume. The replay pins exactly that:
+    zero new collective-log events between save and restored state."""
+    import json as _json
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis, checkpointing
+    from ..accelerator import Accelerator, TrainState
+    from ..state import AcceleratorState
+    from ..utils.dataclasses import ProjectConfiguration
+
+    # ONE root shared by every simulated process (and every replay round):
+    # the save path broadcasts process 0's directory choice, so per-process
+    # roots would leave process 1's own root empty at restore time. Rounds
+    # just stack checkpoint_<n> dirs; names never enter event signatures.
+    root = tempfile.mkdtemp(prefix="atx_lint_mh_elastic_")
+
+    def restore_loop():
+        AcceleratorState._reset_state()
+        # save_on_each_node: each simulated process commits a self-contained
+        # checkpoint (the per-node-filesystem shape), so whichever process
+        # committed last, the directory it restores from is complete.
+        acc = Accelerator(
+            seed=0,
+            project_config=ProjectConfiguration(
+                project_dir=root,
+                automatic_checkpoint_naming=True,
+                save_on_each_node=True,
+            ),
+        )
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)}
+        state = acc.prepare_train_state(
+            TrainState.create(params=params, tx=optax.sgd(1e-2))
+        )
+        step = acc.make_train_step(
+            lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+        )
+        state, _ = step(state, {"x": np.ones((8, 8), np.float32)})
+        final_dir = checkpointing.save_state(acc, None, state, async_save=False)
+        # Doctor the recorded topology (num_devices) so the restore takes
+        # the elastic mismatch path — detection, coverage probe and all.
+        from ..resilience.commit import COMMIT_MARKER
+
+        marker = os.path.join(final_dir, COMMIT_MARKER)
+        with open(marker) as f:
+            meta = _json.load(f)
+        meta["num_devices"] = int(meta.get("num_devices") or 1) * 2
+        with open(marker, "w") as f:
+            _json.dump(meta, f)
+        from ..analysis import host_trace
+
+        rec = host_trace._ACTIVE_RECORDER
+        before = len(rec.collective_events) if rec is not None else None
+        restored = checkpointing.load_state(acc, None, state, resume="latest")
+        if rec is not None:
+            grew = len(rec.collective_events) - before
+            assert grew == 0, (
+                f"elastic restore issued {grew} collective(s); the restore "
+                "path must stay collective-free so a smaller surviving "
+                "group can resume without the dead ranks"
+            )
+        assert int(jax.device_get(restored.step)) == int(
+            jax.device_get(state.step)
+        ), "restore returned the wrong step"
+
+    report = analysis.lint_host_loop(
+        restore_loop, processes=processes, target="elastic_restore"
+    )
+    return (
+        f"committed save + topology-mismatched resume='latest' restore "
+        f"(must add zero collectives), {processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
     "router_drain": _mh_scenario_router_drain,
     "replicated_save": _mh_scenario_replicated_save,
+    "elastic_restore": _mh_scenario_elastic_restore,
 }
 
 

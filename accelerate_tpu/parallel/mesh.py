@@ -246,3 +246,52 @@ def constrain_batch(x: jax.Array) -> jax.Array:
 def local_batch_count(mesh: Mesh) -> int:
     """How many batch shards live on this process (for host-sharded loading)."""
     return data_parallel_size(mesh) // jax.process_count()
+
+
+# ----------------------------------------------------- topology fingerprints
+def topology_signature(mesh: Mesh) -> dict:
+    """JSON-serializable fingerprint of the save-time topology, recorded in
+    checkpoint metadata (checkpointing.py metadata v2 + COMMIT marker) so
+    ``load_state(resume="latest")`` can detect that the pod came back at a
+    different size/slice and switch to the elastic reshard-on-restore path
+    instead of silently assuming shard files line up."""
+    return {
+        "mesh": {axis: int(size) for axis, size in mesh.shape.items()},
+        "num_processes": int(jax.process_count()),
+        "num_devices": int(mesh.size),
+    }
+
+
+def topology_matches(saved: dict | None, mesh: Mesh) -> bool:
+    """Does a saved topology signature describe the CURRENT world? ``None``
+    (legacy pre-metadata checkpoint) and partially-recorded signatures
+    compare permissively — only the recorded fields are checked, so old
+    checkpoints keep loading exactly as before at a matching topology."""
+    if not saved:
+        return True
+    current = topology_signature(mesh)
+    for key in ("mesh", "num_processes", "num_devices"):
+        if key in saved and saved[key] is not None:
+            want = saved[key]
+            have = current[key]
+            if key == "mesh":
+                if {a: int(s) for a, s in dict(want).items()} != have:
+                    return False
+            elif int(want) != int(have):
+                return False
+    return True
+
+
+def describe_topology(sig: dict | None) -> str:
+    """Human-readable one-liner for elastic-restore log lines and errors."""
+    if not sig:
+        return "unknown topology (legacy checkpoint, no metadata)"
+    mesh_part = (
+        "x".join(f"{a}={s}" for a, s in dict(sig["mesh"]).items())
+        if sig.get("mesh")
+        else "mesh=?"
+    )
+    return (
+        f"{sig.get('num_devices', '?')} device(s) / "
+        f"{sig.get('num_processes', '?')} process(es) [{mesh_part}]"
+    )

@@ -28,7 +28,14 @@ notice, or a hung step a *recoverable* event:
   checkpoint into a pluggable `ObjectStore` (``ATX_REPLICATE_URL``) with
   resumable part uploads, retry/backoff, and a remote ``COMMIT`` marker
   written last; `restore_latest` brings the newest remote committed
-  checkpoint back when the local root is lost.
+  checkpoint back when the local root is lost. The ``gs://`` scheme is
+  backed by :mod:`~accelerate_tpu.resilience.gcs` when the
+  ``google-cloud-storage`` SDK is importable.
+- :mod:`~accelerate_tpu.resilience.health` — opt-in peer-health watchdog
+  (``ATX_HEALTH_BEAT_SECS``): collective-free heartbeat files/objects per
+  process; a monitor flags stale peers (logging their last-known step) and
+  escalates to the emergency-save + exit-75 elastic path in seconds instead
+  of wedging until the per-step ``ATX_WATCHDOG_SECS`` deadline.
 
 Fault-injection hooks (`commit.fault_point`) are no-ops unless one of the
 ``ATX_FAULT_{KILL,RAISE}_AT`` env vars is set; the test harness that drives
@@ -40,6 +47,7 @@ from .commit import (
     COMMIT_MARKER,
     TMP_SUFFIX,
     CheckpointIntegrityWarning,
+    CheckpointShardCoverageError,
     commit_dir,
     committed_checkpoints,
     fault_point,
@@ -51,6 +59,7 @@ from .commit import (
     write_manifest,
 )
 from .gce import MaintenancePoller, maintenance_poller_from_env
+from .health import PeerHealthMonitor, health_from_env
 from .replicate import (
     LocalObjectStore,
     ObjectStore,
@@ -77,11 +86,13 @@ __all__ = [
     "COMMIT_MARKER",
     "TMP_SUFFIX",
     "CheckpointIntegrityWarning",
+    "CheckpointShardCoverageError",
     "LocalObjectStore",
     "MaintenancePoller",
     "ObjectStore",
     "ObjectStoreError",
     "PREEMPTION_EXIT_CODE",
+    "PeerHealthMonitor",
     "Replicator",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
@@ -91,6 +102,7 @@ __all__ = [
     "committed_checkpoints",
     "dump_all_stacks",
     "fault_point",
+    "health_from_env",
     "install_preemption_handler",
     "is_committed",
     "latest_committed",

@@ -49,6 +49,14 @@ class CheckpointIntegrityWarning(UserWarning):
     (resume fell back to the previous committed checkpoint)."""
 
 
+class CheckpointShardCoverageError(ValueError):
+    """An elastic (topology-changed) restore could not assemble some leaf's
+    GLOBAL value: the shard files reachable from this process (local dir +
+    fetched peer shards + remote store) leave a hole in the array. Raised
+    instead of silently resuming on a partial reshard; ``resume="latest"``
+    catches it, warns, and falls back to the previous committed checkpoint."""
+
+
 def _maybe_collective_log(kind: str, name: str) -> None:
     """Opt-in runtime mirror of the ATX5xx collective log
     (``ATX_COLLECTIVE_LOG=1``): the commit barrier halves are part of the

@@ -1,0 +1,157 @@
+"""Real ``gs://`` ObjectStore for checkpoint replication.
+
+Thin wrapper over the official ``google-cloud-storage`` SDK implementing
+the `ObjectStore` contract (`resilience/replicate.py`): atomic writes (GCS
+object creation is atomic by construction), stat with size (GCS reports
+md5/crc32c, not SHA-256, so ``ObjectStat.sha256`` is None and the
+Replicator's resumable-skip check falls back to size-only — the final
+`verify_checkpoint` after a restore still hashes every byte), recursive
+prefix listing, and deletes.
+
+The SDK import is **lazy and gated**: this module imports cleanly on
+machines without the SDK, and only `GcsObjectStore` construction raises —
+with an actionable message — when ``google.cloud.storage`` is missing.
+``store_for_url("gs://bucket/prefix")`` routes here automatically via the
+scheme registry; tests inject a fake SDK client, so the wrapper is
+exercised without network or credentials.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from .replicate import ObjectStat, ObjectStore, ObjectStoreError
+
+_MISSING_SDK_MSG = (
+    "gs:// replication needs the `google-cloud-storage` package, which is "
+    "not importable in this environment ({error}). Either install it "
+    "(`pip install google-cloud-storage`) or mount the bucket with gcsfuse "
+    "and point ATX_REPLICATE_URL at the mount path to use the filesystem "
+    "store instead."
+)
+
+
+def _load_sdk():
+    try:
+        from google.cloud import storage  # type: ignore[import-not-found]
+    except ImportError as e:
+        raise ObjectStoreError(_MISSING_SDK_MSG.format(error=e)) from e
+    return storage
+
+
+def parse_gs_url(url: str) -> tuple[str, str]:
+    """``gs://bucket[/prefix]`` -> ``(bucket, prefix)``; the prefix is
+    normalized to either ``""`` or ``"...segments.../"`` so key joins are a
+    plain concatenation."""
+    if url.startswith("gs://"):
+        rest = url[len("gs://") :]
+    else:
+        rest = url.lstrip("/")
+    if not rest:
+        raise ObjectStoreError(f"gs:// URL {url!r} names no bucket")
+    bucket, _, prefix = rest.partition("/")
+    prefix = prefix.strip("/")
+    return bucket, f"{prefix}/" if prefix else ""
+
+
+class GcsObjectStore(ObjectStore):
+    """`ObjectStore` over one GCS bucket (+ optional key prefix).
+
+    ``client`` is injectable for tests (any object with the
+    ``google.cloud.storage.Client`` surface: ``bucket(name)`` returning
+    buckets with ``blob(name)``/``list_blobs``); when omitted the real SDK
+    client is constructed — which is the point where missing-SDK and
+    missing-credentials errors surface, with clear messages.
+    """
+
+    def __init__(self, bucket: str, prefix: str = "", *, client: Any = None):
+        if client is None:
+            storage = _load_sdk()
+            try:
+                client = storage.Client()
+            except Exception as e:
+                raise ObjectStoreError(
+                    f"could not construct a GCS client for bucket {bucket!r}: "
+                    f"{e} — configure application-default credentials "
+                    "(GOOGLE_APPLICATION_CREDENTIALS or `gcloud auth "
+                    "application-default login`)"
+                ) from e
+        self.client = client
+        self.bucket_name = bucket
+        self.prefix = prefix
+        self._bucket = client.bucket(bucket)
+
+    @classmethod
+    def from_url(cls, url: str, *, client: Any = None) -> "GcsObjectStore":
+        bucket, prefix = parse_gs_url(url)
+        return cls(bucket, prefix, client=client)
+
+    def _blob(self, key: str):
+        return self._bucket.blob(self.prefix + key)
+
+    def put_file(self, local_path: str, key: str) -> None:
+        self._blob(key).upload_from_filename(local_path)
+
+    def put_bytes(self, data: bytes, key: str) -> None:
+        self._blob(key).upload_from_string(data)
+
+    def get_file(self, key: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        # Download into a sibling tmp + rename so a crashed download never
+        # leaves a partial file where the restore path expects a whole one.
+        tmp = f"{local_path}.get.{os.getpid()}"
+        try:
+            self._blob(key).download_to_filename(tmp)
+        except Exception as e:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise self._translate(e, key)
+        os.replace(tmp, local_path)
+
+    def get_bytes(self, key: str) -> bytes:
+        try:
+            return self._blob(key).download_as_bytes()
+        except Exception as e:
+            raise self._translate(e, key)
+
+    def stat(self, key: str) -> ObjectStat | None:
+        blob = self._bucket.get_blob(self.prefix + key)
+        if blob is None:
+            return None
+        return ObjectStat(size=int(blob.size or 0), sha256=None)
+
+    def list(self, prefix: str = "") -> list[str]:
+        full = self.prefix + prefix
+        out = []
+        for blob in self.client.list_blobs(self.bucket_name, prefix=full):
+            name = blob.name
+            if name.startswith(self.prefix):
+                out.append(name[len(self.prefix) :])
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            self._blob(key).delete()
+        except Exception as e:
+            if self._is_not_found(e):
+                return
+            raise
+
+    def _translate(self, e: Exception, key: str) -> Exception:
+        if self._is_not_found(e):
+            return ObjectStoreError(
+                f"no object {key!r} in gs://{self.bucket_name}/{self.prefix}"
+            )
+        return e
+
+    @staticmethod
+    def _is_not_found(e: Exception) -> bool:
+        # Avoid a hard dependency on google.api_core exception classes: any
+        # client error carrying a 404 code (the real NotFound does) counts.
+        return getattr(e, "code", None) == 404 or type(e).__name__ == "NotFound"
+
+    def __repr__(self) -> str:
+        return f"GcsObjectStore(gs://{self.bucket_name}/{self.prefix})"

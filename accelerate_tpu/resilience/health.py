@@ -1,0 +1,334 @@
+"""Peer-health watchdog: collective-free heartbeats between pod processes.
+
+A wedged or dead peer normally surfaces as a hung collective — every other
+process parks inside the all-reduce until ``ATX_WATCHDOG_SECS`` (a per-step
+deadline measured in minutes, since it must cover legitimate long steps)
+finally fires. This module detects the sick peer *directly*, in seconds:
+
+- every process heartbeats a small counter file/object (local checkpoint
+  root or the ``ATX_REPLICATE_URL`` store — the same sentinel-polling style
+  as the PR-9 remote restore, NO collectives) every ``ATX_HEALTH_BEAT_SECS``;
+- a monitor thread on each process scans the peers' beats; a peer whose
+  counter has not advanced for ``ATX_HEALTH_STALE_SECS`` is flagged — the
+  straggler's last-known training step is logged — and the monitor
+  escalates to the existing preemption path (`request_preemption`), so the
+  next step boundary takes the emergency-save + exit-75 route and the
+  launcher's elastic loop restarts the group at whatever size survives;
+- if the group still hasn't exited ``ATX_HEALTH_EXIT_SECS`` later (the step
+  boundary never came — the survivor itself is parked in a collective with
+  the dead peer), the monitor hard-aborts with ``PREEMPTION_EXIT_CODE`` so
+  the restart fires anyway.
+
+Knobs (all read by `health_from_env`):
+
+- ``ATX_HEALTH_BEAT_SECS``   — beat + scan period; unset/0 disables (default).
+- ``ATX_HEALTH_STALE_SECS``  — silence before a peer is stale (default 5x beat).
+- ``ATX_HEALTH_EXIT_SECS``   — grace between escalation and hard abort
+  (default 4x stale; 0 disables the hard abort).
+- ``ATX_HEALTH_DIR``         — beat directory override (else ``<checkpoint
+  root>/.health`` or the replicate store under ``health/``).
+- ``ATX_HEALTH_PEERS``       — expected process count override.
+
+Like `commit`, this module is jax-free so it stays cheap to import and
+trivially testable single-process (`PeerHealthMonitor.tick` is the whole
+loop body, public for deterministic tests).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+from .preemption import PREEMPTION_EXIT_CODE, request_preemption
+
+logger = logging.getLogger(__name__)
+
+BEAT_FILE = "beat_{proc}.json"
+STORE_PREFIX = "health/"
+
+
+# ------------------------------------------------------------------ backends
+class _FileBackend:
+    """Beats as files in a shared directory (checkpoint root / ATX_HEALTH_DIR)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def write(self, proc: int, payload: dict[str, Any]) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, BEAT_FILE.format(proc=proc))
+        tmp = f"{path}.tmp.{proc}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # readers never see a partial beat
+
+    def read(self, proc: int) -> dict[str, Any] | None:
+        path = os.path.join(self.directory, BEAT_FILE.format(proc=proc))
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def __repr__(self) -> str:  # pragma: no cover - logging only
+        return f"_FileBackend({self.directory!r})"
+
+
+class _StoreBackend:
+    """Beats as objects in the replicate store (per-node filesystems: the
+    store is the only surface every process can both write and read)."""
+
+    def __init__(self, store, prefix: str = STORE_PREFIX):
+        self.store = store
+        self.prefix = prefix
+
+    def write(self, proc: int, payload: dict[str, Any]) -> None:
+        self.store.put_bytes(
+            self.prefix + BEAT_FILE.format(proc=proc),
+            json.dumps(payload).encode(),
+        )
+
+    def read(self, proc: int) -> dict[str, Any] | None:
+        try:
+            raw = self.store.get_bytes(self.prefix + BEAT_FILE.format(proc=proc))
+            return json.loads(raw.decode())
+        except Exception:
+            return None
+
+    def __repr__(self) -> str:  # pragma: no cover - logging only
+        return f"_StoreBackend({self.store!r})"
+
+
+# ------------------------------------------------------------------- monitor
+class PeerHealthMonitor:
+    """One beat-writer + peer-scanner per process.
+
+    A peer that has NEVER been seen is ignored (startup grace by
+    construction: processes come up at different times, and a smaller
+    restarted group simply never sees the dead ranks' beats). Once a peer's
+    counter has been observed, silence beyond ``stale_secs`` flags it.
+    """
+
+    def __init__(
+        self,
+        process_index: int,
+        num_processes: int,
+        backend,
+        *,
+        beat_secs: float = 5.0,
+        stale_secs: float | None = None,
+        exit_after_secs: float | None = None,
+        escalate: Callable[[], None] | None = None,
+        abort: Callable[[int], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.process_index = int(process_index)
+        self.num_processes = int(num_processes)
+        self.backend = backend
+        self.beat_secs = float(beat_secs)
+        self.stale_secs = float(
+            stale_secs if stale_secs is not None else 5.0 * self.beat_secs
+        )
+        self.exit_after_secs = float(
+            exit_after_secs if exit_after_secs is not None else 4.0 * self.stale_secs
+        )
+        self._escalate = escalate if escalate is not None else request_preemption
+        self._abort = abort if abort is not None else self._default_abort
+        self._clock = clock
+        self._seq = 0
+        self._step = 0
+        # peer -> (last observed seq, clock() when it last advanced, last step)
+        self._peer_state: dict[int, tuple[int, float, int]] = {}
+        self.stale_peers: set[int] = set()
+        self.escalations = 0
+        self.aborts = 0
+        self.beats_written = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    @staticmethod
+    def _default_abort(code: int) -> None:  # pragma: no cover - kills the proc
+        sys.stderr.write(
+            "[atx health] hard abort: stale peer(s) persisted past "
+            "ATX_HEALTH_EXIT_SECS and the group never reached a step "
+            f"boundary; exiting {code} for the elastic restart\n"
+        )
+        sys.stderr.flush()
+        os._exit(code)
+
+    # -- producer side -------------------------------------------------------
+    def note_step(self, step: int) -> None:
+        """Record the current training step (host int, no device sync); it
+        rides in the beat payload so a flagged straggler's last-known step
+        lands in the survivors' logs."""
+        self._step = int(step)
+
+    def _write_beat(self) -> None:
+        self._seq += 1
+        try:
+            self.backend.write(
+                self.process_index,
+                {
+                    "process": self.process_index,
+                    "seq": self._seq,
+                    "step": self._step,
+                    "time": time.time(),
+                },
+            )
+            self.beats_written += 1
+        except Exception as e:  # diagnostics must never kill training
+            logger.warning("[atx health] beat write failed: %s", e)
+
+    # -- monitor side --------------------------------------------------------
+    def _scan_peers(self) -> None:
+        now = self._clock()
+        for peer in range(self.num_processes):
+            if peer == self.process_index:
+                continue
+            payload = self.backend.read(peer)
+            if payload is None:
+                continue  # never seen / unreadable: startup grace
+            try:
+                seq = int(payload.get("seq", 0))
+                step = int(payload.get("step", -1))
+            except (TypeError, ValueError):
+                continue
+            prev = self._peer_state.get(peer)
+            if prev is None or seq != prev[0]:
+                self._peer_state[peer] = (seq, now, step)
+                if peer in self.stale_peers:
+                    self.stale_peers.discard(peer)
+                    logger.warning(
+                        "[atx health] peer %d recovered (beat advanced)", peer
+                    )
+                continue
+            silent = now - prev[1]
+            if silent <= self.stale_secs:
+                continue
+            if peer not in self.stale_peers:
+                self.stale_peers.add(peer)
+                logger.warning(
+                    "[atx health] peer %d is stale: no heartbeat for %.1fs "
+                    "(> ATX_HEALTH_STALE_SECS=%.1fs); last-known step %d. "
+                    "Escalating to emergency-save + exit-%d so the elastic "
+                    "launcher restarts the group.",
+                    peer,
+                    silent,
+                    self.stale_secs,
+                    prev[2],
+                    PREEMPTION_EXIT_CODE,
+                )
+                self.escalations += 1
+                try:
+                    self._escalate()
+                except Exception as e:  # pragma: no cover - diagnostics only
+                    logger.warning("[atx health] escalation failed: %s", e)
+            elif (
+                self.exit_after_secs > 0
+                and silent > self.stale_secs + self.exit_after_secs
+            ):
+                # The step boundary never came — we are probably parked in a
+                # collective with the dead peer. Abort so the restart fires.
+                self.aborts += 1
+                self._abort(PREEMPTION_EXIT_CODE)
+
+    def tick(self) -> None:
+        """One beat + one peer scan — the entire loop body, public so tests
+        drive the protocol deterministically (injected clock, no thread)."""
+        self._write_beat()
+        self._scan_peers()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="atx-health", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # pragma: no cover - never kill training
+                logger.warning("[atx health] tick failed: %s", e)
+            self._stop.wait(self.beat_secs)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(5.0, 2.0 * self.beat_secs))
+            self._thread = None
+
+
+# ----------------------------------------------------------------- env entry
+def _env_float(name: str, default: float | None) -> float | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def health_from_env(
+    *,
+    root: str | None = None,
+    store=None,
+    process_index: int | None = None,
+    num_processes: int | None = None,
+) -> PeerHealthMonitor | None:
+    """Build the monitor from the env contract; None unless
+    ``ATX_HEALTH_BEAT_SECS`` is set > 0 (opt-in, like the step watchdog).
+
+    Beat surface precedence: ``ATX_HEALTH_DIR`` > replicate ``store`` >
+    ``<root>/.health``. With none of the three available the monitor is
+    disabled with a warning rather than raising — health checking is an
+    aid, not a correctness requirement.
+    """
+    beat = _env_float("ATX_HEALTH_BEAT_SECS", 0.0) or 0.0
+    if beat <= 0:
+        return None
+    if process_index is None:
+        process_index = int(os.environ.get("ATX_PROCESS_ID", "0") or 0)
+    if num_processes is None:
+        num_processes = int(os.environ.get("ATX_NUM_PROCESSES", "1") or 1)
+    peers_override = os.environ.get("ATX_HEALTH_PEERS", "").strip()
+    if peers_override:
+        try:
+            num_processes = int(peers_override)
+        except ValueError:
+            pass
+    health_dir = os.environ.get("ATX_HEALTH_DIR", "").strip()
+    if health_dir:
+        backend = _FileBackend(health_dir)
+    elif store is not None:
+        backend = _StoreBackend(store)
+    elif root:
+        backend = _FileBackend(os.path.join(root, ".health"))
+    else:
+        logger.warning(
+            "[atx health] ATX_HEALTH_BEAT_SECS set but no beat surface "
+            "(no ATX_HEALTH_DIR, no replicate store, no checkpoint root); "
+            "peer-health monitoring disabled"
+        )
+        return None
+    stale = _env_float("ATX_HEALTH_STALE_SECS", None)
+    exit_after = _env_float("ATX_HEALTH_EXIT_SECS", None)
+    return PeerHealthMonitor(
+        process_index,
+        num_processes,
+        backend,
+        beat_secs=beat,
+        stale_secs=stale,
+        exit_after_secs=exit_after,
+    )

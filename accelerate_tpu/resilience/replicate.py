@@ -238,14 +238,12 @@ def _file_store(path: str) -> ObjectStore:
 
 
 def _gcs_store(url: str) -> ObjectStore:
-    raise ObjectStoreError(
-        f"the built-in gs:// handler is a placeholder ({url!r}): install a "
-        "GCS client and register a real store, e.g.\n"
-        "    from accelerate_tpu.resilience import replicate\n"
-        "    replicate.register_store_scheme('gs', MyGcsStore.from_url)\n"
-        "— or mount the bucket (gcsfuse) and point ATX_REPLICATE_URL at the "
-        "mount path to use the filesystem store."
-    )
+    # Lazy import: gcs.py itself gates on google-cloud-storage availability
+    # and raises a clear ObjectStoreError (install the SDK, or gcsfuse-mount
+    # the bucket and use the filesystem store) when the SDK is missing.
+    from .gcs import GcsObjectStore
+
+    return GcsObjectStore.from_url(url)
 
 
 register_store_scheme("file", _file_store)

@@ -18,7 +18,10 @@ Two families of faults:
   convert into a quarantine; ``ATX_FAULT_DELAY_AT=<point>`` sleeps
   ``ATX_FAULT_DELAY_SECS`` (default 1.0) there and continues — the
   slow-transport analog, for testing watchdog interaction, replication
-  drain deadlines, and kill-during-upload races deterministically.
+  drain deadlines, and kill-during-upload races deterministically;
+  ``ATX_FAULT_NAN_AT=<point>[@N]`` makes `maybe_poison(point, arr)` return
+  the array with a NaN planted — the divergent-batch analog driving the
+  ``ATX_NAN_GUARD`` tests (the training scripts call it on each batch).
 
 Any spec may carry a hit count, ``<point>@N``: the fault fires on the
 Nth time execution reaches that point (process-wide counter) and never
@@ -67,6 +70,7 @@ RAISE_AT_ENV = "ATX_FAULT_RAISE_AT"
 HANG_AT_ENV = "ATX_FAULT_HANG_AT"
 DELAY_AT_ENV = "ATX_FAULT_DELAY_AT"
 DELAY_SECS_ENV = "ATX_FAULT_DELAY_SECS"
+NAN_AT_ENV = "ATX_FAULT_NAN_AT"
 
 # Hits seen per counted spec ("point@N"); plain specs never touch this.
 _HIT_COUNTS: dict[str, int] = {}
@@ -123,6 +127,23 @@ def crash_point(name: str) -> None:
         sys.stderr.write(f"[faults] kill -9 analog at crash point {name!r}\n")
         sys.stderr.flush()
         os._exit(KILL_EXIT_CODE)
+
+
+def maybe_poison(name: str, array):
+    """Numeric fault: when ``ATX_FAULT_NAN_AT=<name>[@N]`` names this point,
+    return ``array`` with its first element set to NaN — the divergent-batch
+    analog the ``ATX_NAN_GUARD`` budget exists for. ``name@N`` poisons only
+    the Nth visit (process-wide counter, same as the crash-point specs).
+    Returns the array unchanged otherwise."""
+    if not _should_fire(os.environ.get(NAN_AT_ENV), name):
+        return array
+    sys.stderr.write(f"[faults] NaN poison at point {name!r}\n")
+    sys.stderr.flush()
+    import numpy as np
+
+    out = np.array(array, copy=True)
+    out.reshape(-1)[0] = np.nan
+    return out
 
 
 @contextmanager

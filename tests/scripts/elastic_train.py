@@ -1,0 +1,137 @@
+"""Elastic-resume subprocess driver (tests/test_elastic.py).
+
+Deterministic tiny FSDP training run whose mesh adapts to however many
+devices XLA gives it (``fsdp = len(jax.devices())``) — the parent varies
+``XLA_FLAGS=--xla_force_host_platform_device_count`` between runs, so a
+checkpoint saved under an 8-device mesh is restored under 4 or 2 and the
+reshard-on-restore path does the reassembly. Appends ``<step> <loss.hex()>``
+lines to ``--loss_file`` (the trajectory oracle). Modes:
+
+- ``--preempt_at K``: after step K the driver raises SIGTERM against
+  itself — the resilience layer's emergency save + exit-75 path fires at
+  the NEXT step entry, exactly as a spot reclaim would.
+- ``--resume``: ``load_state(resume="latest")`` before stepping (the
+  elastic restore; remote-only when the parent deleted the local root and
+  armed ``ATX_REPLICATE_URL``).
+- ``--save_at K`` / ``--final_save``: synchronous saves, as in
+  replicate_train.py.
+- ``--poison``: build every batch through ``faults.maybe_poison("train.
+  batch", x)`` so ``ATX_FAULT_NAN_AT=train.batch[@N]`` in the env plants
+  NaNs; with ``ATX_NAN_GUARD=1`` the guard must skip those updates and,
+  past the budget, abort — the driver prints ``NAN_GUARD_ABORT`` plus the
+  guard counters and exits 42 so the parent can assert on it.
+
+Ends with ``end_training()`` and ``[elastic_train] DONE``.
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+NAN_GUARD_ABORT_EXIT = 42
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--loss_file", required=True)
+    ap.add_argument("--save_at", type=int, default=None)
+    ap.add_argument("--preempt_at", type=int, default=None)
+    ap.add_argument("--final_save", action="store_true")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--poison", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.parallel import MeshConfig
+    from accelerate_tpu.test_utils import faults
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    n_dev = len(jax.devices())
+    acc = atx.Accelerator(
+        mesh_config=MeshConfig(data=1, fsdp=n_dev),
+        strategy="FSDP",
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir,
+            automatic_checkpoint_naming=True,
+            total_limit=3,
+        ),
+        seed=0,
+    )
+    print(f"[elastic_train] mesh fsdp={n_dev}", flush=True)
+
+    def init_fn(rng):
+        return {
+            "w": jax.random.normal(rng, (64, 64), jnp.float32) * 0.1,
+            "b": jnp.zeros((64,), jnp.float32),
+        }
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    state = acc.create_train_state(init_fn, optax.adam(1e-2))
+    step = acc.make_train_step(loss_fn)
+
+    start = 0
+    if args.resume:
+        state = acc.load_state(None, state, resume="latest")
+        start = int(jax.device_get(state.step))
+        print(f"[elastic_train] resumed at step {start}", flush=True)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1234 + i)
+        x = rng.normal(size=(16, 64)).astype(np.float32)
+        if args.poison:
+            x = faults.maybe_poison("train.batch", x)
+        return {
+            "x": jnp.asarray(x),
+            "y": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+        }
+
+    try:
+        with open(args.loss_file, "a") as out:
+            for i in range(start, args.steps):
+                state, metrics = step(state, make_batch(i))
+                out.write(f"{i} {float(jax.device_get(metrics['loss'])).hex()}\n")
+                out.flush()
+                if args.save_at is not None and i == args.save_at:
+                    acc.save_state(None, state)
+                if args.preempt_at is not None and i == args.preempt_at:
+                    # Deliver the preemption notice to ourselves; the
+                    # emergency save + SystemExit(75) fires at the next
+                    # step entry.
+                    os.kill(os.getpid(), signal.SIGTERM)
+            step.drain_nan_guard()
+    except atx.NonFiniteGuardError as e:
+        g = step._nan_guard or {}
+        print(
+            f"[elastic_train] NAN_GUARD_ABORT streak={g.get('streak')} "
+            f"skipped_total={g.get('skipped_total')}",
+            flush=True,
+        )
+        print(f"[elastic_train] {e}", flush=True)
+        sys.exit(NAN_GUARD_ABORT_EXIT)
+    if args.final_save:
+        acc.save_state(None, state)
+    if step._nan_guard is not None:
+        print(
+            f"[elastic_train] NAN_GUARD_STATS "
+            f"skipped_total={step._nan_guard['skipped_total']}",
+            flush=True,
+        )
+    acc.end_training()
+    print("[elastic_train] DONE", flush=True)
+
+
+main()

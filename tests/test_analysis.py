@@ -708,6 +708,39 @@ class TestMultihostRules:
         report = analysis.lint_host_loop(loop, processes=2)
         assert not report.findings, [f.format() for f in report.findings]
 
+    def test_atx503_seeded_mixed_async_sync_save(self):
+        """One process saving async while its peer saves synchronously is a
+        real save-path divergence: the sync process barriers with
+        ``wait_for_everyone`` (collectives) while the async process goes
+        through the collective-free precommit file barrier — schedules split
+        at the commit barrier, which the replay must classify as ATX503."""
+        import tempfile
+
+        from accelerate_tpu import checkpointing
+        from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+        def loop():
+            AcceleratorState._reset_state()
+            root = tempfile.mkdtemp(prefix="atx_lint_async_div_")
+            acc = atx.Accelerator(
+                seed=0,
+                project_config=ProjectConfiguration(
+                    project_dir=root, automatic_checkpoint_naming=True
+                ),
+            )
+            state = acc.prepare_train_state(
+                atx.TrainState.create(
+                    params={"w": jnp.zeros((8, 8))}, tx=optax.sgd(1e-2)
+                )
+            )
+            checkpointing.save_state(
+                acc, None, state, async_save=(jax.process_index() == 1)
+            )
+            checkpointing.wait_for_checkpoint()
+
+        report = analysis.lint_host_loop(loop, processes=2)
+        assert error_ids(report) == ["ATX503"]
+
     # -- ATX504: per-process RNG into a replicated collective ------------
     def test_atx504_seeded_folded_key(self):
         def loop():

@@ -1,0 +1,894 @@
+"""Elastic-resume tests (docs/fault_tolerance.md, "Elastic resume &
+resharding restore" / "Peer health" / NaN-guard knobs in docs/api.md).
+
+Four layers of proof:
+
+- **reshard-on-restore**: a checkpoint saved under an 8-device FSDP mesh
+  restores bit-identically (params, Adam moments, step) onto 4- and
+  2-device meshes; metadata v2 records the save-time topology; legacy
+  pre-metadata checkpoints still load permissively; a checkpoint missing a
+  shard at the OLD topology warns (`CheckpointIntegrityWarning`) and falls
+  back to the previous committed checkpoint instead of resuming on a
+  partial reshard;
+- **peer shard fetch**: a per-node checkpoint whose peer's shard files
+  only exist in the replicate store is reassembled by fetching them
+  (hash-verified against the peer's remote manifest); kill -9 mid-fetch
+  leaves the committed checkpoint untouched and the retry completes;
+- **peer health + NaN guard**: deterministic `PeerHealthMonitor.tick`
+  protocol tests with an injected clock (stale detection with the
+  straggler's last-known step, recovery, startup grace, hard abort), and
+  the opt-in ``ATX_NAN_GUARD`` non-finite guard (pure `lax.cond` skip, no
+  moment advance, streak abort after ``ATX_NAN_GUARD_MAX_CONSECUTIVE``);
+- **subprocess acceptance**: train under an 8-device mesh, SIGTERM →
+  emergency save + exit 75, resume under a 4-device mesh via
+  ``resume="latest"`` with a loss trajectory matching a never-interrupted
+  4-device run; remote-only elastic restore (local root deleted); the NaN
+  guard skipping an injected bad batch and aborting past its budget.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+pytestmark = pytest.mark.heavy  # compile-heavy / subprocess lane
+
+import accelerate_tpu as atx
+from accelerate_tpu import checkpointing, resilience
+from accelerate_tpu.commands import launch as launch_mod
+from accelerate_tpu.parallel import MeshConfig
+from accelerate_tpu.resilience import commit as commit_mod
+from accelerate_tpu.resilience import replicate
+from accelerate_tpu.resilience.commit import (
+    CheckpointIntegrityWarning,
+    CheckpointShardCoverageError,
+)
+from accelerate_tpu.resilience.health import (
+    PeerHealthMonitor,
+    _FileBackend,
+    health_from_env,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils import faults
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+from accelerate_tpu.utils.environment import patch_environment
+
+from tests.launch_helpers import REPO_ROOT, clean_env
+
+SCRIPTS = os.path.join(REPO_ROOT, "tests", "scripts")
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    yield
+    resilience.clear_preemption()
+    faults._reset_counters()
+
+
+# ----------------------------------------------------------- shared fixtures
+def _fsdp_acc(root, n_devices):
+    """FSDP Accelerator over the first ``n_devices`` simulated devices — the
+    in-process analog of the pod coming back at a smaller size."""
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return atx.Accelerator(
+        mesh_config=MeshConfig(
+            data=1, fsdp=n_devices, devices=jax.devices()[:n_devices]
+        ),
+        strategy="FSDP",
+        project_config=ProjectConfiguration(
+            project_dir=str(root), automatic_checkpoint_naming=True
+        ),
+        seed=0,
+    )
+
+
+def _init_fn(rng):
+    # 64x64 > FSDPConfig.min_weight_size, so ``w`` is genuinely sharded over
+    # the fsdp axis — the reshard tests must move real shard boundaries.
+    return {
+        "w": jax.random.normal(rng, (64, 64), jnp.float32) * 0.1,
+        "b": jnp.zeros((64,), jnp.float32),
+    }
+
+
+def _loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _batch(i=0, poison=False):
+    rng = np.random.default_rng(1234 + i)
+    x = rng.normal(size=(16, 64)).astype(np.float32)
+    if poison:
+        x[0, 0] = np.nan
+    return {
+        "x": jnp.asarray(x),
+        "y": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+    }
+
+
+def _train(acc, steps=3):
+    state = acc.create_train_state(_init_fn, optax.adam(1e-2))
+    step = acc.make_train_step(_loss_fn)
+    for i in range(steps):
+        state, _ = step(state, _batch(i))
+    return state
+
+
+def _snap(state):
+    return jax.device_get(
+        {"params": state.params, "opt": state.opt_state, "step": state.step}
+    )
+
+
+def _assert_tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ======================================================== reshard-on-restore
+class TestReshardRestore:
+    def test_reshard_8_to_4_to_2_bit_identical(self, tmp_path):
+        """Save under fsdp=8; restore under fsdp=4 and fsdp=2. Params, BOTH
+        Adam moments, and the step counter come back bit-identical, laid out
+        on the smaller mesh."""
+        acc8 = _fsdp_acc(tmp_path, 8)
+        state = _train(acc8, steps=3)
+        acc8.save_state(None, state)
+        ref = _snap(state)
+        # Adam state: mu + nu + count — the moments are real arrays, so a
+        # reshard that dropped them could not pass the equality below.
+        assert len(jax.tree.leaves(ref["opt"])) >= 5
+
+        for n in (4, 2):
+            acc = _fsdp_acc(tmp_path, n)
+            restored = acc.load_state(
+                None, acc.create_train_state(_init_fn, optax.adam(1e-2)),
+                resume="latest",
+            )
+            _assert_tree_equal(ref, _snap(restored))
+            devices_used = {
+                d
+                for leaf in jax.tree.leaves(restored.params)
+                for d in leaf.sharding.device_set
+            }
+            assert len(devices_used) == n  # actually re-laid, not replicated
+
+    def test_metadata_records_save_topology(self, tmp_path):
+        acc8 = _fsdp_acc(tmp_path, 8)
+        state = _train(acc8, steps=1)
+        acc8.save_state(None, state)
+        ckpt = commit_mod.latest_committed(str(tmp_path / "checkpoints"))
+        sig = checkpointing.saved_topology(ckpt)
+        assert sig["num_devices"] == 8
+        assert sig["mesh"]["fsdp"] == 8
+        # And the index records each leaf's GLOBAL shape + sharding spec.
+        with open(os.path.join(ckpt, "train_state", "index_0.json")) as f:
+            index = json.load(f)
+        entry = index["params/w"]
+        assert tuple(entry["shape"]) == (64, 64)
+        assert entry["spec"] and "fsdp" in str(entry["spec"])  # really sharded
+        assert len(entry["shards"]) == 8  # one per fsdp slice
+
+    def test_legacy_pre_metadata_checkpoint_loads_permissively(self, tmp_path):
+        """A checkpoint stripped of every topology record (pre-metadata era)
+        still restores — even under a different device count, because the
+        per-leaf shard table is self-describing."""
+        acc8 = _fsdp_acc(tmp_path, 8)
+        state = _train(acc8, steps=2)
+        acc8.save_state(None, state)
+        ref = _snap(state)
+        ckpt = commit_mod.latest_committed(str(tmp_path / "checkpoints"))
+
+        # Strip metadata.json + every topology key from the COMMIT marker,
+        # keeping the manifests consistent (legacy dirs predate metadata).
+        man_path = os.path.join(ckpt, commit_mod.MANIFEST_FILE.format(proc=0))
+        with open(man_path) as f:
+            manifest = json.load(f)
+        files = [r for r in manifest["files"] if r != checkpointing.METADATA_FILE]
+        os.remove(os.path.join(ckpt, checkpointing.METADATA_FILE))
+        commit_mod.write_manifest(ckpt, 0, files, step=manifest.get("step"))
+        commit_mod.write_aggregate_manifest(ckpt)
+        marker = commit_mod.read_commit_marker(ckpt)
+        legacy = {
+            k: v
+            for k, v in marker.items()
+            if k not in ("mesh", "num_processes", "num_devices")
+        }
+        with open(os.path.join(ckpt, commit_mod.COMMIT_MARKER), "w") as f:
+            json.dump(legacy, f)
+        assert checkpointing.saved_topology(ckpt) is None
+        assert commit_mod.verify_checkpoint(ckpt) == []
+
+        acc4 = _fsdp_acc(tmp_path, 4)
+        restored = acc4.load_state(
+            None, acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+            resume="latest",
+        )
+        _assert_tree_equal(ref, _snap(restored))
+
+    def _amputate_leaf_shards(self, ckpt, key="params/w"):
+        """Drop the second half of ``key``'s shard entries from the index
+        (manifests rewritten so the files still verify) — the
+        missing-peer-shard-at-old-topology failure, minus the peer."""
+        idx_path = os.path.join(ckpt, "train_state", "index_0.json")
+        with open(idx_path) as f:
+            index = json.load(f)
+        shards = sorted(index[key]["shards"], key=lambda sh: sh["starts"])
+        assert len(shards) > 1, "leaf is not sharded; nothing to amputate"
+        index[key]["shards"] = shards[: len(shards) // 2]
+        with open(idx_path, "w") as f:
+            json.dump(index, f)
+        man_path = os.path.join(ckpt, commit_mod.MANIFEST_FILE.format(proc=0))
+        with open(man_path) as f:
+            manifest = json.load(f)
+        commit_mod.write_manifest(
+            ckpt, 0, list(manifest["files"]), step=manifest.get("step")
+        )
+        commit_mod.write_aggregate_manifest(ckpt)
+        assert commit_mod.verify_checkpoint(ckpt) == []
+
+    def test_missing_shard_falls_back_to_previous_committed(self, tmp_path):
+        """resume="latest" with the newest checkpoint unable to cover a leaf
+        warns and resumes from the previous committed checkpoint — never a
+        silent partial reshard."""
+        acc8 = _fsdp_acc(tmp_path, 8)
+        state = acc8.create_train_state(_init_fn, optax.adam(1e-2))
+        step = acc8.make_train_step(_loss_fn)
+        state, _ = step(state, _batch(0))
+        acc8.save_state(None, state)  # checkpoint_0: good
+        good = _snap(state)
+        state, _ = step(state, _batch(1))
+        acc8.save_state(None, state)  # checkpoint_1: about to lose a shard
+        root = str(tmp_path / "checkpoints")
+        self._amputate_leaf_shards(os.path.join(root, "checkpoint_1"))
+
+        acc4 = _fsdp_acc(tmp_path, 4)
+        with pytest.warns(
+            CheckpointIntegrityWarning, match="cannot be fully assembled"
+        ):
+            restored = acc4.load_state(
+                None, acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+                resume="latest",
+            )
+        _assert_tree_equal(good, _snap(restored))
+
+    def test_explicit_dir_coverage_error_names_both_topologies(self, tmp_path):
+        """Naming the amputated checkpoint directly raises — with both the
+        saved and current topologies and the available fixes in the error."""
+        acc8 = _fsdp_acc(tmp_path, 8)
+        state = _train(acc8, steps=1)
+        acc8.save_state(None, state)
+        ckpt = commit_mod.latest_committed(str(tmp_path / "checkpoints"))
+        self._amputate_leaf_shards(ckpt)
+
+        acc4 = _fsdp_acc(tmp_path, 4)
+        with pytest.raises(
+            CheckpointShardCoverageError, match="saved under.*8 device"
+        ):
+            acc4.load_state(
+                ckpt, acc4.create_train_state(_init_fn, optax.adam(1e-2))
+            )
+
+
+# ===================================================== peer-shard fetch path
+def _split_into_two_proc_checkpoint(root, store_dir):
+    """Turn a single-process FSDP-8 checkpoint into a per-node TWO-process
+    layout: the second half of ``params/w``'s shards become "process 1"'s
+    shard files, which exist ONLY in the replicate store (under
+    ``node_1/<name>/``) — exactly what a ``save_on_each_node`` pod leaves
+    behind after losing a node. Returns ``(checkpoint_dir, ref_snapshot)``."""
+    acc8 = _fsdp_acc(root, 8)
+    state = _train(acc8, steps=3)
+    acc8.save_state(None, state)
+    ref = _snap(state)
+    ckpt = commit_mod.latest_committed(os.path.join(str(root), "checkpoints"))
+    ts = os.path.join(ckpt, "train_state")
+
+    idx0_path = os.path.join(ts, "index_0.json")
+    with open(idx0_path) as f:
+        idx0 = json.load(f)
+    entry = idx0["params/w"]
+    shards = sorted(entry["shards"], key=lambda sh: sh["starts"])
+    moved = shards[len(shards) // 2 :]
+    entry["shards"] = shards[: len(shards) // 2]
+    assert moved and entry["shards"]
+    idx1 = {"params/w": {**{k: v for k, v in entry.items()}, "shards": moved}}
+    with open(idx0_path, "w") as f:
+        json.dump(idx0, f)
+    idx1_path = os.path.join(ts, "index_1.json")
+    with open(idx1_path, "w") as f:
+        json.dump(idx1, f)
+
+    shards0_path = os.path.join(ts, "shards_0.npz")
+    data = dict(np.load(shards0_path))
+    shards1 = {}
+    for sh in moved:
+        skey = "params/w|" + ",".join(map(str, sh["starts"]))
+        shards1[skey] = data.pop(skey)
+    np.savez(shards0_path, **data)
+    shards1_path = os.path.join(ts, "shards_1.npz")
+    np.savez(shards1_path, **shards1)
+
+    man_path = os.path.join(ckpt, commit_mod.MANIFEST_FILE.format(proc=0))
+    with open(man_path) as f:
+        manifest = json.load(f)
+    step_n = manifest.get("step")
+    commit_mod.write_manifest(ckpt, 0, list(manifest["files"]), step=step_n)
+    rels1 = ["train_state/index_1.json", "train_state/shards_1.npz"]
+    commit_mod.write_manifest(ckpt, 1, rels1, step=step_n)
+    commit_mod.write_aggregate_manifest(ckpt)
+    marker = commit_mod.read_commit_marker(ckpt)
+    marker["num_processes"] = 2
+    marker["save_on_each_node"] = True
+    with open(os.path.join(ckpt, commit_mod.COMMIT_MARKER), "w") as f:
+        json.dump(marker, f)
+
+    # Process 1's files move to the store; locally only the aggregate
+    # remembers them (the per-node layout verify_checkpoint accepts).
+    store = replicate.LocalObjectStore(str(store_dir))
+    name = os.path.basename(ckpt)
+    man1_path = os.path.join(ckpt, commit_mod.MANIFEST_FILE.format(proc=1))
+    store.put_file(idx1_path, f"node_1/{name}/{rels1[0]}")
+    store.put_file(shards1_path, f"node_1/{name}/{rels1[1]}")
+    store.put_file(
+        man1_path, f"node_1/{name}/{commit_mod.MANIFEST_FILE.format(proc=1)}"
+    )
+    for path in (idx1_path, shards1_path, man1_path):
+        os.remove(path)
+    assert commit_mod.verify_checkpoint(ckpt) == []
+    return ckpt, ref
+
+
+class TestPeerShardFetch:
+    def test_missing_peer_shards_fetched_from_store(self, tmp_path):
+        ckpt, ref = _split_into_two_proc_checkpoint(
+            tmp_path / "proj", tmp_path / "store"
+        )
+        with patch_environment(ATX_REPLICATE_URL=str(tmp_path / "store")):
+            acc4 = _fsdp_acc(tmp_path / "proj", 4)
+            restored = acc4.load_state(
+                None, acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+                resume="latest",
+            )
+        _assert_tree_equal(ref, _snap(restored))
+        # The fetched peer files landed (atomically) in the checkpoint dir.
+        assert os.path.exists(os.path.join(ckpt, "train_state", "shards_1.npz"))
+
+    def test_corrupt_peer_fetch_rejected_by_remote_manifest(self, tmp_path):
+        """A store serving bytes that do not match the peer's remote manifest
+        must not land in the checkpoint — the restore fails loudly instead of
+        assembling corrupt rows."""
+        _split_into_two_proc_checkpoint(tmp_path / "proj", tmp_path / "store")
+        store = replicate.LocalObjectStore(str(tmp_path / "store"))
+        key = next(k for k in store.list() if k.endswith("shards_1.npz"))
+        store.put_bytes(b"garbage bytes", key)
+        with patch_environment(ATX_REPLICATE_URL=str(tmp_path / "store")):
+            acc4 = _fsdp_acc(tmp_path / "proj", 4)
+            with pytest.raises(ValueError):
+                with pytest.warns(CheckpointIntegrityWarning):
+                    acc4.load_state(
+                        None,
+                        acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+                        resume="latest",
+                    )
+
+    def test_no_store_fails_instead_of_partial_reshard(self, tmp_path):
+        ckpt, _ = _split_into_two_proc_checkpoint(
+            tmp_path / "proj", tmp_path / "store"
+        )
+        acc4 = _fsdp_acc(tmp_path / "proj", 4)  # no ATX_REPLICATE_URL
+        with pytest.raises(ValueError, match="failed integrity verification"):
+            with pytest.warns(
+                CheckpointIntegrityWarning, match="cannot be fully assembled"
+            ):
+                acc4.load_state(
+                    None, acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+                    resume="latest",
+                )
+
+    _RESTORE_RUNNER = """\
+import sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp, optax
+import accelerate_tpu as atx
+from accelerate_tpu.parallel import MeshConfig
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+acc = atx.Accelerator(
+    mesh_config=MeshConfig(data=1, fsdp=len(jax.devices())),
+    strategy="FSDP",
+    project_config=ProjectConfiguration(
+        project_dir={root!r}, automatic_checkpoint_naming=True
+    ),
+    seed=0,
+)
+
+def init_fn(rng):
+    return {{
+        "w": jax.random.normal(rng, (64, 64), jnp.float32) * 0.1,
+        "b": jnp.zeros((64,), jnp.float32),
+    }}
+
+state = acc.create_train_state(init_fn, optax.adam(1e-2))
+state = acc.load_state(None, state, resume="latest")
+print("RESTORED", int(jax.device_get(state.step)), flush=True)
+"""
+
+    def test_kill9_mid_peer_fetch_leaves_checkpoint_untouched(self, tmp_path):
+        """kill -9 (exit 137) at ``restore.peer_shard_fetched`` — after the
+        first peer file downloaded, before anything is renamed in. The
+        committed checkpoint still verifies clean, and the retry (fresh
+        process, no fault) completes the fetch and restores."""
+        proj = tmp_path / "proj"
+        ckpt, _ = _split_into_two_proc_checkpoint(proj, tmp_path / "store")
+        script = tmp_path / "restore_runner.py"
+        script.write_text(
+            self._RESTORE_RUNNER.format(repo=REPO_ROOT, root=str(proj))
+        )
+        env = clean_env(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "ATX_REPLICATE_URL": str(tmp_path / "store"),
+            }
+        )
+        killed = subprocess.run(
+            [sys.executable, str(script)],
+            cwd=REPO_ROOT,
+            env={**env, "ATX_FAULT_KILL_AT": "restore.peer_shard_fetched"},
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
+        assert killed.returncode == faults.KILL_EXIT_CODE, killed.stderr
+        # Nothing landed in the committed directory: no peer shards, and the
+        # checkpoint verifies exactly as before the attempt.
+        ts = os.path.join(ckpt, "train_state")
+        assert not os.path.exists(os.path.join(ts, "shards_1.npz"))
+        assert not os.path.exists(os.path.join(ts, "index_1.json"))
+        assert commit_mod.verify_checkpoint(ckpt) == []
+
+        retry = subprocess.run(
+            [sys.executable, str(script)],
+            cwd=REPO_ROOT,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
+        assert retry.returncode == 0, retry.stderr
+        assert "RESTORED 3" in retry.stdout, retry.stdout
+
+
+# ============================================================== peer health
+class _Recorder:
+    def __init__(self):
+        self.escalations = 0
+        self.aborted_with = None
+
+    def escalate(self):
+        self.escalations += 1
+
+    def abort(self, code):
+        self.aborted_with = code
+
+
+class TestPeerHealthMonitor:
+    def _pair(self, tmp_path, **kw):
+        backend = _FileBackend(str(tmp_path / "health"))
+        clock = {"now": 0.0}
+        rec = _Recorder()
+        mk = lambda proc: PeerHealthMonitor(  # noqa: E731
+            proc,
+            2,
+            backend,
+            beat_secs=1.0,
+            stale_secs=kw.get("stale_secs", 3.0),
+            exit_after_secs=kw.get("exit_after_secs", 6.0),
+            escalate=rec.escalate,
+            abort=rec.abort,
+            clock=lambda: clock["now"],
+        )
+        return mk(0), mk(1), clock, rec
+
+    def test_stale_peer_flagged_escalates_with_last_step(self, tmp_path, caplog):
+        m0, m1, clock, rec = self._pair(tmp_path)
+        m0.note_step(41)
+        m0.tick()
+        m1.tick()  # observes peer 0 (seq 1, step 41) at t=0
+        clock["now"] = 1.0
+        m0.note_step(42)
+        m0.tick()
+        m1.tick()  # seq advanced -> fresh timestamp, step 42
+        # Peer 0 dies. Silence within stale_secs: not flagged.
+        clock["now"] = 3.5
+        with caplog.at_level("WARNING", logger="accelerate_tpu.resilience.health"):
+            m1.tick()
+            assert m1.stale_peers == set() and rec.escalations == 0
+            # Past stale_secs: flagged ONCE, escalated, last step in the log.
+            clock["now"] = 5.0
+            m1.tick()
+            m1.tick()
+        assert m1.stale_peers == {0}
+        assert rec.escalations == 1  # no repeat escalation
+        assert "last-known step 42" in caplog.text
+
+    def test_startup_grace_never_seen_peer_ignored(self, tmp_path):
+        _, m1, clock, rec = self._pair(tmp_path)
+        for t in (0.0, 10.0, 100.0):
+            clock["now"] = t
+            m1.tick()  # peer 0 never wrote a beat: a smaller restarted group
+        assert m1.stale_peers == set() and rec.escalations == 0
+
+    def test_recovered_peer_unflagged(self, tmp_path, caplog):
+        m0, m1, clock, rec = self._pair(tmp_path)
+        m0.tick()
+        m1.tick()
+        clock["now"] = 5.0
+        m1.tick()
+        assert m1.stale_peers == {0}
+        m0.tick()  # the straggler comes back
+        with caplog.at_level("WARNING", logger="accelerate_tpu.resilience.health"):
+            clock["now"] = 5.5
+            m1.tick()
+        assert m1.stale_peers == set()
+        assert "recovered" in caplog.text
+        assert rec.escalations == 1
+
+    def test_hard_abort_when_step_boundary_never_comes(self, tmp_path):
+        m0, m1, clock, rec = self._pair(tmp_path, stale_secs=3.0, exit_after_secs=6.0)
+        m0.tick()
+        m1.tick()
+        clock["now"] = 5.0
+        m1.tick()  # flagged + escalated
+        assert rec.aborted_with is None
+        clock["now"] = 8.0
+        m1.tick()  # still within stale+exit grace
+        assert rec.aborted_with is None
+        clock["now"] = 10.0
+        m1.tick()  # silence > stale_secs + exit_after_secs
+        assert rec.aborted_with == resilience.PREEMPTION_EXIT_CODE
+
+    def test_health_from_env_gating(self, tmp_path):
+        assert health_from_env(root=str(tmp_path)) is None  # opt-in
+        with patch_environment(
+            ATX_HEALTH_BEAT_SECS="2.5",
+            ATX_HEALTH_STALE_SECS="7",
+            ATX_HEALTH_PEERS="4",
+        ):
+            mon = health_from_env(root=str(tmp_path), process_index=1)
+            assert mon.beat_secs == 2.5
+            assert mon.stale_secs == 7.0
+            assert mon.num_processes == 4
+            assert isinstance(mon.backend, _FileBackend)
+            assert mon.backend.directory == os.path.join(str(tmp_path), ".health")
+        with patch_environment(
+            ATX_HEALTH_BEAT_SECS="1", ATX_HEALTH_DIR=str(tmp_path / "hb")
+        ):
+            mon = health_from_env(root=None)
+            assert mon.backend.directory == str(tmp_path / "hb")
+        # No beat surface at all: disabled with a warning, never raising.
+        with patch_environment(ATX_HEALTH_BEAT_SECS="1"):
+            assert health_from_env(root=None) is None
+
+    def test_accelerator_wires_monitor(self, tmp_path):
+        hb = tmp_path / "hb"
+        with patch_environment(
+            ATX_HEALTH_BEAT_SECS="0.05", ATX_HEALTH_DIR=str(hb)
+        ):
+            AcceleratorState._reset_state()
+            acc = atx.Accelerator(seed=0)
+            assert acc._health is not None
+            acc._health._thread.join(0.5)  # let a few beats land
+            acc.end_training()
+        payload = json.loads((hb / "beat_0.json").read_text())
+        assert payload["process"] == 0 and payload["seq"] >= 1
+        assert acc._health._thread is None  # stopped
+
+
+# ================================================================ NaN guard
+class TestNanGuard:
+    def _acc(self):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        return atx.Accelerator(seed=0)
+
+    def test_off_by_default(self):
+        acc = self._acc()
+        state = acc.create_train_state(_init_fn, optax.adam(1e-2))
+        step = acc.make_train_step(_loss_fn)
+        _, metrics = step(state, _batch(0))
+        assert "nonfinite_skipped" not in metrics
+        assert step._nan_guard is None
+        step.drain_nan_guard()  # no-op, never raises
+
+    def test_skip_preserves_state_and_streak_resets(self):
+        with patch_environment(
+            ATX_NAN_GUARD="1", ATX_NAN_GUARD_MAX_CONSECUTIVE="3"
+        ):
+            acc = self._acc()
+            state = acc.create_train_state(_init_fn, optax.adam(1e-2))
+            step = acc.make_train_step(_loss_fn)
+            state, m = step(state, _batch(0))
+            step.drain_nan_guard()
+            assert int(jax.device_get(m["nonfinite_skipped"])) == 0
+            before = _snap(state)
+
+            state2, m2 = step(state, _batch(1, poison=True))
+            step.drain_nan_guard()
+            assert int(jax.device_get(m2["nonfinite_skipped"])) == 1
+            after = _snap(state2)
+            # The lax.cond skip: params AND moments bit-unchanged; the step
+            # counter still advances (data order stays reproducible).
+            _assert_tree_equal(before["params"], after["params"])
+            _assert_tree_equal(before["opt"], after["opt"])
+            assert int(after["step"]) == int(before["step"]) + 1
+            assert step._nan_guard["streak"] == 1
+
+            state3, _ = step(state2, _batch(2))
+            step.drain_nan_guard()
+            assert step._nan_guard["streak"] == 0  # a finite step resets it
+            assert step._nan_guard["skipped_total"] == 1
+
+    def test_streak_abort_after_budget(self):
+        with patch_environment(
+            ATX_NAN_GUARD="1", ATX_NAN_GUARD_MAX_CONSECUTIVE="3"
+        ):
+            acc = self._acc()
+            state = acc.create_train_state(_init_fn, optax.adam(1e-2))
+            step = acc.make_train_step(_loss_fn)
+            with pytest.raises(atx.NonFiniteGuardError, match="3 consecutive"):
+                for i in range(10):
+                    state, _ = step(state, _batch(i, poison=True))
+                step.drain_nan_guard()
+            assert step._nan_guard["skipped_total"] == 3
+
+
+# ===================================================== elastic launch plumbing
+class TestElasticDevicesFile:
+    def test_apply_elastic_devices_file(self, tmp_path, capsys):
+        import argparse
+
+        path = tmp_path / "devices"
+        args = argparse.Namespace(
+            elastic_devices_file=str(path), host_devices=8
+        )
+        launch_mod._apply_elastic_devices(args)  # missing file: keep value
+        assert args.host_devices == 8
+        path.write_text("4\n")
+        launch_mod._apply_elastic_devices(args)
+        assert args.host_devices == 4
+        path.write_text("not-a-number")  # torn write: keep previous value
+        launch_mod._apply_elastic_devices(args)
+        assert args.host_devices == 4
+        path.write_text("0")  # nonsense size: ignored
+        launch_mod._apply_elastic_devices(args)
+        assert args.host_devices == 4
+        args_no_file = argparse.Namespace(host_devices=8)
+        launch_mod._apply_elastic_devices(args_no_file)  # flag unused: no-op
+        assert args_no_file.host_devices == 8
+
+    def test_launch_cli_accepts_flag(self):
+        import argparse
+
+        parser = argparse.ArgumentParser()
+        launch_mod.register(parser.add_subparsers())
+        args = parser.parse_args(
+            ["launch", "--elastic_devices_file", "/tmp/devs", "script.py"]
+        )
+        assert args.elastic_devices_file == "/tmp/devs"
+
+
+# ===================================================== collective-log shipping
+class TestCollectiveLogShipping:
+    def test_ship_and_fetch_roundtrip(self, tmp_path):
+        from accelerate_tpu.analysis import collective_log
+        from accelerate_tpu.ops import collectives as C
+
+        store = replicate.LocalObjectStore(str(tmp_path / "store"))
+        with patch_environment(
+            ATX_COLLECTIVE_LOG="1",
+            ATX_COLLECTIVE_LOG_DIR=str(tmp_path / "logs"),
+            ATX_COLLECTIVE_LOG_PROC="0",
+        ):
+            C.reduce({"x": np.ones((2,), np.float32)})
+            key = collective_log.ship_log(store, process_index=0)
+        assert key == "collective_logs/collective_log_0.jsonl"
+        assert store.exists(key)
+        # A process that never logged ships nothing.
+        assert collective_log.ship_log(store, process_index=9) is None
+
+        fetched_dir = tmp_path / "fetched"
+        fetched = collective_log.fetch_logs(store, str(fetched_dir))
+        assert len(fetched) == 1
+        logs = collective_log.read_logs(str(fetched_dir))
+        assert [e["kind"] for e in logs[0]] == ["reduce"]
+
+    def test_end_training_ships_log_when_store_armed(self, tmp_path):
+        with patch_environment(
+            ATX_COLLECTIVE_LOG="1",
+            ATX_COLLECTIVE_LOG_DIR=str(tmp_path / "logs"),
+            ATX_REPLICATE_URL=str(tmp_path / "store"),
+        ):
+            AcceleratorState._reset_state()
+            acc = atx.Accelerator(seed=0)
+            acc.wait_for_everyone()  # one logged collective
+            acc.end_training()
+        store = replicate.LocalObjectStore(str(tmp_path / "store"))
+        assert store.exists("collective_logs/collective_log_0.jsonl")
+
+    def test_end_training_no_ship_without_flag(self, tmp_path):
+        with patch_environment(
+            ATX_COLLECTIVE_LOG_DIR=str(tmp_path / "logs"),
+            ATX_REPLICATE_URL=str(tmp_path / "store"),
+        ):
+            AcceleratorState._reset_state()
+            acc = atx.Accelerator(seed=0)
+            acc.wait_for_everyone()
+            acc.end_training()
+        store = replicate.LocalObjectStore(str(tmp_path / "store"))
+        assert store.list("collective_logs/") == []
+
+
+# ========================================================= subprocess proof
+def _run_driver(*argv, devices, env_extra=None, timeout=300):
+    env = clean_env(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}",
+        }
+    )
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "elastic_train.py"), *argv],
+        cwd=REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def _losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            step, loss = line.split()
+            out[int(step)] = float.fromhex(loss)
+    return out
+
+
+class TestElasticAcceptance:
+    def test_preempt_8dev_resume_4dev_matches_reference(self, tmp_path):
+        """The headline acceptance: train under an 8-device FSDP mesh,
+        SIGTERM mid-run → emergency save + exit 75, resume the SAME job
+        under a 4-device mesh via ``resume="latest"``. The stitched loss
+        trajectory equals a never-interrupted 4-device run's (with data=1
+        the math is identical at any FSDP width; the reshard must keep it
+        so)."""
+        ref_file = str(tmp_path / "ref_losses.txt")
+        r = _run_driver(
+            "--project_dir", str(tmp_path / "proj_ref"), "--steps", "8",
+            "--loss_file", ref_file,
+            devices=4,
+        )
+        assert r.returncode == 0, r.stderr
+        ref = _losses(ref_file)
+        assert sorted(ref) == list(range(8))
+
+        proj = str(tmp_path / "proj")
+        loss_file = str(tmp_path / "losses.txt")
+        r = _run_driver(
+            "--project_dir", proj, "--steps", "8", "--preempt_at", "2",
+            "--loss_file", loss_file,
+            devices=8,
+        )
+        assert r.returncode == resilience.PREEMPTION_EXIT_CODE, (
+            r.returncode,
+            r.stderr,
+        )
+        assert "emergency checkpoint committed" in r.stderr
+        assert commit_mod.latest_committed(os.path.join(proj, "checkpoints"))
+
+        r = _run_driver(
+            "--project_dir", proj, "--steps", "8", "--resume", "--final_save",
+            "--loss_file", loss_file,
+            devices=4,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "resumed at step 3" in r.stdout, r.stdout
+        assert "mesh fsdp=4" in r.stdout
+        got = _losses(loss_file)
+        assert sorted(got) == list(range(8))
+        # Sharded-matmul reduction order differs per mesh width, so the two
+        # trajectories agree to float32 round-off, not bit-for-bit.
+        for step in range(8):
+            assert got[step] == pytest.approx(ref[step], rel=1e-4), (
+                step,
+                got[step],
+                ref[step],
+            )
+
+    def test_remote_only_elastic_restore(self, tmp_path):
+        """Local checkpoints root deleted entirely; ``resume="latest"``
+        restores the 8-device checkpoint from the replicate store onto a
+        2-device mesh and the remaining trajectory matches the reference."""
+        store = str(tmp_path / "remote")
+        ref_file = str(tmp_path / "ref_losses.txt")
+        r = _run_driver(
+            "--project_dir", str(tmp_path / "proj_ref"), "--steps", "6",
+            "--loss_file", ref_file,
+            devices=2,
+        )
+        assert r.returncode == 0, r.stderr
+        ref = _losses(ref_file)
+
+        proj = str(tmp_path / "proj")
+        loss_file = str(tmp_path / "losses.txt")
+        r = _run_driver(
+            "--project_dir", proj, "--steps", "4", "--final_save",
+            "--loss_file", loss_file,
+            devices=8,
+            env_extra={"ATX_REPLICATE_URL": store},
+        )
+        assert r.returncode == 0, r.stderr
+        shutil.rmtree(os.path.join(proj, "checkpoints"))
+
+        r = _run_driver(
+            "--project_dir", proj, "--steps", "6", "--resume",
+            "--loss_file", loss_file,
+            devices=2,
+            env_extra={"ATX_REPLICATE_URL": store},
+        )
+        assert r.returncode == 0, r.stderr
+        assert "resumed at step 4" in r.stdout, r.stdout
+        got = _losses(loss_file)
+        for step in (4, 5):
+            assert got[step] == pytest.approx(ref[step], rel=1e-4), (
+                step,
+                got[step],
+                ref[step],
+            )
+
+    def test_nan_guard_aborts_past_budget(self, tmp_path):
+        r = _run_driver(
+            "--project_dir", str(tmp_path / "proj"), "--steps", "6",
+            "--loss_file", str(tmp_path / "losses.txt"), "--poison",
+            devices=4,
+            env_extra={
+                "ATX_NAN_GUARD": "1",
+                "ATX_NAN_GUARD_MAX_CONSECUTIVE": "2",
+                "ATX_FAULT_NAN_AT": "train.batch",
+            },
+        )
+        assert r.returncode == 42, (r.returncode, r.stdout, r.stderr)
+        assert "NAN_GUARD_ABORT streak=2" in r.stdout, r.stdout
+        assert "ATX_NAN_GUARD" in r.stdout  # the actionable error text
+
+    def test_nan_guard_skips_isolated_bad_batch(self, tmp_path):
+        loss_file = str(tmp_path / "losses.txt")
+        r = _run_driver(
+            "--project_dir", str(tmp_path / "proj"), "--steps", "6",
+            "--loss_file", loss_file, "--poison",
+            devices=4,
+            env_extra={
+                "ATX_NAN_GUARD": "1",
+                "ATX_FAULT_NAN_AT": "train.batch@3",  # poison only step 2
+            },
+        )
+        assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+        assert "NAN_GUARD_STATS skipped_total=1" in r.stdout, r.stdout
+        got = _losses(loss_file)
+        assert np.isnan(got[2])  # the poisoned step's loss was non-finite
+        assert all(np.isfinite(got[s]) for s in (0, 1, 3, 4, 5))
