@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -98,8 +98,23 @@ smoke-elastic:
 		python -m accelerate_tpu.commands.cli lint elastic_restore --multihost 2 \
 		--severity error
 
+# CPU shrink-in-place lane (docs/fault_tolerance.md, "Shrink/grow in
+# place"): the live-resize acceptance — an 8-rank (simulated) run loses 2
+# peers mid-training, survivors agree and reshard IN PLACE (no relaunch),
+# and post-shrink losses + Adam moments + step match a never-interrupted
+# 6-device reference; grow-back; kill -9 / agreement-timeout mid-shrink
+# degrading to the exit-75 relaunch with the prior commit intact; ranged
+# object-store reads; then the shrink host-loop replay under 2 simulated
+# processes proving escalate -> agree -> reshard -> resume adds NO
+# collectives (error findings fail).
+smoke-shrink:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_shrink.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint shrink --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink
 	python -m pytest tests/ -q --heavy

@@ -55,7 +55,10 @@ from .parallel.mesh import (
     TENSOR_AXIS,
     MeshConfig,
     batch_sharding,
+    build_mesh,
     data_parallel_size,
+    resize_mesh_config,
+    topology_signature,
     use_mesh,
 )
 from .parallel.sharding import (
@@ -166,6 +169,17 @@ class TrainState(struct.PyTreeNode):
             apply_fn=apply_fn,
             tx=tx,
         )
+
+
+def _specs_equal(a: Any, b: Any) -> bool:
+    """Leaf-wise PartitionSpec equality between two spec trees (is_leaf
+    guard because PartitionSpec is tuple-like and would be flattened into
+    its entries otherwise). Used to verify an elastic mesh resize preserves
+    every leaf's layout."""
+    is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+    la = jax.tree_util.tree_flatten(a, is_leaf=is_spec)[0]
+    lb = jax.tree_util.tree_flatten(b, is_leaf=is_spec)[0]
+    return len(la) == len(lb) and all(x == y for x, y in zip(la, lb))
 
 
 def global_norm(tree: Any) -> jax.Array:
@@ -291,6 +305,22 @@ class Accelerator:
         )
         if self._health is not None:
             self._health.start()
+        # Shrink/grow-in-place (resilience/elastic.py): opt-in via
+        # ATX_ELASTIC_SHRINK — on health escalation or a devices-file
+        # retarget, survivors agree on a reduced topology and reshard live
+        # state in memory at the next step entry instead of relaunching.
+        self._elastic = _resilience.elastic_controller_from_env(
+            root=_health_root,
+            store=self._replicator.store if self._replicator is not None else None,
+            health=self._health,
+            process_index=self.process_index,
+            num_processes=self.num_processes,
+            host_devices=jax.local_device_count(),
+            total_devices=self.mesh.devices.size,
+        )
+        self._topology_callbacks: list[Callable] = []
+        self._mesh_epoch = 0
+        self._elastic_timer: tuple[int, str, float] | None = None
         self._preemption_exit_started = False
         self._preemption_sync_calls = 0
         self._flag_tensor: jax.Array | None = None
@@ -1194,12 +1224,22 @@ class Accelerator:
                 # behind a deep dispatch queue.
                 if len(_guard["pending"]) > max(8, 2 * nan_guard_budget):
                     _drain_guard(block=True)
-            if self._health is not None:
+            if self._health is not None or self._elastic is not None:
                 if _host_step["n"] is None:
                     _host_step["n"] = int(jax.device_get(state.step))
                 else:
                     _host_step["n"] += 1
-                self._health.note_step(_host_step["n"])
+                if self._health is not None:
+                    self._health.note_step(_host_step["n"])
+            # Elastic shrink/grow check BEFORE the preemption boundary: a
+            # successful in-place resize clears the health-escalated
+            # preemption flag so the emergency-save + exit-75 machinery
+            # below never fires; a failed one leaves the flag set and the
+            # very next lines take the relaunch path as before.
+            if self._elastic is not None:
+                resized = self._maybe_elastic_resize(state, _host_step["n"])
+                if resized is not None:
+                    state = resized
             # Preemption boundary check at ENTRY, before any compute: the
             # input state is exactly the last completed step's output (whose
             # metrics the caller already has), so the emergency checkpoint
@@ -1227,6 +1267,11 @@ class Accelerator:
             # to this Accelerator's axes.
             with use_mesh(self.mesh):
                 new_state, metrics = jitted(state, batch)
+            if self._elastic_timer is not None:
+                # First step after an in-place resize: block on its output
+                # (once) so the reported escalation -> first-step wall clock
+                # covers real compute, not an async dispatch.
+                self._report_elastic_latency(new_state)
             if nan_guard:
                 _guard["pending"].append(metrics["nonfinite_skipped"])
             return new_state, metrics
@@ -1551,6 +1596,260 @@ class Accelerator:
             logging.getLogger(__name__).warning(
                 "collective-log shipping failed (post-mortem aid only): %s", e
             )
+
+    # ---------------------------------------------------- elastic shrink/grow
+    def on_topology_change(
+        self, callback: Callable[[dict, dict, Any], None]
+    ) -> Callable:
+        """Register ``callback(old_signature, new_signature, decision)`` to
+        fire after an in-place shrink/grow (signatures from
+        `parallel.mesh.topology_signature`). The hook is where user code
+        re-prepares anything pinned to the old world — dataloader sharding,
+        logging of the new topology, LR rescaling for the changed global
+        batch. Exceptions are logged, never raised (the resize already
+        committed). Returns the callback (usable as a decorator)."""
+        self._topology_callbacks.append(callback)
+        return callback
+
+    def _maybe_elastic_resize(
+        self, state: "TrainState", step_hint: int
+    ) -> "TrainState | None":
+        """Step-entry elastic poll: the resized TrainState when the group
+        just shrank/grew in place, None otherwise. Every failure mode —
+        agreement timeout/conflict, unsupported layout, reshard holes —
+        degrades to the existing emergency-save + exit-75 relaunch path by
+        setting the preemption flag and letting `_maybe_emergency_exit`
+        (the very next check in `run_step`) take over."""
+        import sys as _sys
+
+        from . import resilience
+        from .resilience import elastic as _elastic
+
+        try:
+            decision = self._elastic.check(int(step_hint))
+        except _elastic.AgreementError as e:
+            _sys.stderr.write(
+                f"[atx elastic] topology agreement failed ({e}); falling "
+                "back to emergency-save + relaunch\n"
+            )
+            _sys.stderr.flush()
+            resilience.request_preemption()
+            return None
+        if decision is None:
+            return None
+        try:
+            return self._apply_topology_decision(state, decision)
+        except Exception as e:
+            _sys.stderr.write(
+                f"[atx elastic] in-place resize failed before completion "
+                f"({type(e).__name__}: {e}); falling back to emergency-save "
+                "+ relaunch\n"
+            )
+            _sys.stderr.flush()
+            self._elastic.abandon()
+            resilience.request_preemption()
+            return None
+
+    def _apply_topology_decision(
+        self, state: "TrainState", decision: Any
+    ) -> "TrainState":
+        """Execute an agreed resize: snapshot live shards, rebuild the
+        distributed runtime + mesh at the new size, reshard
+        params/opt-state/step in memory, and swing the health/elastic
+        rosters over. Raises on any problem BEFORE mutating accelerator
+        state wherever possible (the `shrink.before_reshard` fault point
+        marks that boundary); the caller maps failures to the relaunch
+        path."""
+        import sys as _sys
+        import time as _time
+
+        from . import checkpointing as _ckpt
+        from . import resilience
+        from .resilience.commit import fault_point
+
+        esc_at = self._elastic.escalated_at
+        t0 = _time.monotonic()
+        if esc_at is None:
+            esc_at = t0
+        old_sig = topology_signature(self.mesh)
+        old_devices = self.mesh.devices.size
+        if getattr(self, "_opt_host_shardings", None) is not None:
+            raise RuntimeError(
+                "host-offloaded optimizer state cannot be resized in place "
+                "yet (its pinned-host shardings are tied to the old mesh)"
+            )
+        fault_point("shrink.before_reshard")
+        # 1. Snapshot every live leaf to host — ALL addressable shards, so
+        #    replica copies cover slices whose replica-0 owner died. This is
+        #    the last read of the old-mesh arrays.
+        template: dict[str, Any] = {
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+        }
+        if state.loss_scale is not None:
+            template["loss_scale"] = state.loss_scale
+        snapshot = _ckpt.InMemoryShardSource.from_tree(template)
+        live_step = int(jax.device_get(state.step))
+        # 2. Real multi-host worlds re-initialize the distributed runtime at
+        #    the reduced size (survivor ranks densify via decision.rank_of).
+        #    Single-process simulated worlds skip this — the mesh rebuild
+        #    below is the whole transition.
+        if (
+            self.process_state.num_processes > 1
+            and decision.num_processes != self.process_state.num_processes
+        ):
+            new_rank = decision.rank_of(self.process_state.process_index)
+            if new_rank is None:
+                raise RuntimeError(
+                    f"rank {self.process_state.process_index} is not in the "
+                    f"agreed survivor set {decision.survivors}"
+                )
+            import os as _os
+
+            from .state import maybe_initialize_jax_distributed
+
+            self.process_state.destroy_process_group()
+            _os.environ["ATX_NUM_PROCESSES"] = str(decision.num_processes)
+            _os.environ["ATX_PROCESS_ID"] = str(new_rank)
+            maybe_initialize_jax_distributed()
+        # 3. Rebuild the mesh with the same parallelism layout at the new
+        #    device count; per-leaf partition specs must come out unchanged
+        #    (a layout flip would need a different jit program — relaunch).
+        want = decision.num_devices
+        devs = list(jax.devices())
+        if len(devs) < want:
+            raise RuntimeError(
+                f"resize wants {want} devices but only {len(devs)} are "
+                "visible"
+            )
+        cfg = resize_mesh_config(self.mesh, want, devices=devs[:want])
+        new_mesh = build_mesh(cfg)
+        old_param_specs = self._param_specs
+        self.state.set_mesh(new_mesh)
+        try:
+            params_shapes = jax.eval_shape(lambda p: p, state.params)
+            self._resolve_specs(params_shapes, state.tx)
+            if old_param_specs is not None and not _specs_equal(
+                old_param_specs, self._param_specs
+            ):
+                raise RuntimeError(
+                    "parameter partition specs differ at the new world size "
+                    "(a leaf stopped dividing evenly); in-place resize would "
+                    "silently change layouts"
+                )
+            shardings = self.state_shardings(state)
+            shard_tree: dict[str, Any] = {
+                "step": shardings.step,
+                "params": shardings.params,
+                "opt_state": shardings.opt_state,
+            }
+            if state.loss_scale is not None:
+                shard_tree["loss_scale"] = shardings.loss_scale
+            # 4. In-memory reshard: live local shards first; the replicate
+            #    store's newest SAME-STEP committed checkpoint only for
+            #    slices nobody alive holds (ranged reads, not whole files).
+            try:
+                restored = _ckpt.reshard_arrays(template, shard_tree, [snapshot])
+            except _ckpt.CheckpointShardCoverageError:
+                store = (
+                    self._replicator.store if self._replicator is not None else None
+                )
+                if store is None:
+                    from .resilience import replicate as _replicate
+
+                    store = _replicate.store_from_env()
+                fallback = (
+                    _ckpt.store_fallback_source(store, live_step)
+                    if store is not None
+                    else None
+                )
+                if fallback is None:
+                    raise
+                _sys.stderr.write(
+                    "[atx elastic] live shards have holes; streaming missing "
+                    f"slices from remote {fallback.name} (byte-range reads)\n"
+                )
+                restored = _ckpt.reshard_arrays(
+                    template, shard_tree, [snapshot, fallback]
+                )
+        except BaseException:
+            # The mesh swing is the one mutation before this point; undo it
+            # so the relaunch fallback saves the emergency checkpoint under
+            # the topology the live arrays actually have. Best-effort: in a
+            # torn-down real multi-host world this can itself fail, and the
+            # relaunch path recovers regardless.
+            try:
+                if len(devs) >= old_devices:
+                    self.state.set_mesh(
+                        build_mesh(
+                            resize_mesh_config(
+                                new_mesh, old_devices, devices=devs[:old_devices]
+                            )
+                        )
+                    )
+                    if old_param_specs is not None:
+                        params_shapes = jax.eval_shape(lambda p: p, state.params)
+                        self._resolve_specs(params_shapes, state.tx)
+            except Exception:
+                pass
+            raise
+        new_state = state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            loss_scale=restored.get("loss_scale", state.loss_scale),
+        )
+        # 5. Roster swing: the health monitor stops scanning (and retires
+        #    the beats of) departed ranks; the controller arms the next
+        #    epoch. A health-escalated preemption flag is now satisfied —
+        #    clear it so the emergency-exit path doesn't fire.
+        if self._health is not None:
+            self._health.adopt_roster(decision.survivors)
+        self._elastic.adopt(decision)
+        resilience.clear_preemption()
+        self._mesh_epoch += 1
+        new_sig = topology_signature(new_mesh)
+        for cb in self._topology_callbacks:
+            try:
+                cb(old_sig, new_sig, decision)
+            except Exception as e:
+                _sys.stderr.write(
+                    f"[atx elastic] on_topology_change callback failed: {e}\n"
+                )
+        kind = "grow" if decision.num_devices > old_devices else "shrink"
+        agree_secs = (self._elastic.last_transition or {}).get("agree_secs", 0.0)
+        reshard_secs = _time.monotonic() - t0
+        if self._elastic.last_transition is not None:
+            self._elastic.last_transition["reshard_secs"] = reshard_secs
+        _sys.stderr.write(
+            f"[atx elastic] {kind} in place (epoch {decision.epoch}): "
+            f"{old_sig['num_devices']} -> {decision.num_devices} devices, "
+            f"{decision.num_processes} process(es) x "
+            f"{decision.host_devices} device(s) at step {live_step}; "
+            f"agreement {agree_secs:.3f}s, reshard {reshard_secs:.3f}s\n"
+        )
+        _sys.stderr.flush()
+        self._elastic_timer = (decision.epoch, kind, esc_at)
+        return new_state
+
+    def _report_elastic_latency(self, new_state: "TrainState") -> None:
+        """Log escalation -> first post-resize step wall clock (the ISSUE's
+        reported metric) after blocking once on that step's output."""
+        import sys as _sys
+        import time as _time
+
+        epoch, kind, esc_at = self._elastic_timer
+        self._elastic_timer = None
+        try:
+            jax.block_until_ready(new_state.step)
+        except Exception:  # pragma: no cover - reporting must not kill steps
+            pass
+        _sys.stderr.write(
+            f"[atx elastic] epoch {epoch} {kind}: escalation -> first "
+            f"post-{kind} step {_time.monotonic() - esc_at:.3f}s\n"
+        )
+        _sys.stderr.flush()
 
     # ------------------------------------------------------------ checkpoint
     def register_for_checkpointing(self, *objects: Any) -> None:

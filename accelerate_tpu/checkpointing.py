@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import atexit
 import contextlib
+import io
 import json
 import logging
 import os
@@ -42,10 +43,12 @@ import pickle
 import random as _py_random
 import re
 import shutil
+import struct
 import threading
 import time
 import warnings
-from typing import TYPE_CHECKING, Any, Iterable
+import zlib
+from typing import TYPE_CHECKING, Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
@@ -172,6 +175,61 @@ def _from_jsonable(value: Any) -> Any:
     return value
 
 
+def _assemble_slice(
+    entries: Iterable[tuple[tuple[int, ...], tuple[int, ...], Callable[[], np.ndarray]]],
+    idx: tuple[slice, ...],
+    shape: tuple[int, ...],
+    dtype: Any,
+    key: str,
+    *,
+    context: str = "",
+) -> np.ndarray:
+    """Source-agnostic shard assembly: build the requested global slice of a
+    ``shape``-shaped array from overlapping shards (saved and requested shard
+    boundaries need not match).
+
+    ``entries`` is ``(starts, shard_shape, fetch)`` where ``fetch()`` returns
+    the shard's data — a decoded npz member, a live device shard, or a
+    byte-range fetch from an object store. Entries are consulted in order and
+    a shard whose region is already fully covered is SKIPPED WITHOUT
+    FETCHING, so expensive sources (peer files, remote byte ranges) listed
+    after cheap ones (live local shards) only pay for actual holes.
+    """
+    req_starts = tuple((sl.start or 0) for sl in idx)
+    req_stops = tuple(
+        (sl.stop if sl.stop is not None else dim) for sl, dim in zip(idx, shape)
+    )
+    req_shape = tuple(b - a for a, b in zip(req_starts, req_stops))
+    out = np.empty(req_shape, dtype=dtype)
+    # Boolean fill mask (not a volume count): overlapping shards must not
+    # be able to mask a hole and leak uninitialized memory.
+    covered = np.zeros(req_shape, dtype=bool) if req_shape else np.zeros((), dtype=bool)
+    for starts, sshape, fetch in entries:
+        stops = tuple(a + s for a, s in zip(starts, sshape))
+        inter_start = tuple(max(a, b) for a, b in zip(starts, req_starts))
+        inter_stop = tuple(min(a, b) for a, b in zip(stops, req_stops))
+        if any(a >= b for a, b in zip(inter_start, inter_stop)):
+            continue
+        dst_idx = tuple(
+            slice(a - r0, b - r0) for a, b, r0 in zip(inter_start, inter_stop, req_starts)
+        )
+        if covered[dst_idx].all():
+            continue
+        src = fetch()
+        src_idx = tuple(
+            slice(a - s0, b - s0) for a, b, s0 in zip(inter_start, inter_stop, starts)
+        )
+        out[dst_idx] = src[src_idx]
+        covered[dst_idx] = True
+    if not covered.all():
+        raise CheckpointShardCoverageError(
+            f"Checkpoint shards for {key!r} do not cover requested slice {idx} "
+            f"({int(covered.sum())}/{int(np.prod(req_shape))} elements covered) "
+            + context
+        )
+    return out
+
+
 class _ShardReader:
     """Lazily-opened view over every process's shard files in a directory."""
 
@@ -224,39 +282,26 @@ class _ShardReader:
     def read_slice(self, key: str, idx: tuple[slice, ...], shape: tuple[int, ...], dtype: Any) -> np.ndarray:
         """Assemble the requested global slice from overlapping saved shards
         (saved and requested shard boundaries need not match)."""
-        req_starts = tuple((sl.start or 0) for sl in idx)
-        req_stops = tuple(
-            (sl.stop if sl.stop is not None else dim) for sl, dim in zip(idx, shape)
-        )
-        req_shape = tuple(b - a for a, b in zip(req_starts, req_stops))
-        out = np.empty(req_shape, dtype=dtype)
-        # Boolean fill mask (not a volume count): overlapping shards must not
-        # be able to mask a hole and leak uninitialized memory.
-        covered = np.zeros(req_shape, dtype=bool) if req_shape else np.zeros((), dtype=bool)
-        for starts, sshape, proc in self.shard_table.get(key, ()):
-            stops = tuple(a + s for a, s in zip(starts, sshape))
-            inter_start = tuple(max(a, b) for a, b in zip(starts, req_starts))
-            inter_stop = tuple(min(a, b) for a, b in zip(stops, req_stops))
-            if any(a >= b for a, b in zip(inter_start, inter_stop)):
-                continue
-            src = self._shard_array(proc, _shard_entry_key(key, starts))
-            src_idx = tuple(
-                slice(a - s0, b - s0) for a, b, s0 in zip(inter_start, inter_stop, starts)
+        entries = [
+            (
+                starts,
+                sshape,
+                lambda p=proc, s=_shard_entry_key(key, starts): self._shard_array(p, s),
             )
-            dst_idx = tuple(
-                slice(a - r0, b - r0) for a, b, r0 in zip(inter_start, inter_stop, req_starts)
-            )
-            out[dst_idx] = src[src_idx]
-            covered[dst_idx] = True
-        if not covered.all():
-            raise CheckpointShardCoverageError(
-                f"Checkpoint shards for {key!r} do not cover requested slice {idx} "
-                f"({int(covered.sum())}/{int(np.prod(req_shape))} elements covered) "
+            for starts, sshape, proc in self.shard_table.get(key, ())
+        ]
+        return _assemble_slice(
+            entries,
+            idx,
+            shape,
+            dtype,
+            key,
+            context=(
                 "— a shard file another process wrote is missing from this "
                 "directory (per-node checkpoint restored at a different "
                 "topology without a replicate store, or deleted shard files)"
-            )
-        return out
+            ),
+        )
 
     def read_full(self, key: str) -> np.ndarray:
         info = self.index[key]
@@ -341,6 +386,316 @@ def load_pytree(target: Any, directory: str) -> Any:
     finally:
         reader.close()
     return jax.tree_util.tree_unflatten(treedef, [x for x in out])
+
+
+# ------------------------------------------------- in-memory resharder (elastic)
+# Shrink/grow-in-place (resilience/elastic.py) reuses the shard-assembly
+# machinery above on LIVE arrays: survivors rebuild every leaf for a new
+# mesh from the shards they already hold in memory, consulting a committed
+# remote checkpoint only for slices nobody holds. The sources below all
+# speak the same `(starts, shard_shape, fetch)` protocol `_assemble_slice`
+# consumes, so the resharder is agnostic to where bytes come from.
+
+
+class InMemoryShardSource:
+    """Live local shards of a pytree, snapshot to host.
+
+    The primary source for the in-place reshard. Unlike the save path
+    (replica-0 shards only — every byte written exactly once), this keeps
+    ALL addressable shards: replicas are free extra coverage when the
+    process that owned replica 0 of a slice is the one that died.
+    """
+
+    def __init__(self) -> None:
+        self._info: dict[str, dict[str, Any]] = {}
+        self._shards: dict[str, list[tuple[tuple[int, ...], np.ndarray]]] = {}
+
+    @classmethod
+    def from_tree(cls, tree: Any) -> "InMemoryShardSource":
+        src = cls()
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            key = _leaf_key(path)
+            if isinstance(leaf, jax.Array):
+                shards: list[tuple[tuple[int, ...], np.ndarray]] = []
+                for shard in leaf.addressable_shards:
+                    starts = tuple(
+                        (sl.start or 0) for sl in shard.index
+                    ) if leaf.ndim else ()
+                    shards.append((starts, np.asarray(shard.data)))
+                src._info[key] = {
+                    "shape": list(leaf.shape),
+                    "dtype": str(np.dtype(leaf.dtype)),
+                }
+                src._shards[key] = shards
+            else:
+                src._info[key] = {"value": _to_jsonable(leaf)}
+        return src
+
+    def leaf_info(self, key: str) -> dict[str, Any] | None:
+        return self._info.get(key)
+
+    def shards(
+        self, key: str
+    ) -> list[tuple[tuple[int, ...], tuple[int, ...], Callable[[], np.ndarray]]]:
+        return [
+            (starts, tuple(data.shape), lambda d=data: d)
+            for starts, data in self._shards.get(key, ())
+        ]
+
+
+def _zip_entries(store: Any, key: str) -> dict[str, tuple[int, int, int]]:
+    """Member table of a remote zip (npz) via ranged reads only:
+    ``name -> (compress_method, compressed_size, local_header_offset)``.
+
+    Reads the EOCD from a bounded tail fetch, then the central directory —
+    two small ranged requests against an arbitrarily large archive."""
+    st = store.stat(key)
+    if st is None:
+        raise _replicate.ObjectStoreError(f"no object {key!r}")
+    size = int(st.size)
+    # EOCD is 22 bytes + up to 64KiB of archive comment.
+    tail_len = min(size, 22 + 65535)
+    tail_off = size - tail_len
+    tail = store.get_range(key, tail_off, tail_len)
+    eocd = tail.rfind(b"PK\x05\x06")
+    if eocd < 0:
+        raise ValueError(f"{key!r}: no zip end-of-central-directory record")
+    cd_size, cd_offset = struct.unpack("<II", tail[eocd + 12 : eocd + 20])
+    if cd_offset >= tail_off:
+        cd = tail[cd_offset - tail_off : cd_offset - tail_off + cd_size]
+    else:
+        cd = store.get_range(key, cd_offset, cd_size)
+    entries: dict[str, tuple[int, int, int]] = {}
+    pos = 0
+    while pos + 46 <= len(cd) and cd[pos : pos + 4] == b"PK\x01\x02":
+        (method,) = struct.unpack("<H", cd[pos + 10 : pos + 12])
+        comp_size, _uncomp = struct.unpack("<II", cd[pos + 20 : pos + 28])
+        name_len, extra_len, comment_len = struct.unpack(
+            "<HHH", cd[pos + 28 : pos + 34]
+        )
+        (header_off,) = struct.unpack("<I", cd[pos + 42 : pos + 46])
+        name = cd[pos + 46 : pos + 46 + name_len].decode("utf-8")
+        entries[name] = (method, comp_size, header_off)
+        pos += 46 + name_len + extra_len + comment_len
+    return entries
+
+
+def read_npz_member(
+    store: Any,
+    key: str,
+    member: str,
+    *,
+    entries: dict[str, tuple[int, int, int]] | None = None,
+) -> np.ndarray:
+    """One array out of a remote ``.npz`` by byte range (`ObjectStore.
+    get_range`) — the member's local header + payload only, never the whole
+    archive. np.savez stores members uncompressed (ZIP_STORED), so a member
+    IS a contiguous byte range; compressed members are handled anyway.
+    Pass ``entries`` (from `_zip_entries`) to amortize the directory reads
+    across members of the same archive."""
+    if entries is None:
+        entries = _zip_entries(store, key)
+    name = member if member in entries else member + ".npy"
+    if name not in entries:
+        raise KeyError(f"{member!r} not in {key!r} ({len(entries)} members)")
+    method, comp_size, header_off = entries[name]
+    # 30-byte local file header carries its own (possibly longer) extra field.
+    header = store.get_range(key, header_off, 30)
+    if header[:4] != b"PK\x03\x04":
+        raise ValueError(f"{key!r}: bad local header for member {name!r}")
+    name_len, extra_len = struct.unpack("<HH", header[26:30])
+    data = store.get_range(key, header_off + 30 + name_len + extra_len, comp_size)
+    if method == 8:
+        data = zlib.decompress(data, -15)
+    elif method != 0:
+        raise ValueError(f"{key!r}: unsupported zip method {method} for {name!r}")
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class StoreShardSource:
+    """Shards of a committed remote checkpoint, fetched by byte range.
+
+    The fallback source for the in-place reshard: survivors' live shards are
+    consulted first, and thanks to `_assemble_slice`'s covered-region skip a
+    fetch here only fires for slices nobody alive holds — and downloads only
+    that member's bytes, not the whole ``shards_<p>.npz`` (the ROADMAP
+    "streams whole npz files" follow-up). Fires the
+    ``shrink.peer_slice_fetched`` fault point per fetched member."""
+
+    def __init__(self, store: Any, name: str, procs: Iterable[int]) -> None:
+        self.store = store
+        self.name = name
+        self._info: dict[str, dict[str, Any]] = {}
+        # leaf key -> [(starts, shape, proc)]
+        self._table: dict[str, list[tuple[tuple[int, ...], tuple[int, ...], int]]] = {}
+        self._prefix: dict[int, str] = {}
+        self._entries: dict[int, dict[str, tuple[int, int, int]]] = {}
+        self._cache: dict[tuple[int, str], np.ndarray] = {}
+        for p in procs:
+            for prefix in (f"node_{p}/{name}", name):
+                idx_key = f"{prefix}/{MODEL_DIR}/{INDEX_FILE.format(proc=p)}"
+                if not store.exists(idx_key):
+                    continue
+                idx = json.loads(store.get_bytes(idx_key).decode())
+                self._prefix[p] = prefix
+                for key, entry in idx.items():
+                    if "shards" in entry:
+                        self._info.setdefault(
+                            key, {k: entry[k] for k in ("shape", "dtype")}
+                        )
+                        for sh in entry["shards"]:
+                            self._table.setdefault(key, []).append(
+                                (tuple(sh["starts"]), tuple(sh["shape"]), p)
+                            )
+                    else:
+                        self._info.setdefault(key, entry)
+                break
+
+    @property
+    def procs(self) -> list[int]:
+        return sorted(self._prefix)
+
+    def leaf_info(self, key: str) -> dict[str, Any] | None:
+        return self._info.get(key)
+
+    def _fetch(self, proc: int, skey: str) -> np.ndarray:
+        cached = self._cache.get((proc, skey))
+        if cached is not None:
+            return cached
+        npz_key = f"{self._prefix[proc]}/{MODEL_DIR}/{SHARDS_FILE.format(proc=proc)}"
+        entries = self._entries.get(proc)
+        if entries is None:
+            entries = self._entries[proc] = _zip_entries(self.store, npz_key)
+        arr = read_npz_member(self.store, npz_key, skey, entries=entries)
+        _fault_point("shrink.peer_slice_fetched")
+        self._cache[(proc, skey)] = arr
+        return arr
+
+    def shards(
+        self, key: str
+    ) -> list[tuple[tuple[int, ...], tuple[int, ...], Callable[[], np.ndarray]]]:
+        return [
+            (starts, sshape, lambda p=proc, s=_shard_entry_key(key, starts): self._fetch(p, s))
+            for starts, sshape, proc in self._table.get(key, ())
+        ]
+
+
+def store_fallback_source(store: Any, expected_step: int) -> StoreShardSource | None:
+    """Newest remote *committed* checkpoint whose saved ``step`` equals
+    ``expected_step``, as a `StoreShardSource` — None when the store has no
+    same-step commit. The step gate is load-bearing: mixing a stale commit's
+    shards into a live reshard would silently roll back part of the state.
+    The step probe itself is a ~100-byte ranged read."""
+    keys = store.list("")
+    names: set[str] = set()
+    for key in keys:
+        m = re.match(
+            r"^(?:node_(\d+)/)?(checkpoint_\d+)/" + re.escape(_commit.COMMIT_MARKER) + "$",
+            key,
+        )
+        if m:
+            names.add(m.group(2))
+    for name in sorted(
+        names, key=lambda n: int(n.rsplit("_", 1)[1]), reverse=True
+    ):
+        procs = set()
+        for key in keys:
+            m = re.match(
+                r"^(?:node_\d+/)?" + re.escape(name) + r"/"
+                + re.escape(MODEL_DIR) + r"/index_(\d+)\.json$",
+                key,
+            )
+            if m:
+                procs.add(int(m.group(1)))
+        if not procs:
+            continue
+        try:
+            src = StoreShardSource(store, name, sorted(procs))
+            step_entries = src.shards("step")
+            if not step_entries:
+                continue
+            saved = int(np.asarray(step_entries[0][2]()).reshape(()))
+        except Exception as e:
+            logger.warning(
+                "[atx elastic] remote %s unusable as reshard fallback: %s",
+                name,
+                e,
+            )
+            continue
+        if saved == int(expected_step):
+            return src
+        logger.info(
+            "[atx elastic] remote %s is at step %d (want %d); not a reshard "
+            "fallback",
+            name,
+            saved,
+            expected_step,
+        )
+    return None
+
+
+def reshard_arrays(
+    template: Any,
+    shardings: Any,
+    sources: Iterable[Any],
+) -> Any:
+    """Rebuild ``template``'s jax.Array leaves under new ``shardings`` from
+    ``sources`` — the source-agnostic in-memory resharder behind
+    shrink/grow-in-place.
+
+    ``template`` supplies structure + global shape/dtype (its leaves may
+    live on the OLD mesh); ``shardings`` is a matching pytree of the TARGET
+    NamedShardings. ``sources`` are consulted in order per leaf; within a
+    leaf their shards are unioned, and `_assemble_slice` only *fetches* a
+    later source's shard for regions earlier sources left uncovered.
+    Raises `CheckpointShardCoverageError` when the union still has holes
+    (callers degrade to the emergency-save + relaunch path)."""
+    sources = list(sources)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    shard_leaves = treedef.flatten_up_to(shardings)
+    out = []
+    for (path, leaf), sharding in zip(flat, shard_leaves):
+        key = _leaf_key(path)
+        info = None
+        for src in sources:
+            info = src.leaf_info(key)
+            if info is not None:
+                break
+        if info is None:
+            raise KeyError(f"Leaf {key!r} missing from every reshard source")
+        if "value" in info:
+            out.append(_from_jsonable(info["value"]))
+            continue
+        shape = tuple(info["shape"])
+        dtype = np.dtype(info["dtype"])
+        if isinstance(leaf, jax.Array) and tuple(leaf.shape) != shape:
+            raise ValueError(
+                f"Shape mismatch for {key!r}: template {tuple(leaf.shape)} vs "
+                f"source {shape}"
+            )
+        target_dtype = leaf.dtype if isinstance(leaf, jax.Array) else dtype
+        entries = [e for src in sources for e in src.shards(key)]
+        arr = jax.make_array_from_callback(
+            shape,
+            sharding,
+            lambda idx, e=entries, s=shape, d=dtype, k=key, td=target_dtype: (
+                _assemble_slice(
+                    e,
+                    idx,
+                    s,
+                    d,
+                    k,
+                    context=(
+                        "— the surviving processes' live shards plus the "
+                        "replicate-store fallback do not cover this leaf; "
+                        "shrink-in-place is impossible without data loss"
+                    ),
+                ).astype(td)
+            ),
+        )
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def consolidate_checkpoint(directory: str, output_path: str) -> str:

@@ -127,12 +127,15 @@ def register(subparsers: argparse._SubParsersAction) -> None:
     p.add_argument(
         "--elastic_devices_file",
         default=None,
-        help="Path to a file holding one integer: the --host_devices value "
-        "to use for each worker-group (re)start. Re-read before every "
-        "group launch, so an elastic restart (preemption exit-75, health "
-        "escalation) can come back at a SMALLER simulated device count and "
-        "the workers reshard their checkpoint on restore "
-        "(docs/fault_tolerance.md, elastic resume)",
+        help="Path to a file holding 'H' (the --host_devices value) or "
+        "'P H' (num_processes and host_devices) for each worker-group "
+        "(re)start. Re-read before every group launch, so an elastic "
+        "restart (preemption exit-75, health escalation) can come back at "
+        "a SMALLER topology and the workers reshard their checkpoint on "
+        "restore. The path is also exported as ATX_ELASTIC_DEVICES_FILE so "
+        "a running group with ATX_ELASTIC_SHRINK=1 can watch it and "
+        "shrink/grow IN PLACE without a relaunch "
+        "(docs/fault_tolerance.md, shrink/grow in place)",
     )
     p.add_argument("--dry_run", action="store_true", help="Print commands, don't run")
     p.add_argument("script", help="Training script to run")
@@ -176,6 +179,14 @@ def _merge_config(args: argparse.Namespace) -> LaunchConfig:
         # in Accelerator.__init__); extra_env is applied last in
         # build_child_env so the flag also wins over a config-file value.
         cfg.extra_env = {**cfg.extra_env, "ATX_REPLICATE_URL": args.replicate_url}
+    if getattr(args, "elastic_devices_file", None):
+        # Exported so workers running with ATX_ELASTIC_SHRINK=1 can watch
+        # the same file and resize IN PLACE; the launcher keeps re-reading
+        # it per group (re)start as the relaunch fallback.
+        cfg.extra_env = {
+            **cfg.extra_env,
+            "ATX_ELASTIC_DEVICES_FILE": args.elastic_devices_file,
+        }
     return cfg
 
 
@@ -288,19 +299,26 @@ def _run_worker_group(cfg: LaunchConfig, cmd: list[str], args) -> int:
             p.kill()
 
 
-def _apply_elastic_devices(args) -> None:
+def _apply_elastic_devices(args, cfg=None) -> None:
     """Re-read ``--elastic_devices_file`` (when given) before a worker-group
-    (re)start: the file holds the ``--host_devices`` value for the NEXT
-    group, so an external controller (or a test) can shrink the simulated
-    topology between an emergency exit and the elastic resume. Unreadable /
-    non-integer content keeps the previous value — a live elastic loop must
+    (re)start: the file holds either ``H`` (the ``--host_devices`` value) or
+    ``P H`` (num_processes and host_devices) for the NEXT group, so an
+    external controller (or a test) can shrink the simulated topology
+    between an emergency exit and the elastic resume. Unreadable /
+    non-integer content keeps the previous values — a live elastic loop must
     not die on a torn write."""
     path = getattr(args, "elastic_devices_file", None)
     if not path:
         return
     try:
         with open(path) as f:
-            devices = int(f.read().strip())
+            fields = [int(tok) for tok in f.read().split()]
+        if len(fields) == 1:
+            processes, devices = None, fields[0]
+        elif len(fields) == 2:
+            processes, devices = fields
+        else:
+            raise ValueError(f"expected 'H' or 'P H', got {len(fields)} fields")
     except (OSError, ValueError) as e:
         print(
             f"[accelerate-tpu launch] could not read --elastic_devices_file "
@@ -318,6 +336,20 @@ def _apply_elastic_devices(args) -> None:
             flush=True,
         )
         args.host_devices = devices
+    if (
+        cfg is not None
+        and processes is not None
+        and processes > 0
+        and processes != cfg.num_processes
+    ):
+        print(
+            f"[accelerate-tpu launch] elastic devices file: next worker "
+            f"group starts with num_processes={processes} "
+            f"(was {cfg.num_processes})",
+            file=sys.stderr,
+            flush=True,
+        )
+        cfg.num_processes = processes
 
 
 def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
@@ -354,7 +386,7 @@ def _local_multiprocess_launch(cfg: LaunchConfig, cmd: list[str], args) -> int:
         else:
             cfg.coordinator_address = f"127.0.0.1:{_free_port()}"
         first_group = False
-        _apply_elastic_devices(args)
+        _apply_elastic_devices(args, cfg)
         exit_code = _run_worker_group(cfg, cmd, args)
         if exit_code == 0:
             return 0
@@ -551,7 +583,7 @@ def run(args: argparse.Namespace) -> int:
             "not restarted.",
             file=sys.stderr,
         )
-    _apply_elastic_devices(args)
+    _apply_elastic_devices(args, cfg)
     env = build_child_env(cfg, None, host_devices=args.host_devices)
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in cmd))

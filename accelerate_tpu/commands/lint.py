@@ -579,12 +579,127 @@ def _mh_scenario_elastic_restore(processes: int = 2):
     )
 
 
+def _mh_scenario_shrink(processes: int = 2):
+    """Shrink-in-place (resilience/elastic.py): a devices-file retarget
+    escalates at a step boundary, survivors run the agreement round, and
+    the accelerator reshards params/opt-state/step in memory onto the
+    smaller mesh — then keeps training. The whole escalate -> agree ->
+    reshard window must be COLLECTIVE-FREE (proposal/decision objects +
+    file IO only): in a real shrink the departed peer is dead, and any
+    collective in this window would park the survivors forever. The replay
+    pins exactly that, plus identical post-shrink schedules across the
+    surviving processes (the ATX501/502/503 gates)."""
+    import math
+    import tempfile
+
+    import jax
+
+    from .. import analysis
+    from ..resilience import elastic as _elastic
+
+    total = jax.device_count()
+    host = total // processes if processes else 0
+    if host < 2 or total % processes != 0:
+        raise RuntimeError(
+            f"the shrink scenario needs >= 2 simulated devices per process "
+            f"(got {total} device(s) for {processes} process(es)); run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    new_host = host - 1
+    new_total = processes * new_host
+    # Batch rows must divide the data axis both before and after the shrink
+    # (constrain_batch binds activations to the mesh).
+    rows = math.lcm(total, new_total)
+
+    # ONE root shared by every simulated process and every replay round:
+    # the agreement surface is how the survivors see each other. The
+    # devices file, peer proposals, and decision are seeded ONCE up front —
+    # the replay runs simulated processes SEQUENTIALLY, so a blocking
+    # follower could never observe a live coordinator; pre-seeding plus the
+    # coordinator's idempotent decision write make every round converge on
+    # identical bytes.
+    root = tempfile.mkdtemp(prefix="atx_lint_mh_shrink_")
+    edir = os.path.join(root, "elastic")
+    dfile = os.path.join(root, "devices")
+    with open(dfile, "w") as f:
+        f.write(f"{processes} {new_host}\n")
+    decision = _elastic.TopologyDecision(
+        epoch=1,
+        survivors=tuple(range(processes)),
+        host_devices=new_host,
+        step=0,
+    )
+    surface = _elastic._FileSurface(edir)
+    _elastic.post_peer_proposals(surface, range(processes), decision)
+    surface.write(_elastic.DECISION_FILE.format(epoch=1), decision.to_payload())
+
+    env = {
+        "ATX_ELASTIC_SHRINK": "1",
+        "ATX_ELASTIC_DIR": edir,
+        "ATX_ELASTIC_DEVICES_FILE": dfile,
+        "ATX_ELASTIC_AGREE_SECS": "5",
+    }
+
+    def shrink_loop():
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from ..accelerator import Accelerator, TrainState
+        from ..analysis import host_trace
+        from ..state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        acc = Accelerator(seed=0)
+        assert acc._elastic is not None, "elastic controller did not arm"
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)}
+        state = acc.prepare_train_state(
+            TrainState.create(params=params, tx=optax.sgd(1e-2))
+        )
+        step = acc.make_train_step(
+            lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+        )
+        rec = host_trace._ACTIVE_RECORDER
+        before = len(rec.collective_events) if rec is not None else None
+        resized = acc._maybe_elastic_resize(state, 0)
+        if rec is not None:
+            grew = len(rec.collective_events) - before
+            assert grew == 0, (
+                f"shrink agreement+reshard issued {grew} collective(s); the "
+                "escalate -> agree -> reshard window must stay collective-"
+                "free — the departed peer is dead and would park any "
+                "collective forever"
+            )
+        assert resized is not None, "in-place shrink did not engage"
+        assert acc.mesh.devices.size == new_total, (
+            f"mesh has {acc.mesh.devices.size} devices after shrink, "
+            f"wanted {new_total}"
+        )
+        state = resized
+        batch = {"x": np.ones((rows, 8), np.float32)}
+        state, _ = step(state, batch)
+        state, _ = step(state, batch)
+        assert int(jax.device_get(state.step)) == 2, "post-shrink steps lost"
+        assert acc.mesh.devices.size == new_total, "mesh reverted after steps"
+
+    report = analysis.lint_host_loop(
+        shrink_loop, processes=processes, env=env, target="shrink"
+    )
+    return (
+        f"live shrink-in-place: devices-file retarget {total} -> {new_total} "
+        f"devices, collective-free agree + in-memory reshard + resumed "
+        f"steps, {processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
     "router_drain": _mh_scenario_router_drain,
     "replicated_save": _mh_scenario_replicated_save,
     "elastic_restore": _mh_scenario_elastic_restore,
+    "shrink": _mh_scenario_shrink,
 }
 
 

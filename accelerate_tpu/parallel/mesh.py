@@ -124,6 +124,54 @@ def build_mesh(config: MeshConfig | None = None) -> Mesh:
     return Mesh(device_array, MESH_AXES)
 
 
+def resize_mesh_config(
+    mesh: Mesh,
+    n_devices: int,
+    devices: "Sequence[jax.Device] | None" = None,
+) -> MeshConfig:
+    """A `MeshConfig` with the same parallelism layout as ``mesh`` at a
+    different device count — the elastic shrink/grow resize policy.
+
+    Model-parallel axes (tensor/sequence/expert) are preserved: their sizes
+    encode how the model is cut up, and changing them would change every
+    per-leaf layout. The size delta is absorbed by ``fsdp`` when the mesh is
+    FSDP-sharded (fsdp > 1), else by ``data``; a mesh using both keeps fsdp
+    and scales data (the outermost, cheapest axis to resize). Raises
+    ``ValueError`` when ``n_devices`` doesn't factor — callers fall back to
+    the relaunch path rather than invent a different layout.
+    """
+    shape = dict(zip(MESH_AXES, mesh.devices.shape))
+    fixed = shape[TENSOR_AXIS] * shape[SEQUENCE_AXIS] * shape[EXPERT_AXIS]
+    if n_devices <= 0 or n_devices % fixed != 0:
+        raise ValueError(
+            f"cannot resize mesh {dict(shape)} to {n_devices} devices: "
+            f"model axes tensor*sequence*expert={fixed} must divide the "
+            "new device count"
+        )
+    flex = n_devices // fixed
+    data, fsdp = shape[DATA_AXIS], shape[FSDP_AXIS]
+    if fsdp > 1 and data > 1:
+        if flex % fsdp != 0:
+            raise ValueError(
+                f"cannot resize mesh {dict(shape)} to {n_devices} devices: "
+                f"fsdp={fsdp} is kept fixed and must divide the remaining "
+                f"factor {flex}"
+            )
+        data = flex // fsdp
+    elif fsdp > 1:
+        data, fsdp = 1, flex
+    else:
+        data, fsdp = flex, 1
+    return MeshConfig(
+        data=data,
+        fsdp=fsdp,
+        tensor=shape[TENSOR_AXIS],
+        sequence=shape[SEQUENCE_AXIS],
+        expert=shape[EXPERT_AXIS],
+        devices=devices,
+    )
+
+
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     device = device or jax.devices()[0]
     return Mesh(np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES)

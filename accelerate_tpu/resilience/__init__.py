@@ -36,6 +36,14 @@ notice, or a hung step a *recoverable* event:
   process; a monitor flags stale peers (logging their last-known step) and
   escalates to the emergency-save + exit-75 elastic path in seconds instead
   of wedging until the per-step ``ATX_WATCHDOG_SECS`` deadline.
+- :mod:`~accelerate_tpu.resilience.elastic` — shrink/grow-in-place
+  (``ATX_ELASTIC_SHRINK``): on a health escalation or an
+  ``--elastic_devices_file`` retarget, survivors run a collective-free
+  agreement round (proposal/decision objects through a shared dir or the
+  replicate store) and the accelerator reshards params/opt-state/step in
+  memory onto the reduced mesh — seconds of reshard instead of the
+  emergency-save → relaunch → restore cycle, which stays as the fallback
+  whenever agreement or the reshard fails.
 
 Fault-injection hooks (`commit.fault_point`) are no-ops unless one of the
 ``ATX_FAULT_{KILL,RAISE}_AT`` env vars is set; the test harness that drives
@@ -57,6 +65,12 @@ from .commit import (
     verify_checkpoint,
     write_aggregate_manifest,
     write_manifest,
+)
+from .elastic import (
+    AgreementError,
+    ElasticController,
+    TopologyDecision,
+    elastic_controller_from_env,
 )
 from .gce import MaintenancePoller, maintenance_poller_from_env
 from .health import PeerHealthMonitor, health_from_env
@@ -83,10 +97,12 @@ from .watchdog import WATCHDOG_EXIT_CODE, Watchdog, dump_all_stacks, watchdog_fr
 
 __all__ = [
     "AGG_MANIFEST",
+    "AgreementError",
     "COMMIT_MARKER",
     "TMP_SUFFIX",
     "CheckpointIntegrityWarning",
     "CheckpointShardCoverageError",
+    "ElasticController",
     "LocalObjectStore",
     "MaintenancePoller",
     "ObjectStore",
@@ -94,9 +110,11 @@ __all__ = [
     "PREEMPTION_EXIT_CODE",
     "PeerHealthMonitor",
     "Replicator",
+    "TopologyDecision",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "clear_preemption",
+    "elastic_controller_from_env",
     "maintenance_poller_from_env",
     "commit_dir",
     "committed_checkpoints",

@@ -1,0 +1,590 @@
+"""Shrink/grow-in-place: live topology agreement for elastic training.
+
+PR 10 made *restores* topology-independent; this module removes the
+restart from the loop. When the peer-health watchdog (`health.py`) flags a
+dead peer — or ``--elastic_devices_file`` retargets the group — survivors
+run a **collective-free agreement round** and then reshard live state in
+memory (`checkpointing.reshard_arrays`) instead of round-tripping through
+emergency-save → exit 75 → relaunch → restore.
+
+Agreement protocol (the ATX502-safe pattern from the preemption or-reduce
+and the PR-9 sentinel polling — proposal *objects*, never collectives,
+because the dead peer would park any collective forever):
+
+- Every survivor writes ``proposal_<rank>.json`` = ``(epoch, survivors,
+  host_devices, step)`` to the agreement surface (a shared directory or
+  the replicate object store under ``elastic/``).
+- The **coordinator** (lowest-ranked survivor) polls until every proposed
+  survivor has posted an *identical* proposal for this epoch, then writes
+  ``decision_<epoch>.json`` — the write is idempotent, so replays and
+  races are safe.
+- Non-coordinators poll for the decision and verify it matches their own
+  proposal. Any mismatch (different survivor sets, different steps — the
+  group diverged) or timeout (``ATX_ELASTIC_AGREE_SECS``) raises
+  `AgreementError`, and the caller degrades to the existing
+  emergency-save + exit-75 relaunch path. Agreement can fail; it cannot
+  wedge or split-brain.
+
+Epochs are monotonically increasing per transition; proposals from older
+epochs are ignored (a crashed round's debris), and decisions are keyed by
+epoch so a late reader of round N never adopts round N+1's topology by
+accident. Grow-back is the same round in reverse, triggered by
+``--elastic_devices_file`` reporting more capacity or a retired peer's
+beats returning.
+
+Survivor ranks are the **old** ranks (a shrink of {0..7} losing {2,5}
+leaves roster (0,1,3,4,6,7)) — beat files and ``node_<p>/`` store
+prefixes stay valid — while `TopologyDecision.rank_of` gives the dense
+new rank used to re-initialize the distributed runtime.
+
+Like `health.py` and `commit.py`, this module is jax-free: the accelerator
+owns all mesh/array work; everything here is file/store IO and is
+deterministically testable with injected clocks.
+
+Knobs: ``ATX_ELASTIC_SHRINK`` (opt-in), ``ATX_ELASTIC_AGREE_SECS``
+(agreement timeout, default 30), ``ATX_ELASTIC_DIR`` (surface override),
+``ATX_ELASTIC_DEVICES_FILE`` (grow/shrink target file, set by the
+launcher's ``--elastic_devices_file``), ``ATX_ELASTIC_PEERS`` (roster
+size override for simulated-peer tests, like ``ATX_HEALTH_PEERS``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from .commit import fault_point
+from .preemption import request_preemption
+
+logger = logging.getLogger(__name__)
+
+PROPOSAL_FILE = "proposal_{proc}.json"
+DECISION_FILE = "decision_{epoch}.json"
+STORE_PREFIX = "elastic/"
+
+ELASTIC_SHRINK_ENV = "ATX_ELASTIC_SHRINK"
+AGREE_SECS_ENV = "ATX_ELASTIC_AGREE_SECS"
+DEVICES_FILE_ENV = "ATX_ELASTIC_DEVICES_FILE"
+ELASTIC_DIR_ENV = "ATX_ELASTIC_DIR"
+ELASTIC_PEERS_ENV = "ATX_ELASTIC_PEERS"
+
+
+class AgreementError(RuntimeError):
+    """The survivors could not agree on a topology (timeout, divergent
+    proposals, or a conflicting decision) — shrink-in-place must not
+    proceed; degrade to the relaunch path."""
+
+
+@dataclass(frozen=True)
+class TopologyDecision:
+    """The agreed post-transition topology: all survivors adopt the same
+    ``(survivors, host_devices, epoch)`` before touching any state."""
+
+    epoch: int
+    survivors: tuple[int, ...]
+    host_devices: int
+    step: int
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.survivors)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.survivors) * self.host_devices
+
+    def rank_of(self, old_rank: int) -> int | None:
+        """Dense new rank of ``old_rank`` (its index in the survivor list),
+        None when the rank did not survive."""
+        try:
+            return self.survivors.index(old_rank)
+        except ValueError:
+            return None
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "epoch": self.epoch,
+            "survivors": list(self.survivors),
+            "host_devices": self.host_devices,
+            "step": self.step,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "TopologyDecision":
+        return cls(
+            epoch=int(payload["epoch"]),
+            survivors=tuple(int(p) for p in payload["survivors"]),
+            host_devices=int(payload["host_devices"]),
+            step=int(payload["step"]),
+        )
+
+    def same_topology(self, other: "TopologyDecision") -> bool:
+        return (
+            self.epoch == other.epoch
+            and self.survivors == other.survivors
+            and self.host_devices == other.host_devices
+            and self.step == other.step
+        )
+
+
+# ----------------------------------------------------------------- surfaces
+class _FileSurface:
+    """Agreement objects as files in a shared directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def write(self, name: str, payload: dict[str, Any]) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # readers never see a partial proposal
+
+    def read(self, name: str) -> dict[str, Any] | None:
+        try:
+            with open(os.path.join(self.directory, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(os.path.join(self.directory, name))
+        except OSError:
+            pass
+
+    def __repr__(self) -> str:  # pragma: no cover - logging only
+        return f"_FileSurface({self.directory!r})"
+
+
+class _StoreSurface:
+    """Agreement objects in the replicate store (per-node filesystems)."""
+
+    def __init__(self, store, prefix: str = STORE_PREFIX):
+        self.store = store
+        self.prefix = prefix
+
+    def write(self, name: str, payload: dict[str, Any]) -> None:
+        self.store.put_bytes(json.dumps(payload).encode(), self.prefix + name)
+
+    def read(self, name: str) -> dict[str, Any] | None:
+        try:
+            return json.loads(self.store.get_bytes(self.prefix + name).decode())
+        except Exception:
+            return None
+
+    def delete(self, name: str) -> None:
+        try:
+            self.store.delete(self.prefix + name)
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:  # pragma: no cover - logging only
+        return f"_StoreSurface({self.store!r})"
+
+
+def post_peer_proposals(
+    surface,
+    peers: Iterable[int],
+    decision: TopologyDecision,
+) -> None:
+    """Write ``decision`` as the proposal of each rank in ``peers`` — how
+    tests and the lint replay seed the simulated survivors' side of an
+    agreement round (the real peers would have written these themselves)."""
+    for p in peers:
+        payload = decision.to_payload()
+        payload["proposer"] = int(p)
+        surface.write(PROPOSAL_FILE.format(proc=int(p)), payload)
+
+
+# ---------------------------------------------------------------- agreement
+class ElasticAgreement:
+    """One agreement round: propose, then converge on a decision."""
+
+    def __init__(
+        self,
+        surface,
+        process_index: int,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        poll_secs: float = 0.05,
+    ):
+        self.surface = surface
+        self.process_index = int(process_index)
+        self._clock = clock
+        self._sleep = sleep
+        self.poll_secs = float(poll_secs)
+
+    def agree(self, proposal: TopologyDecision, timeout: float) -> TopologyDecision:
+        """Run one round for ``proposal``; returns the adopted decision or
+        raises `AgreementError`. Never issues a collective."""
+        payload = proposal.to_payload()
+        payload["proposer"] = self.process_index
+        self.surface.write(PROPOSAL_FILE.format(proc=self.process_index), payload)
+        fault_point("shrink.agreement_proposed")
+        deadline = self._clock() + float(timeout)
+        coordinator = min(proposal.survivors)
+        if self.process_index == coordinator:
+            return self._coordinate(proposal, deadline)
+        return self._follow(proposal, deadline)
+
+    def _coordinate(
+        self, proposal: TopologyDecision, deadline: float
+    ) -> TopologyDecision:
+        decision_name = DECISION_FILE.format(epoch=proposal.epoch)
+        while True:
+            missing: list[int] = []
+            for peer in proposal.survivors:
+                raw = self.surface.read(PROPOSAL_FILE.format(proc=peer))
+                if raw is None:
+                    missing.append(peer)
+                    continue
+                try:
+                    theirs = TopologyDecision.from_payload(raw)
+                except (KeyError, TypeError, ValueError):
+                    missing.append(peer)
+                    continue
+                if theirs.epoch < proposal.epoch:
+                    missing.append(peer)  # stale debris from an older round
+                    continue
+                if not theirs.same_topology(proposal):
+                    raise AgreementError(
+                        f"survivor {peer} proposed a conflicting topology "
+                        f"{raw} vs ours {proposal.to_payload()} — the group "
+                        "diverged; refusing to shrink in place"
+                    )
+            if not missing:
+                # Idempotent: a replayed/raced coordinator rewrites the
+                # identical bytes, so "decision already exists" is not a
+                # conflict unless the content differs.
+                existing = self.surface.read(decision_name)
+                if existing is not None:
+                    theirs = TopologyDecision.from_payload(existing)
+                    if not theirs.same_topology(proposal):
+                        raise AgreementError(
+                            f"decision for epoch {proposal.epoch} already "
+                            f"exists with different topology {existing}"
+                        )
+                    return theirs
+                payload = proposal.to_payload()
+                payload["coordinator"] = self.process_index
+                self.surface.write(decision_name, payload)
+                return proposal
+            if self._clock() >= deadline:
+                raise AgreementError(
+                    f"agreement timed out after {deadline}: no proposal from "
+                    f"survivors {missing} for epoch {proposal.epoch}"
+                )
+            self._sleep(self.poll_secs)
+
+    def _follow(self, proposal: TopologyDecision, deadline: float) -> TopologyDecision:
+        decision_name = DECISION_FILE.format(epoch=proposal.epoch)
+        while True:
+            raw = self.surface.read(decision_name)
+            if raw is not None:
+                try:
+                    decision = TopologyDecision.from_payload(raw)
+                except (KeyError, TypeError, ValueError) as e:
+                    raise AgreementError(f"unreadable decision {raw}: {e}")
+                if not decision.same_topology(proposal):
+                    raise AgreementError(
+                        f"coordinator decided {raw} but this process proposed "
+                        f"{proposal.to_payload()} — divergent view of the "
+                        "group; refusing to shrink in place"
+                    )
+                return decision
+            if self._clock() >= deadline:
+                raise AgreementError(
+                    f"agreement timed out: no decision for epoch "
+                    f"{proposal.epoch} (coordinator "
+                    f"{min(proposal.survivors)} silent)"
+                )
+            self._sleep(self.poll_secs)
+
+
+# --------------------------------------------------------------- controller
+class ElasticController:
+    """Step-boundary shrink/grow decision engine (jax-free).
+
+    `check(step)` is called by the accelerator at every step entry; it
+    returns a `TopologyDecision` when the group just agreed to resize (the
+    accelerator then reshards and calls `adopt`), None otherwise, and
+    raises `AgreementError` when a triggered round failed (the accelerator
+    then falls back to the emergency-save + exit-75 path).
+
+    Triggers, in priority order:
+
+    1. health escalation: `PeerHealthMonitor.stale_peers` ∩ roster — the
+       survivors drop the dead ranks (pure shrink);
+    2. the devices file (``ATX_ELASTIC_DEVICES_FILE``): ``"P H"`` retargets
+       to P processes x H devices (``"H"`` alone keeps the process count —
+       the launcher's original format); shrink keeps the lowest current
+       ranks, grow re-adds the lowest retired ranks first;
+    3. returning peer beats: a retired rank heartbeating again (beat
+       timestamp newer than its retirement) is absorbed back.
+    """
+
+    def __init__(
+        self,
+        surface,
+        process_index: int,
+        num_processes: int,
+        host_devices: int,
+        *,
+        agree_secs: float = 30.0,
+        devices_file: str | None = None,
+        health=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.surface = surface
+        self.process_index = int(process_index)
+        self.roster: tuple[int, ...] = tuple(range(int(num_processes)))
+        self.initial_roster = self.roster
+        self.host_devices = int(host_devices)
+        self.agree_secs = float(agree_secs)
+        self.devices_file = devices_file
+        self.health = health
+        self._clock = clock
+        self.agreement = ElasticAgreement(
+            surface, self.process_index, clock=clock, sleep=sleep
+        )
+        self.epoch = 0
+        self.escalated_at: float | None = None
+        self.last_transition: dict[str, Any] | None = None
+        self._retired_at: dict[int, float] = {}
+        self._abandoned = False
+        self.transitions = 0
+
+    # -- triggers ------------------------------------------------------------
+    def _read_devices_file(self) -> tuple[int, int] | None:
+        """Parse the target as ``(num_processes, host_devices)``. One int
+        means host_devices only (the launcher's original format). Unreadable
+        or torn writes keep the previous target (None)."""
+        path = self.devices_file
+        if not path:
+            return None
+        try:
+            with open(path) as f:
+                parts = f.read().split()
+        except OSError:
+            return None
+        try:
+            if len(parts) == 1:
+                procs, devices = len(self.roster), int(parts[0])
+            elif len(parts) >= 2:
+                procs, devices = int(parts[0]), int(parts[1])
+            else:
+                return None
+        except ValueError:
+            return None
+        if procs <= 0 or devices <= 0:
+            return None
+        return procs, devices
+
+    def _returning_peers(self) -> set[int]:
+        backend = getattr(self.health, "backend", None)
+        if backend is None or not self._retired_at:
+            return set()
+        back: set[int] = set()
+        for peer, retired in list(self._retired_at.items()):
+            payload = backend.read(peer)
+            if payload is None:
+                continue
+            try:
+                beat_time = float(payload.get("time", 0.0))
+            except (TypeError, ValueError):
+                continue
+            # Wall time on purpose: retirement stamps wall time too, and the
+            # comparison is against the SAME peer's pre/post-death beats.
+            if beat_time > retired + 1.0:
+                back.add(peer)
+        return back
+
+    def _retire_self(self, target: tuple[int, ...]) -> None:
+        sys.stderr.write(
+            f"[atx elastic] rank {self.process_index} is not in the target "
+            f"roster {target}; requesting preemption (emergency save + "
+            "exit 75) to drain this process\n"
+        )
+        sys.stderr.flush()
+        self._abandoned = True
+        request_preemption()
+
+    def _trigger(self) -> tuple[tuple[int, ...], int, str] | None:
+        roster_set = set(self.roster)
+        stale = (
+            set(self.health.stale_peers) & roster_set
+            if self.health is not None
+            else set()
+        )
+        if stale:
+            survivors = tuple(p for p in self.roster if p not in stale)
+            if not survivors or self.process_index not in survivors:
+                return None
+            return survivors, self.host_devices, "shrink"
+        target = self._read_devices_file()
+        if target is not None:
+            procs, devices = target
+            if (procs, devices) != (len(self.roster), self.host_devices):
+                if procs <= len(self.roster):
+                    survivors = tuple(sorted(roster_set))[:procs]
+                else:
+                    pool = sorted(roster_set | set(self.initial_roster))
+                    while len(pool) < procs:
+                        pool.append(pool[-1] + 1 if pool else 0)
+                    survivors = tuple(pool[:procs])
+                if self.process_index not in survivors:
+                    self._retire_self(survivors)
+                    return None
+                grow = procs * devices > len(self.roster) * self.host_devices
+                return survivors, devices, ("grow" if grow else "shrink")
+        returning = self._returning_peers()
+        if returning:
+            survivors = tuple(sorted(roster_set | returning))
+            return survivors, self.host_devices, "grow"
+        return None
+
+    # -- main entry ----------------------------------------------------------
+    def check(self, step: int) -> TopologyDecision | None:
+        """One step-boundary poll: None (nothing to do) or an agreed
+        decision. Raises `AgreementError` on a failed round — after which
+        the controller disarms itself (the caller is now on the relaunch
+        path and must not re-enter agreement every step)."""
+        if self._abandoned:
+            return None
+        trig = self._trigger()
+        if trig is None:
+            return None
+        survivors, host_devices, kind = trig
+        if self.escalated_at is None:
+            self.escalated_at = self._clock()
+            logger.warning(
+                "[atx elastic] %s escalation at step %d: target %d proc(s) "
+                "x %d device(s), roster %r",
+                kind,
+                step,
+                len(survivors),
+                host_devices,
+                self.roster,
+            )
+        proposal = TopologyDecision(
+            epoch=self.epoch + 1,
+            survivors=survivors,
+            host_devices=host_devices,
+            step=int(step),
+        )
+        try:
+            return self.agreement.agree(proposal, timeout=self.agree_secs)
+        except AgreementError:
+            self._abandoned = True
+            raise
+
+    def adopt(self, decision: TopologyDecision) -> None:
+        """Commit the controller's view after the accelerator finished the
+        reshard: new roster/epoch, retirement stamps for departed ranks
+        (the returning-beat grow trigger keys off these)."""
+        old = set(self.roster)
+        self.epoch = decision.epoch
+        self.roster = decision.survivors
+        self.host_devices = decision.host_devices
+        now = time.time()
+        for p in old - set(decision.survivors):
+            self._retired_at[p] = now
+        for p in set(decision.survivors) - old:
+            self._retired_at.pop(p, None)
+        agree_secs = (
+            self._clock() - self.escalated_at
+            if self.escalated_at is not None
+            else 0.0
+        )
+        self.escalated_at = None
+        self.transitions += 1
+        self.last_transition = {
+            "epoch": decision.epoch,
+            "survivors": decision.survivors,
+            "host_devices": decision.host_devices,
+            "step": decision.step,
+            "agree_secs": agree_secs,
+        }
+
+    def abandon(self) -> None:
+        """Disarm after a failed in-place transition (the caller degraded to
+        the relaunch path)."""
+        self._abandoned = True
+
+
+# ----------------------------------------------------------------- env entry
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def elastic_controller_from_env(
+    *,
+    root: str | None = None,
+    store=None,
+    health=None,
+    process_index: int = 0,
+    num_processes: int = 1,
+    host_devices: int = 1,
+    total_devices: int | None = None,
+) -> ElasticController | None:
+    """Build the controller from the env contract; None unless
+    ``ATX_ELASTIC_SHRINK`` is truthy (opt-in, like the health monitor).
+
+    Agreement surface precedence mirrors `health_from_env`:
+    ``ATX_ELASTIC_DIR`` > replicate ``store`` (under ``elastic/``) >
+    ``<root>/.elastic``. No surface → disabled with a warning."""
+    flag = os.environ.get(ELASTIC_SHRINK_ENV, "").strip().lower()
+    if flag not in ("1", "true", "yes", "on"):
+        return None
+    peers_override = os.environ.get(ELASTIC_PEERS_ENV, "").strip()
+    if peers_override:
+        try:
+            num_processes = int(peers_override)
+        except ValueError:
+            pass
+    if total_devices is not None and num_processes > 0:
+        # Simulated-peer worlds (ATX_ELASTIC_PEERS > real process count):
+        # "per-host" devices is the roster's even share of the mesh.
+        if total_devices % num_processes == 0:
+            host_devices = total_devices // num_processes
+    elastic_dir = os.environ.get(ELASTIC_DIR_ENV, "").strip()
+    if elastic_dir:
+        surface = _FileSurface(elastic_dir)
+    elif store is not None:
+        surface = _StoreSurface(store)
+    elif root:
+        surface = _FileSurface(os.path.join(root, ".elastic"))
+    else:
+        logger.warning(
+            "[atx elastic] %s set but no agreement surface (no %s, no "
+            "replicate store, no checkpoint root); shrink-in-place disabled",
+            ELASTIC_SHRINK_ENV,
+            ELASTIC_DIR_ENV,
+        )
+        return None
+    devices_file = os.environ.get(DEVICES_FILE_ENV, "").strip() or None
+    return ElasticController(
+        surface,
+        process_index,
+        num_processes,
+        host_devices,
+        agree_secs=_env_float(AGREE_SECS_ENV, 30.0),
+        devices_file=devices_file,
+        health=health,
+    )

@@ -117,6 +117,20 @@ class GcsObjectStore(ObjectStore):
         except Exception as e:
             raise self._translate(e, key)
 
+    def get_range(self, key: str, start: int, length: int) -> bytes:
+        if start < 0 or length < 0:
+            raise ValueError(f"invalid range start={start} length={length}")
+        if length == 0:
+            return b""
+        try:
+            # GCS ranges are INCLUSIVE of `end`; an end past the object is
+            # clamped server-side, matching the file-read suffix contract.
+            return self._blob(key).download_as_bytes(
+                start=start, end=start + length - 1
+            )
+        except Exception as e:
+            raise self._translate(e, key)
+
     def stat(self, key: str) -> ObjectStat | None:
         blob = self._bucket.get_blob(self.prefix + key)
         if blob is None:

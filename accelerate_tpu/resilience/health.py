@@ -75,6 +75,12 @@ class _FileBackend:
         except (OSError, ValueError):
             return None
 
+    def delete(self, proc: int) -> None:
+        try:
+            os.remove(os.path.join(self.directory, BEAT_FILE.format(proc=proc)))
+        except OSError:
+            pass
+
     def __repr__(self) -> str:  # pragma: no cover - logging only
         return f"_FileBackend({self.directory!r})"
 
@@ -89,8 +95,8 @@ class _StoreBackend:
 
     def write(self, proc: int, payload: dict[str, Any]) -> None:
         self.store.put_bytes(
-            self.prefix + BEAT_FILE.format(proc=proc),
             json.dumps(payload).encode(),
+            self.prefix + BEAT_FILE.format(proc=proc),
         )
 
     def read(self, proc: int) -> dict[str, Any] | None:
@@ -99,6 +105,12 @@ class _StoreBackend:
             return json.loads(raw.decode())
         except Exception:
             return None
+
+    def delete(self, proc: int) -> None:
+        try:
+            self.store.delete(self.prefix + BEAT_FILE.format(proc=proc))
+        except Exception:
+            pass
 
     def __repr__(self) -> str:  # pragma: no cover - logging only
         return f"_StoreBackend({self.store!r})"
@@ -140,6 +152,11 @@ class PeerHealthMonitor:
         self._escalate = escalate if escalate is not None else request_preemption
         self._abort = abort if abort is not None else self._default_abort
         self._clock = clock
+        # Ranks to scan. Starts as range(num_processes); an elastic shrink
+        # rewrites it via `adopt_roster` (survivor old-ranks are preserved,
+        # so the roster can be non-contiguous after a mid-rank loss).
+        self.roster: tuple[int, ...] = tuple(range(self.num_processes))
+        self._roster_lock = threading.Lock()
         self._seq = 0
         self._step = 0
         # peer -> (last observed seq, clock() when it last advanced, last step)
@@ -184,10 +201,56 @@ class PeerHealthMonitor:
         except Exception as e:  # diagnostics must never kill training
             logger.warning("[atx health] beat write failed: %s", e)
 
+    # -- roster --------------------------------------------------------------
+    def adopt_roster(
+        self,
+        roster,
+        *,
+        process_index: int | None = None,
+        retire_beats: bool = True,
+    ) -> None:
+        """Adopt a new peer set after an elastic shrink/grow.
+
+        ``roster`` is the surviving (old-)rank list. Departed ranks' tracked
+        state and stale flags are dropped and their beat files/objects are
+        deleted (best-effort, idempotent across survivors) — without this a
+        shrunk group would flag the dead peer as stale forever via
+        ``ATX_HEALTH_PEERS``/beat-dir scans. Re-added ranks start with the
+        never-seen startup grace."""
+        new = tuple(sorted(int(p) for p in roster))
+        with self._roster_lock:
+            departed = set(self.roster) - set(new)
+            self.roster = new
+            self.num_processes = len(new)
+            if process_index is not None:
+                self.process_index = int(process_index)
+            for peer in departed:
+                self._peer_state.pop(peer, None)
+                self.stale_peers.discard(peer)
+                if retire_beats:
+                    try:
+                        self.backend.delete(peer)
+                    except Exception as e:  # pragma: no cover - best-effort
+                        logger.warning(
+                            "[atx health] beat retirement for peer %d "
+                            "failed: %s",
+                            peer,
+                            e,
+                        )
+        if departed:
+            logger.warning(
+                "[atx health] roster adopted: %d peer(s) now %r (retired %r)",
+                len(new),
+                new,
+                sorted(departed),
+            )
+
     # -- monitor side --------------------------------------------------------
     def _scan_peers(self) -> None:
         now = self._clock()
-        for peer in range(self.num_processes):
+        with self._roster_lock:
+            roster = self.roster
+        for peer in roster:
             if peer == self.process_index:
                 continue
             payload = self.backend.read(peer)

@@ -103,6 +103,19 @@ class ObjectStore:
     def get_bytes(self, key: str) -> bytes:
         raise NotImplementedError
 
+    def get_range(self, key: str, start: int, length: int) -> bytes:
+        """``length`` bytes of the object starting at byte ``start``.
+
+        Reads past the end of the object return the available suffix (like
+        a file read), so callers can over-ask for zip tails. The base
+        implementation downloads the whole object and slices — correct for
+        any store; Local/GCS override with true ranged reads so the elastic
+        reshard path fetches only the byte ranges a leaf needs.
+        """
+        if start < 0 or length < 0:
+            raise ValueError(f"invalid range start={start} length={length}")
+        return self.get_bytes(key)[start : start + length]
+
     def exists(self, key: str) -> bool:
         return self.stat(key) is not None
 
@@ -171,6 +184,16 @@ class LocalObjectStore(ObjectStore):
             raise ObjectStoreError(f"no object {key!r} in {self.root}")
         with open(src, "rb") as f:
             return f.read()
+
+    def get_range(self, key: str, start: int, length: int) -> bytes:
+        if start < 0 or length < 0:
+            raise ValueError(f"invalid range start={start} length={length}")
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no object {key!r} in {self.root}")
+        with open(src, "rb") as f:
+            f.seek(start)
+            return f.read(length)
 
     def stat(self, key: str) -> ObjectStat | None:
         path = self._path(key)

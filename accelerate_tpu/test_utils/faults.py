@@ -50,6 +50,20 @@ Instrumented points:
 ``replicate.before_marker``     every part + manifest uploaded, remote
                                 ``COMMIT`` marker NOT yet written (the
                                 remote durability boundary)
+``restore.peer_shard_fetched``  one peer shard file fetched + verified into
+                                the local checkpoint dir, next NOT yet
+                                (`checkpointing._ensure_shard_coverage`)
+``shrink.agreement_proposed``   this process's topology proposal is written
+                                to the agreement surface, decision NOT yet
+                                reached (`resilience/elastic.py`)
+``shrink.before_reshard``       topology decision adopted, live state NOT
+                                yet mutated — a fault here must degrade to
+                                the exit-75 relaunch path with the prior
+                                committed checkpoint intact
+``shrink.peer_slice_fetched``   one shard byte-range fetched from the
+                                replicate store during an in-memory
+                                reshard, next NOT yet
+                                (`checkpointing.StoreShardSource`)
 ==============================  =================================================
 """
 

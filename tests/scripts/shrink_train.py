@@ -1,0 +1,181 @@
+"""Shrink-in-place subprocess driver (tests/test_shrink.py).
+
+Deterministic tiny FSDP run with the LIVE elastic path armed
+(``ATX_ELASTIC_SHRINK=1``): at ``--retarget_at K`` the driver rewrites the
+``ATX_ELASTIC_DEVICES_FILE`` target (``"P H"``) and pre-seeds the virtual
+peers' agreement proposals (``ATX_ELASTIC_PEERS`` simulates an 8-rank
+roster on one real process, one simulated device per rank), so the NEXT
+step entry escalates, agrees, and reshards params/opt-state/step in
+memory — no relaunch, the loop just keeps stepping on the smaller mesh.
+``--retarget2_at`` arms a second transition (the grow-back leg).
+
+``data=1`` keeps every batch fully replicated, so the loss trajectory is
+comparable across device counts (up to reduction order) and a post-shrink
+run must track a never-interrupted reference at the small size.
+
+- ``--no_seed``: do NOT seed peer proposals — the agreement round times
+  out (``ATX_ELASTIC_AGREE_SECS``) and the driver must degrade to the
+  emergency-save + exit-75 relaunch path.
+- ``--save_at K`` / ``--resume``: committed save / ``resume="latest"``
+  restore, as in elastic_train.py (the relaunch fallback leg).
+- ``--dump PATH``: final step + every (params, opt_state) leaf to an npz,
+  the bit-accuracy oracle for Adam moments across a shrink.
+
+Appends ``<step> <loss.hex()>`` lines to ``--loss_file``; ends with
+``[shrink_train] DONE``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--loss_file", required=True)
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--save_at", type=int, default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--dump", default=None)
+    ap.add_argument("--retarget_at", type=int, default=None)
+    ap.add_argument("--retarget", default=None, help='"P H" devices-file target')
+    ap.add_argument("--retarget2_at", type=int, default=None)
+    ap.add_argument("--retarget2", default=None)
+    ap.add_argument("--no_seed", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import accelerate_tpu as atx
+    from accelerate_tpu.parallel import MeshConfig
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    n_dev = args.devices or len(jax.devices())
+    acc = atx.Accelerator(
+        mesh_config=MeshConfig(data=1, fsdp=n_dev, devices=jax.devices()[:n_dev]),
+        strategy="FSDP",
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir,
+            automatic_checkpoint_naming=True,
+            total_limit=5,
+        ),
+        seed=0,
+    )
+    print(f"[shrink_train] mesh devices={acc.mesh.devices.size}", flush=True)
+
+    @acc.on_topology_change
+    def _log_topology(old, new, decision):
+        print(
+            f"[shrink_train] TOPOLOGY {old['num_devices']} -> "
+            f"{new['num_devices']} epoch={decision.epoch}",
+            flush=True,
+        )
+
+    def init_fn(rng):
+        # 48 divides evenly over fsdp=8 AND fsdp=6, so the per-leaf
+        # partition specs survive the resize unchanged.
+        return {
+            "w": jax.random.normal(rng, (48, 48), jnp.float32) * 0.1,
+            "b": jnp.zeros((48,), jnp.float32),
+        }
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    state = acc.create_train_state(init_fn, optax.adam(1e-2))
+    step = acc.make_train_step(loss_fn)
+
+    start = 0
+    if args.resume:
+        state = acc.load_state(None, state, resume="latest")
+        start = int(jax.device_get(state.step))
+        print(f"[shrink_train] resumed at step {start}", flush=True)
+
+    retargets: dict[int, str] = {}
+    if args.retarget_at is not None:
+        retargets[args.retarget_at] = args.retarget
+    if args.retarget2_at is not None:
+        retargets[args.retarget2_at] = args.retarget2
+
+    epoch = [0]
+
+    def apply_retarget(i: int, spec: str) -> None:
+        from accelerate_tpu.resilience import elastic as el
+
+        procs, host = (int(t) for t in spec.split())
+        dfile = os.environ[el.DEVICES_FILE_ENV]
+        with open(dfile + ".tmp", "w") as f:
+            f.write(f"{procs} {host}\n")
+        os.replace(dfile + ".tmp", dfile)
+        epoch[0] += 1
+        if not args.no_seed:
+            # Play the virtual peers' side of the round: each survivor
+            # would have written an identical proposal for this epoch.
+            ctl = acc._elastic
+            surface = el._FileSurface(os.environ[el.ELASTIC_DIR_ENV])
+            roster_set = set(ctl.roster)
+            if procs <= len(ctl.roster):
+                survivors = tuple(sorted(roster_set))[:procs]
+            else:
+                pool = sorted(roster_set | set(ctl.initial_roster))
+                while len(pool) < procs:
+                    pool.append(pool[-1] + 1)
+                survivors = tuple(pool[:procs])
+            decision = el.TopologyDecision(
+                epoch=epoch[0],
+                survivors=survivors,
+                host_devices=host,
+                step=i + 1,  # the escalation fires at the NEXT step entry
+            )
+            el.post_peer_proposals(
+                surface,
+                [p for p in survivors if p != ctl.process_index],
+                decision,
+            )
+        print(f"[shrink_train] retarget at step {i}: {procs} x {host}", flush=True)
+
+    def make_batch(i: int):
+        rng = np.random.default_rng(1234 + i)
+        return {
+            "x": jnp.asarray(rng.normal(size=(16, 48)).astype(np.float32)),
+            "y": jnp.asarray(rng.normal(size=(16, 48)), jnp.float32),
+        }
+
+    with open(args.loss_file, "a") as out:
+        for i in range(start, args.steps):
+            state, metrics = step(state, make_batch(i))
+            out.write(f"{i} {float(jax.device_get(metrics['loss'])).hex()}\n")
+            out.flush()
+            if args.save_at is not None and i == args.save_at:
+                acc.save_state(None, state)
+            if i in retargets:
+                apply_retarget(i, retargets[i])
+
+    if args.dump:
+        leaves = jax.tree_util.tree_leaves((state.params, state.opt_state))
+        arrs = {
+            f"leaf{j}": np.asarray(jax.device_get(leaf))
+            for j, leaf in enumerate(leaves)
+        }
+        arrs["step"] = np.asarray(int(jax.device_get(state.step)))
+        np.savez(args.dump, **arrs)
+
+    transitions = acc._elastic.transitions if acc._elastic is not None else 0
+    print(
+        f"[shrink_train] transitions={transitions} mesh={acc.mesh.devices.size}",
+        flush=True,
+    )
+    acc.end_training()
+    print("[shrink_train] DONE", flush=True)
+
+
+main()
