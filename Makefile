@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -113,8 +113,18 @@ smoke-shrink:
 		python -m accelerate_tpu.commands.cli lint shrink --multihost 2 \
 		--severity error
 
+# CPU kernel-tier lane (docs/performance.md, "Pallas kernel tier"):
+# interpret-mode parity of every Pallas kernel against its exact fallback
+# lowering (flash-decode attention incl. GQA/ragged cursors/int8 KV,
+# int8/fp8 fused matmul fwd+bwd, fused AdamW), dispatch-knob resolution,
+# and `atx lint kernels` over the kernel-enabled decode + train steps
+# (error-severity ATX findings fail the lane).
+smoke-kernels:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_kernels.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint kernels --severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels
 	python -m pytest tests/ -q --heavy

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import atexit
 import contextlib
+import hashlib
 import io
 import json
 import logging
@@ -231,48 +232,94 @@ def _assemble_slice(
 
 
 class _ShardReader:
-    """Lazily-opened view over every process's shard files in a directory."""
+    """Lazily-opened view over every process's shard files in a directory.
 
-    def __init__(self, directory: str) -> None:
+    ``remote`` optionally maps procs whose files are NOT in the directory to
+    ``(store, npz_key, index)`` refs from `_ensure_shard_coverage`: their
+    shard members are fetched by byte range (`read_npz_member`) straight
+    from the replicate store — nothing is downloaded into the committed
+    directory. A remote ref wins over a partial local copy (coverage only
+    hands out refs for procs whose local index+shards pair is incomplete,
+    e.g. debris from an interrupted whole-file fetch).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        remote: dict[int, tuple[Any, str, dict]] | None = None,
+    ) -> None:
         self.directory = directory
         self.index: dict[str, Any] = {}
         # leaf key -> list of (starts, shape, proc)
         self.shard_table: dict[str, list[tuple[tuple[int, ...], tuple[int, ...], int]]] = {}
         self._files: dict[int, Any] = {}
         self._array_cache: dict[tuple[int, str], np.ndarray] = {}
+        self._remote: dict[int, tuple[Any, str]] = {}
+        self._remote_entries: dict[int, dict[str, tuple[int, int, int]]] = {}
+        remote = remote or {}
         procs = []
         for name in sorted(os.listdir(directory)):
             m = re.match(r"^index_(\d+)\.json$", name)
             if not m:
                 continue
             proc = int(m.group(1))
+            if proc in remote:
+                continue
             procs.append(proc)
             with open(os.path.join(directory, name)) as f:
                 idx = json.load(f)
-            for key, entry in idx.items():
-                if "shards" in entry:
-                    base = self.index.setdefault(key, {k: entry[k] for k in ("shape", "dtype")})
-                    base.setdefault("shards", True)
-                    for sh in entry["shards"]:
-                        self.shard_table.setdefault(key, []).append(
-                            (tuple(sh["starts"]), tuple(sh["shape"]), proc)
-                        )
-                else:
-                    self.index.setdefault(key, entry)
+            self._merge_index(idx, proc)
+        for proc, (store, npz_key, idx) in sorted(remote.items()):
+            procs.append(proc)
+            self._remote[proc] = (store, npz_key)
+            self._merge_index(idx, proc)
         if not procs:
             raise FileNotFoundError(f"No checkpoint index files in {directory}")
+
+    def _merge_index(self, idx: dict, proc: int) -> None:
+        for key, entry in idx.items():
+            if "shards" in entry:
+                base = self.index.setdefault(key, {k: entry[k] for k in ("shape", "dtype")})
+                base.setdefault("shards", True)
+                for sh in entry["shards"]:
+                    self.shard_table.setdefault(key, []).append(
+                        (tuple(sh["starts"]), tuple(sh["shape"]), proc)
+                    )
+            else:
+                self.index.setdefault(key, entry)
 
     def _npz(self, proc: int) -> Any:
         if proc not in self._files:
             self._files[proc] = np.load(os.path.join(self.directory, f"shards_{proc}.npz"))
         return self._files[proc]
 
+    def _remote_member(self, proc: int, skey: str) -> np.ndarray:
+        store, npz_key = self._remote[proc]
+        try:
+            entries = self._remote_entries.get(proc)
+            if entries is None:
+                entries = self._remote_entries[proc] = _zip_entries(store, npz_key)
+            arr = read_npz_member(store, npz_key, skey, entries=entries)
+        except Exception as e:
+            # Anything wrong with the remote copy (corrupt archive, store
+            # error) must surface as a coverage failure so resume="latest"
+            # falls back to the previous committed checkpoint instead of
+            # resuming on a partial reshard.
+            raise CheckpointShardCoverageError(
+                f"ranged read of shard {skey!r} from {npz_key!r} failed: {e}"
+            ) from e
+        _fault_point("restore.peer_slice_fetched")
+        return arr
+
     def _shard_array(self, proc: int, skey: str) -> np.ndarray:
         # NpzFile re-reads the zip member on every access; resharding loads
         # touch the same shard once per target device, so cache decoded arrays.
         cached = self._array_cache.get((proc, skey))
         if cached is None:
-            cached = self._npz(proc)[skey]
+            if proc in self._remote:
+                cached = self._remote_member(proc, skey)
+            else:
+                cached = self._npz(proc)[skey]
             self._array_cache[(proc, skey)] = cached
         return cached
 
@@ -331,14 +378,16 @@ def _index_has_prefix(directory: str, prefix: str) -> bool:
     return False
 
 
-def load_pytree(target: Any, directory: str) -> Any:
+def load_pytree(target: Any, directory: str, remote_shards: dict | None = None) -> Any:
     """Restore a pytree saved with `save_pytree` into ``target``'s structure.
 
     jax.Array leaves are rebuilt with their **current** shardings (each device
     fetches exactly its slice — topology-independent resharding); other
     leaves come from the JSON index. Raises KeyError on missing leaves.
+    ``remote_shards`` (from `_ensure_shard_coverage`) maps procs whose shard
+    files are not local to ranged-read refs into the replicate store.
     """
-    reader = _ShardReader(directory)
+    reader = _ShardReader(directory, remote=remote_shards)
     flat, treedef = jax.tree_util.tree_flatten_with_path(target)
     out = []
     try:
@@ -1352,23 +1401,30 @@ def saved_topology(input_dir: str) -> dict | None:
 
 def _ensure_shard_coverage(
     accelerator: "Accelerator", input_dir: str, saved: dict | None
-) -> None:
+) -> dict[int, tuple[Any, str, dict]]:
     """Elastic-restore prelude: make every saved process's shard files
-    reachable from THIS directory before `load_pytree` assembles globals.
+    reachable before `load_pytree` assembles globals.
 
     On a shared filesystem all ``index_<p>.json``/``shards_<p>.npz`` files
-    are already local and this is a no-op. With ``save_on_each_node`` (or a
-    partially-lost root) the peers' files live under the replicate store —
-    ``node_<p>/<name>/`` prefixes, or the flat ``<name>/`` prefix the
-    shared-fs Replicator uploads everything under. Fetches are atomic
-    (``.fetch`` tmp + rename) and verified against the peer's remote
-    manifest when one exists; anything still missing surfaces later as
+    are already local and this returns ``{}``. With ``save_on_each_node``
+    (or a partially-lost root) the peers' files live under the replicate
+    store — ``node_<p>/<name>/`` prefixes, or the flat ``<name>/`` prefix
+    the shared-fs Replicator uploads everything under. For those procs the
+    (small) JSON index is read into memory and verified against the peer's
+    remote manifest; the returned refs make `_ShardReader` fetch individual
+    shard members by byte range (``ObjectStore.get_range``, same machinery
+    as the live-shrink `StoreShardSource`) instead of streaming whole
+    archives — a reshard that needs a few rows of a peer's npz no longer
+    downloads all of it, and nothing is ever written into the committed
+    directory. ``ATX_RESTORE_RANGED=0`` restores the legacy behaviour
+    (atomic whole-file download + rename into the checkpoint dir). Anything
+    still missing or corrupt surfaces later as
     `CheckpointShardCoverageError` (never a silent partial reshard).
     """
     model_dir = os.path.join(input_dir, MODEL_DIR)
     want = int((saved or {}).get("num_processes") or 0)
     if want <= 1:
-        return
+        return {}
     have: set[int] = set()
     if os.path.isdir(model_dir):
         for name in os.listdir(model_dir):
@@ -1381,7 +1437,7 @@ def _ensure_shard_coverage(
                 have.add(int(m.group(1)))
     missing = [p for p in range(want) if p not in have]
     if not missing:
-        return
+        return {}
     replicator = getattr(accelerator, "_replicator", None)
     store = replicator.store if replicator is not None else _replicate.store_from_env()
     if store is None:
@@ -1393,8 +1449,102 @@ def _ensure_shard_coverage(
             input_dir,
             len(missing),
         )
-        return
+        return {}
     name = os.path.basename(os.path.abspath(input_dir))
+    ranged = os.environ.get("ATX_RESTORE_RANGED", "1").strip().lower() not in (
+        "0",
+        "off",
+        "false",
+        "no",
+    )
+    if not ranged:
+        _fetch_peer_shards_whole(input_dir, store, name, missing)
+        return {}
+    refs: dict[int, tuple[Any, str, dict]] = {}
+    for p in missing:
+        ref = _remote_shard_ref(store, name, p, input_dir)
+        if ref is not None:
+            refs[p] = ref
+            logger.info(
+                "elastic restore of %s: process %d's shards will be read by "
+                "byte range from %r",
+                input_dir,
+                p,
+                store,
+            )
+        else:
+            logger.warning(
+                "elastic restore of %s: process %d's shard files are not in "
+                "%r either — the restore fails with "
+                "CheckpointShardCoverageError if any leaf needs them",
+                input_dir,
+                p,
+                store,
+            )
+    return refs
+
+
+def _remote_shard_ref(
+    store: Any, name: str, proc: int, input_dir: str
+) -> tuple[Any, str, dict] | None:
+    """Locate process ``proc``'s checkpoint under the store and return a
+    ``(store, npz_key, index)`` ranged-read ref, or ``None`` when neither
+    prefix has it. Only the JSON index is transferred (and sha-verified
+    against the peer's remote manifest when one exists); shard bytes stay
+    remote until a leaf actually needs them."""
+    idx_rel = f"{MODEL_DIR}/{INDEX_FILE.format(proc=proc)}"
+    npz_rel = f"{MODEL_DIR}/{SHARDS_FILE.format(proc=proc)}"
+    for prefix in (f"node_{proc}/{name}", name):
+        try:
+            if not store.exists(f"{prefix}/{idx_rel}"):
+                continue
+            raw = store.get_bytes(f"{prefix}/{idx_rel}")
+            _verify_remote_bytes(store, prefix, proc, idx_rel, raw)
+            index = json.loads(raw.decode())
+        except Exception as e:
+            logger.warning(
+                "elastic restore of %s: reading process %d's index from "
+                "%r/%s failed: %s",
+                input_dir,
+                proc,
+                store,
+                prefix,
+                e,
+            )
+            continue
+        _fault_point("restore.peer_shard_fetched")
+        return store, f"{prefix}/{npz_rel}", index
+    return None
+
+
+def _verify_remote_bytes(
+    store: Any, prefix: str, proc: int, rel: str, raw: bytes
+) -> None:
+    """Best-effort hash check of in-memory remote bytes against the peer's
+    remote manifest — the ranged-path twin of `_verify_fetched_shards`. A
+    store with no manifest passes (read_slice coverage is the backstop)."""
+    try:
+        manifest = json.loads(
+            store.get_bytes(
+                f"{prefix}/{_commit.MANIFEST_FILE.format(proc=proc)}"
+            ).decode()
+        )
+    except Exception:
+        return
+    info = manifest.get("files", {}).get(rel)
+    if info is not None and hashlib.sha256(raw).hexdigest() != info["sha256"]:
+        raise ValueError(
+            f"fetched {rel} does not match process {proc}'s remote manifest"
+        )
+
+
+def _fetch_peer_shards_whole(
+    input_dir: str, store: Any, name: str, missing: list[int]
+) -> None:
+    """Legacy (``ATX_RESTORE_RANGED=0``) coverage: download each missing
+    process's index+shards pair whole into the checkpoint directory.
+    Fetches are atomic (``.fetch`` tmp + rename) and verified against the
+    peer's remote manifest when one exists."""
     for p in missing:
         rels = [
             f"{MODEL_DIR}/{INDEX_FILE.format(proc=p)}",
@@ -1627,6 +1777,7 @@ def _load_state_dir(
     dataloaders: Iterable[Any] | None = None,
 ) -> "TrainState":
     saved = saved_topology(input_dir)
+    remote_shards: dict | None = None
     if not _mesh.topology_matches(saved, accelerator.mesh):
         # Elastic reshard-on-restore: the pod came back at a different
         # size/slice. The on-disk format is already topology-independent
@@ -1642,14 +1793,14 @@ def _load_state_dir(
             _mesh.describe_topology(saved),
             _mesh.describe_topology(_mesh.topology_signature(accelerator.mesh)),
         )
-        _ensure_shard_coverage(accelerator, input_dir, saved)
+        remote_shards = _ensure_shard_coverage(accelerator, input_dir, saved)
     model_dir = os.path.join(input_dir, MODEL_DIR)
     target = {"step": state.step, "params": state.params, "opt_state": state.opt_state}
     if state.loss_scale is not None and _index_has_prefix(model_dir, "loss_scale"):
         # Only restore the scaler when the checkpoint has one: an fp16 resume
         # from a pre-scaler (or bf16-trained) checkpoint keeps the fresh scaler.
         target["loss_scale"] = state.loss_scale
-    restored = load_pytree(target, model_dir)
+    restored = load_pytree(target, model_dir, remote_shards=remote_shards)
 
     rng_path = os.path.join(input_dir, RNG_FILE.format(proc=jax.process_index()))
     if not os.path.exists(rng_path):

@@ -249,11 +249,70 @@ def _scenario_serving(**options: Any):
     )
 
 
+def _scenario_kernels(**options: Any):
+    """Pallas kernel tier (`native/pallas/`): the serving decode step and a
+    host-offloaded-AdamW train step with every kernel forced into interpret
+    mode — proving the kernel lowerings keep the donation and host-sync
+    contracts (no new ATX2xx/3xx findings relative to the fallbacks the
+    other scenarios lint)."""
+    import jax
+    import numpy as np
+
+    from .. import analysis
+    from ..generation import GenerationConfig
+    from ..models import gpt, llama
+    from ..native.pallas import force_kernels
+    from ..parallel import host_offload
+    from ..serving import Engine
+
+    config = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
+    params = llama.init(jax.random.PRNGKey(0), config)
+    findings: list = []
+    with force_kernels("interpret"):
+        engine = Engine(
+            lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+            lambda b, m: llama.init_cache(config, b, m),
+            params,
+            GenerationConfig(eos_token_id=0),
+            slots=4,
+            buckets=(16, 32),
+            max_len=96,
+        )
+        report = analysis.lint_step(
+            engine._decode_fn,
+            *engine.abstract_decode_args(),
+            donate_argnums=(3,),
+            target="kernels.decode_attn",
+            **options,
+        )
+        findings += report.findings
+
+        acc = _fresh_accelerator(mixed_precision="bf16", max_grad_norm=1.0)
+        gpt_config = gpt.GPTConfig(
+            vocab_size=128, d_model=128, n_layers=4, num_heads=4, d_ff=512,
+            max_seq_len=64,
+        )
+        batch = {"input_ids": np.zeros((8, 64), np.int32)}
+        train_report = analysis.lint_training(
+            acc,
+            lambda r: gpt.init(r, gpt_config),
+            host_offload.host_offloaded_adamw(3e-3),
+            lambda params, b, rng: gpt.loss_fn(params, b, gpt_config, rng),
+            batch,
+            target="kernels.fused_adamw",
+            **options,
+        )
+        findings += train_report.findings
+    desc = "kernel-tier decode + fused-AdamW train step, interpret mode"
+    return desc, analysis.Report(findings=findings, target="kernels")
+
+
 SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "nlp_example": _scenario_nlp_example,
     "lm_example": _scenario_lm_example,
     "cv_example": _scenario_cv_example,
     "serving": _scenario_serving,
+    "kernels": _scenario_kernels,
 }
 
 

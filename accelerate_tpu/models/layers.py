@@ -350,6 +350,38 @@ def dot_product_attention(
     return out.reshape(B, S, H, h)
 
 
+def cached_decode_attention(
+    q: jax.Array,
+    k_full: jax.Array,
+    v_full: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    lengths: jax.Array | None = None,
+    kv_raw=None,
+    window: int | None = None,
+) -> jax.Array:
+    """Decode-step attention over a slot KV cache.
+
+    Routes through the `native/pallas` flash-decode kernel when the
+    `decode_attn` kernel is enabled and the shapes are supported (single
+    query token, no sliding window, cursor-masked by ``lengths``); otherwise
+    the reference `dot_product_attention` with the full cache ``mask`` — the
+    exact current lowering, so with kernels off this function is
+    byte-identical to calling the reference directly. ``kv_raw`` optionally
+    carries the raw int8 cache + scales so the kernel fuses the dequant.
+    """
+    if lengths is not None and window is None and q.shape[1] == 1:
+        try:
+            from ..native.pallas.decode_attention import maybe_flash_decode
+        except Exception:  # pragma: no cover - environment dependent
+            maybe_flash_decode = None
+        if maybe_flash_decode is not None:
+            out = maybe_flash_decode(q, k_full, v_full, lengths, kv_raw=kv_raw)
+            if out is not None:
+                return out
+    return dot_product_attention(q, k_full, v_full, mask=mask)
+
+
 # ------------------------------------------------------------------ attention block
 @dataclasses.dataclass(frozen=True)
 class AttentionSpec:

@@ -38,6 +38,7 @@ from .layers import (
     cache_positions,
     cache_write,
     cache_write_stacked,
+    cached_decode_attention,
     cross_entropy_loss,
     dot_product_attention,
     init_attention,
@@ -502,8 +503,17 @@ def forward_with_cache(
     # time and both paths are numerically identical (tested).
     carry_cache = max_len >= CARRY_CACHE_MIN_LEN
 
-    def attend(block, x, q, k_full, v_full):
-        attn = dot_product_attention(q, k_full, v_full, mask=mask)
+    # Decode steps (T_new == 1) may take the Pallas flash-decode kernel:
+    # valid prefix per row after the write is positions[:, 0] + 1 (works for
+    # the scalar cursor and the per-row speculative cursors alike). Prefill
+    # and sliding-window configs always run the masked reference attention.
+    decode_lengths = positions[:, 0] + 1 if T_new == 1 else None
+
+    def attend(block, x, q, k_full, v_full, kv_raw=None):
+        attn = cached_decode_attention(
+            q, k_full, v_full, mask=mask, lengths=decode_lengths,
+            kv_raw=kv_raw, window=config.sliding_window,
+        )
         x = x + attention_out(block["attn"], attn)
         h = rms_norm(x, block["mlp_norm"], config.norm_eps)
         ffn_out, _ = _ffn(block, h, config)  # aux unused at inference
@@ -538,12 +548,16 @@ def forward_with_cache(
                 # Dequant stays elementwise on the sliced layer: HBM reads int8.
                 k_full = _dequant_kv(k_layer, ks_layer, q_dtype)
                 v_full = _dequant_kv(v_layer, vs_layer, q_dtype)
+                # Raw cache for the flash-decode kernel: when it runs, the
+                # dequantized copies above are dead and XLA drops them.
+                kv_raw = (k_layer, ks_layer, v_layer, vs_layer)
             else:
                 k_all, k_layer = _update_layer(k_all, i, k)
                 v_all, v_layer = _update_layer(v_all, i, v)
                 k_full = k_layer.astype(q_dtype)
                 v_full = v_layer.astype(q_dtype)
-            x = attend(block, x, q, k_full, v_full)
+                kv_raw = None
+            x = attend(block, x, q, k_full, v_full, kv_raw)
             if int8_kv:
                 return (x, k_all, v_all, ks_all, vs_all, i + 1), None
             return (x, k_all, v_all, i + 1), None
@@ -582,12 +596,14 @@ def forward_with_cache(
                 v_sc = cache_write(v_sc, vs, start)
                 k_full = _dequant_kv(k_cache, k_sc, q_dtype)
                 v_full = _dequant_kv(v_cache, v_sc, q_dtype)
+                kv_raw = (k_cache, k_sc, v_cache, v_sc)
             else:
                 k_cache = cache_write(k_cache, k, start)
                 v_cache = cache_write(v_cache, v, start)
                 k_full = k_cache.astype(q_dtype)
                 v_full = v_cache.astype(q_dtype)
-            x = attend(block, x, q, k_full, v_full)
+                kv_raw = None
+            x = attend(block, x, q, k_full, v_full, kv_raw)
             if int8_kv:
                 return x, (k_cache, v_cache, k_sc, v_sc)
             return x, (k_cache, v_cache)

@@ -1,0 +1,25 @@
+"""Pallas hot-path kernel tier (ROADMAP direction 3).
+
+Custom TPU kernels for the three measured hot paths the XLA lowerings leave
+on the table (BENCH_r05): flash-decode attention over the slot KV cache
+(`kv16k_int8_speedup` 1.016 — decode attention ignores KV-quantization
+bandwidth headroom), fused quantize→dot→rescale matmuls for the int8/fp8
+paths (`fp8_matmul_speedup` 1.004 — fp8 round-trips through XLA's upcast),
+and a single-pass fused AdamW update (`hostoffload_adamw_mfu` 0.0898).
+
+Every kernel sits behind the dispatch-by-availability registry in
+`dispatch.py`: TPU backend + pallas importable + shape/dtype supported →
+kernel; anything else → the exact current lowering, byte-identical to a
+build without this package. `ATX_KERNELS` / `ATX_KERNEL_<NAME>` force any
+kernel off, on, or into interpret mode (the CPU bit-parity test path).
+"""
+
+from __future__ import annotations
+
+from .dispatch import (  # noqa: F401
+    force_kernels,
+    kernel_mode,
+    kernel_status,
+    pallas_available,
+    register_kernel,
+)

@@ -1,0 +1,230 @@
+"""Flash-decode attention over the slot KV cache.
+
+Single query row per sequence (decode: T_new == 1) attending over the whole
+cached prefix, split-K over the cache length with an online-softmax merge —
+the FlashDecoding / PagedAttention-style kernel reduced to our static-shape
+slot cache. Each (batch, kv-head) program walks the cache-length axis in
+blocks, carrying running max / normalizer / accumulator in VMEM scratch, and
+masks by the host-shipped length cursor so the padded slot tail never enters
+the softmax.
+
+The int8-KV variant dequantizes inside the kernel (``k * scale`` per cache
+block) — that is the bandwidth win the kv16k bench measures: the fallback
+lowering materializes the full bf16 dequant copy of a 16k-token cache before
+a single attention flop, this kernel reads the int8 bytes once. When the
+kernel takes the quantized operands the call site's dequantized copies are
+dead and XLA drops them.
+
+Parity vs `models.layers.dot_product_attention` is to tolerance, not bitwise:
+the oracle computes one full-row softmax, this kernel merges per-block
+partials (both in f32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import kernel_mode, pallas_available, register_kernel
+
+register_kernel(
+    "decode_attn",
+    "single-query flash-decode over the slot KV cache (bf16 + int8-dequant)",
+)
+
+if pallas_available():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ...ops.flash_attention import _NEG_INF, pick_block, tuned_call_kwargs
+else:  # pragma: no cover - environment dependent
+    pl = pltpu = None
+    _NEG_INF = -1e30
+
+    def pick_block(dim, candidates=(512, 256, 128, 64, 32, 16, 8)):
+        return None
+
+
+def _decode_kernel(
+    len_ref,
+    q_ref,
+    k_ref,
+    ks_ref,
+    v_ref,
+    vs_ref,
+    o_ref,
+    m_s,
+    l_s,
+    acc_s,
+    *,
+    scale: float,
+    blk: int,
+    n_blocks: int,
+):
+    """One (B, K) program; grid axis 2 walks the cache length (carried)."""
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    length = len_ref[0, 0]
+
+    # Blocks entirely past the cursor contribute nothing — skip the flops
+    # (this is where short sequences in a long-max_len cache win).
+    @pl.when(t * blk < length)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # (group, h)
+        k = k_ref[0, 0].astype(jnp.float32)  # (blk, h)
+        if ks_ref is not None:
+            k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (group, blk)
+        cols = t * blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, _NEG_INF)
+
+        m_prev = m_s[...]  # (group, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (group, blk)
+
+        v = v_ref[0, 0].astype(jnp.float32)  # (blk, h)
+        if vs_ref is not None:
+            v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
+
+        l_s[...] = l_s[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_s[...] = m_new
+
+    @pl.when(t == n_blocks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_s[...] / jnp.maximum(l_s[...], 1e-30)).astype(o_ref.dtype)
+
+
+def supported(q: jax.Array, k: jax.Array) -> bool:
+    """Shape support: one query token per row, GQA-divisible heads, and a
+    cache length some tile divides exactly (the kernel never pads)."""
+    if q.ndim != 4 or k.ndim != 4 or q.shape[1] != 1:
+        return False
+    B, _, H, h = q.shape
+    T, K = k.shape[1], k.shape[2]
+    if k.shape[0] != B or k.shape[3] != h or H % K != 0:
+        return False
+    return pick_block(T) is not None
+
+
+def flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """q: (B, 1, H, h); k/v: (B, T, K, h) cache buffers (bf16/f32, or int8
+    with per-(token, head) ``*_scale`` of shape (B, T, K)); lengths: () or
+    (B,) valid-prefix cursors. Returns (B, 1, H, h) in q's dtype."""
+    B, S, H, h = q.shape
+    if S != 1:
+        raise ValueError(f"flash_decode is single-query only, got T_new={S}")
+    T, K = k.shape[1], k.shape[2]
+    group = H // K
+    blk = pick_block(T)
+    if blk is None:
+        raise ValueError(f"no block tile divides cache length {T}")
+    n_blocks = T // blk
+    scale = scale if scale is not None else float(1.0 / (h**0.5))
+
+    qt = q.reshape(B, K, group, h)  # head = kk * group + g, the oracle's layout
+    kt = k.transpose(0, 2, 1, 3)  # (B, K, T, h)
+    vt = v.transpose(0, 2, 1, 3)
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32).reshape(-1, 1), (B, 1))
+
+    qkv_specs = [
+        pl.BlockSpec((1, 1, group, h), lambda b, kk, t: (b, kk, 0, 0)),
+        pl.BlockSpec((1, 1, blk, h), lambda b, kk, t: (b, kk, t, 0)),
+    ]
+    scale_spec = pl.BlockSpec((1, 1, blk), lambda b, kk, t: (b, kk, t))
+    len_spec = pl.BlockSpec((1, 1), lambda b, kk, t: (b, 0))
+
+    operands = [lengths, qt, kt]
+    in_specs = [len_spec, qkv_specs[0], qkv_specs[1]]
+    if k_scale is not None:
+        operands.append(k_scale.transpose(0, 2, 1))
+        in_specs.append(scale_spec)
+    operands.append(vt)
+    in_specs.append(qkv_specs[1])
+    if v_scale is not None:
+        operands.append(v_scale.transpose(0, 2, 1))
+        in_specs.append(scale_spec)
+
+    kernel = functools.partial(
+        _kernel_with_optionals,
+        has_ks=k_scale is not None,
+        has_vs=v_scale is not None,
+        scale=scale,
+        blk=blk,
+        n_blocks=n_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, K, n_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, group, h), lambda b, kk, t: (b, kk, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K, group, h), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, h), jnp.float32),
+        ],
+        **tuned_call_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+    )(*operands)
+    return out.reshape(B, 1, H, h)
+
+
+def _kernel_with_optionals(len_ref, q_ref, k_ref, *rest, has_ks, has_vs, **kw):
+    """Unpack the optional scale operands into the fixed-arity kernel."""
+    rest = list(rest)
+    ks_ref = rest.pop(0) if has_ks else None
+    v_ref = rest.pop(0)
+    vs_ref = rest.pop(0) if has_vs else None
+    o_ref, m_s, l_s, acc_s = rest
+    _decode_kernel(len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref, m_s, l_s, acc_s, **kw)
+
+
+def maybe_flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lengths: jax.Array,
+    *,
+    kv_raw=None,
+    scale: float | None = None,
+) -> jax.Array | None:
+    """Dispatch entry: the kernel output when `decode_attn` is enabled and
+    the shapes are supported, else ``None`` (caller runs the exact reference
+    lowering). ``kv_raw = (k_q, k_scale, v_q, v_scale)`` hands over the raw
+    int8 cache so dequant fuses into the kernel."""
+    mode = kernel_mode("decode_attn")
+    if mode is None or not supported(q, k):
+        return None
+    interpret = mode == "interpret"
+    if kv_raw is not None:
+        kq, ks, vq, vs = kv_raw
+        return flash_decode(
+            q, kq, vq, lengths, k_scale=ks, v_scale=vs, scale=scale, interpret=interpret
+        )
+    return flash_decode(q, k, v, lengths, scale=scale, interpret=interpret)

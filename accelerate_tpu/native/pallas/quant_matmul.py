@@ -1,0 +1,252 @@
+"""Fused quantized matmul kernels for the int8 / fp8 paths.
+
+Every projection in the model zoo funnels through `ops.fp8.matmul_einsum`,
+and every equation it (and its `_grad_equations` transposes) emits is
+matmul-shaped with the contracted labels a contiguous prefix or suffix of
+each operand and ``out == a_rest + b_rest`` — so each one is a 2D matmul in
+one of four orientations, reached by reshape (never a physical transpose).
+`_parse_matmul_eq` proves that per equation; anything it can't prove falls
+back to the reference lowering.
+
+Two kernels share the tiling (grid over (M, N) tiles, contraction axis
+resident per program):
+
+- :func:`int8_matmul_fused` — the whole `ops.int8.int8_einsum` body in one
+  pass: per-row dynamic activation quantization (amax/127), int8×int8→int32
+  dot on the MXU, rescale by ``row scale × per-channel weight scale``.
+  Integer accumulation is exact and the elementwise ops replicate
+  `quantize_act` literally; the one divergence from the fallback is the
+  activation-scale divide, which Pallas lowers with TPU semantics
+  (reciprocal-multiply, 1 ulp off IEEE) — parity is ~1e-7 relative, not
+  bitwise, and the quantize/rescale never round-trip through HBM.
+- :func:`scaled_matmul` — the fp8 contraction `(dot(qx, qw) * scale)` with
+  fp8 operands fed to the MXU directly (``preferred_element_type=f32``)
+  instead of XLA's materialized upcast (the flat 1.004
+  ``fp8_matmul_speedup``). Quantization stays OUTSIDE (the custom_vjp
+  residuals carry qx/qw for the backward); parity is to f32 tolerance
+  (different accumulation order), not bitwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import kernel_mode, pallas_available, register_kernel
+
+register_kernel(
+    "int8_matmul", "fused per-row quantize -> int8 MXU dot -> rescale"
+)
+register_kernel(
+    "fp8_matmul", "fp8 dot + scalar rescale without the XLA upcast round-trip"
+)
+
+if pallas_available():
+    from jax.experimental import pallas as pl
+
+    from ...ops.flash_attention import pick_block, tuned_call_kwargs
+else:  # pragma: no cover - environment dependent
+    pl = None
+
+    def pick_block(dim, candidates=(512, 256, 128, 64, 32, 16, 8)):
+        return None
+
+
+# Contraction axes larger than this would blow the resident-operand VMEM
+# budget per program; such shapes (none in the model zoo today) fall back.
+_MAX_CONTRACT = 65536
+
+
+def _parse_matmul_eq(eq: str):
+    """Prove ``eq`` is a pure matmul: returns ``(oa, ob, a_rest, b_rest)``
+    with orientations in {"lead", "trail"} (contracted labels at the front
+    or back of the operand, same order in both), or ``None``."""
+    if "->" not in eq or "." in eq:
+        return None
+    lhs, out = eq.split("->")
+    if "," not in lhs:
+        return None
+    a, b = lhs.split(",")
+    contracted = "".join(c for c in a if c in b)
+    if not contracted or any(c in out for c in contracted):
+        return None  # no contraction, or shared batch labels: not this kernel
+    if "".join(c for c in b if c in a) != contracted:
+        return None  # contracted labels must appear in the same order
+    a_rest = "".join(c for c in a if c not in contracted)
+    b_rest = "".join(c for c in b if c not in contracted)
+    if a_rest + b_rest != out or not a_rest or not b_rest:
+        return None
+    if a.startswith(contracted):
+        oa = "lead"
+    elif a.endswith(contracted):
+        oa = "trail"
+    else:
+        return None
+    if b.startswith(contracted):
+        ob = "lead"
+    elif b.endswith(contracted):
+        ob = "trail"
+    else:
+        return None
+    return oa, ob, len(a_rest), len(b_rest)
+
+
+def _plan(eq: str, a_shape, b_shape):
+    """2D views + tiles for ``eq``: ``(oa, ob, M, N, C, bm, bn, out_shape)``
+    or ``None`` when unsupported."""
+    parsed = _parse_matmul_eq(eq)
+    if parsed is None:
+        return None
+    oa, ob, na, nb = parsed
+    a_rest = a_shape[:na] if oa == "trail" else a_shape[-na:]
+    b_rest = b_shape[-nb:] if ob == "lead" else b_shape[:nb]
+    c_dims = a_shape[na:] if oa == "trail" else a_shape[: len(a_shape) - na]
+    M = int(functools.reduce(lambda x, y: x * y, a_rest, 1))
+    N = int(functools.reduce(lambda x, y: x * y, b_rest, 1))
+    C = int(functools.reduce(lambda x, y: x * y, c_dims, 1))
+    if M == 0 or N == 0 or C == 0 or C > _MAX_CONTRACT:
+        return None
+    bm = pick_block(M) or (M if M <= 1024 else None)
+    bn = pick_block(N) or (N if N <= 1024 else None)
+    if bm is None or bn is None:
+        return None
+    return oa, ob, M, N, C, bm, bn, tuple(a_rest) + tuple(b_rest)
+
+
+def _views(oa, ob, a, b, M, N, C):
+    a2 = a.reshape(M, C) if oa == "trail" else a.reshape(C, M)
+    b2 = b.reshape(C, N) if ob == "lead" else b.reshape(N, C)
+    return a2, b2
+
+
+def _specs(oa, ob, bm, bn, C):
+    if oa == "trail":
+        a_spec = pl.BlockSpec((bm, C), lambda i, j: (i, 0))
+    else:
+        a_spec = pl.BlockSpec((C, bm), lambda i, j: (0, i))
+    if ob == "lead":
+        b_spec = pl.BlockSpec((C, bn), lambda i, j: (0, j))
+    else:
+        b_spec = pl.BlockSpec((bn, C), lambda i, j: (j, 0))
+    return a_spec, b_spec
+
+
+def _dot_dims(oa, ob):
+    ca = 1 if oa == "trail" else 0
+    cb = 0 if ob == "lead" else 1
+    return (((ca,), (cb,)), ((), ()))
+
+
+def _int8_kernel(a_ref, b_ref, ws_ref, o_ref, *, dims):
+    # `quantize_act` verbatim, per (bm) row block, then an exact integer
+    # dot; only the scale divide (TPU reciprocal semantics) can differ
+    # from the fallback, by 1 ulp.
+    xf = a_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    sx = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(q, b_ref[...], dims, preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * (sx * ws_ref[...])).astype(o_ref.dtype)
+
+
+def _scaled_kernel(a_ref, b_ref, s_ref, o_ref, *, dims):
+    acc = jax.lax.dot_general(
+        a_ref[...], b_ref[...], dims, preferred_element_type=jnp.float32
+    )
+    o_ref[...] = (acc * s_ref[0, 0]).astype(o_ref.dtype)
+
+
+def int8_matmul_fused(
+    eq: str,
+    x: jax.Array,
+    wq: jax.Array,
+    w_scale: jax.Array,
+    *,
+    interpret: bool = False,
+) -> jax.Array | None:
+    """Fused `ops.int8.int8_einsum`: quantize rows of ``x``, int8 dot with
+    ``wq``, rescale by ``row scale × w_scale``. Requires x contracted on its
+    trailing axes (per-row groups = rows of the 2D view) and w on its
+    leading axes — true for every int8 forward equation. ``None`` when the
+    equation/shapes aren't supported (caller falls back)."""
+    plan = _plan(eq, x.shape, wq.shape)
+    if plan is None:
+        return None
+    oa, ob, M, N, C, bm, bn, out_shape = plan
+    if oa != "trail" or ob != "lead":
+        return None
+    x2, w2 = _views(oa, ob, x, wq, M, N, C)
+    # Contracted axes of w_scale are size 1 (quantizer keepdims): the value
+    # layout is exactly the per-output-channel vector.
+    ws2 = w_scale.astype(jnp.float32).reshape(1, N)
+    a_spec, b_spec = _specs(oa, ob, bm, bn, C)
+    out = pl.pallas_call(
+        functools.partial(_int8_kernel, dims=_dot_dims(oa, ob)),
+        grid=(M // bm, N // bn),
+        in_specs=[
+            a_spec,
+            b_spec,
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        **tuned_call_kwargs(interpret, ("parallel", "parallel")),
+    )(x2, w2, ws2)
+    return out.reshape(out_shape)
+
+
+def scaled_matmul(
+    eq: str,
+    qa: jax.Array,
+    qb: jax.Array,
+    scale: jax.Array,
+    out_dtype,
+    *,
+    interpret: bool = False,
+) -> jax.Array | None:
+    """``(einsum(eq, qa, qb, preferred_element_type=f32) * scale).astype``
+    as one kernel — the fp8 forward/backward contraction without the
+    materialized upcast. ``scale`` is the scalar product of the per-tensor
+    scales. ``None`` when unsupported."""
+    plan = _plan(eq, qa.shape, qb.shape)
+    if plan is None:
+        return None
+    oa, ob, M, N, C, bm, bn, out_shape = plan
+    a2, b2 = _views(oa, ob, qa, qb, M, N, C)
+    s2 = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    a_spec, b_spec = _specs(oa, ob, bm, bn, C)
+    out = pl.pallas_call(
+        functools.partial(_scaled_kernel, dims=_dot_dims(oa, ob)),
+        grid=(M // bm, N // bn),
+        in_specs=[
+            a_spec,
+            b_spec,
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        **tuned_call_kwargs(interpret, ("parallel", "parallel")),
+    )(a2, b2, s2)
+    return out.reshape(out_shape)
+
+
+def maybe_int8_matmul(
+    eq: str, x: jax.Array, wq: jax.Array, w_scale: jax.Array
+) -> jax.Array | None:
+    """Dispatch entry for `ops.int8.int8_einsum`."""
+    mode = kernel_mode("int8_matmul")
+    if mode is None:
+        return None
+    return int8_matmul_fused(eq, x, wq, w_scale, interpret=mode == "interpret")
+
+
+def maybe_scaled_matmul(
+    eq: str, qa: jax.Array, qb: jax.Array, scale: jax.Array, out_dtype
+) -> jax.Array | None:
+    """Dispatch entry for the fp8 forward/backward contractions."""
+    mode = kernel_mode("fp8_matmul")
+    if mode is None:
+        return None
+    return scaled_matmul(eq, qa, qb, scale, out_dtype, interpret=mode == "interpret")

@@ -931,3 +931,36 @@ def flash_attention(
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+# Shared block/tuning helpers for the `native/pallas/` kernel tier: every
+# tier kernel needs "largest tile that divides this dim" (grids must cover
+# exactly — the tier kernels never pad, they fall back) and per-grid
+# dimension semantics.
+
+def pick_block(dim: int, candidates: tuple[int, ...] = (512, 256, 128, 64, 32, 16, 8)):
+    """Largest candidate evenly dividing ``dim``; ``dim`` itself when smaller
+    than every candidate; ``None`` when no candidate divides (caller falls
+    back to the reference lowering)."""
+    if dim <= 0:
+        return None
+    for c in candidates:
+        if dim >= c and dim % c == 0:
+            return c
+    if dim < min(candidates):
+        return dim
+    return None
+
+
+def tuned_call_kwargs(interpret: bool, semantics: tuple[str, ...]):
+    """`pallas_call` kwargs with per-grid dimension semantics, dropped in
+    interpret mode and on pallas versions without TPUCompilerParams."""
+    kwargs = {"interpret": interpret}
+    if not interpret:
+        try:
+            kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+                dimension_semantics=tuple(semantics)
+            )
+        except Exception:  # pragma: no cover - version dependent
+            pass
+    return kwargs

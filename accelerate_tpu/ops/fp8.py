@@ -129,10 +129,25 @@ def _contract(eq: str, a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.einsum(eq, a, b, preferred_element_type=jnp.float32)
 
 
+def _scaled_contract(eq, qa, qb, scale, out_dtype):
+    """``(dot(qa, qb) * scale).astype(out_dtype)`` — through the `fp8_matmul`
+    Pallas kernel when enabled (fp8 operands straight to the MXU, no
+    materialized upcast), else the exact reference expression."""
+    try:
+        from ..native.pallas.quant_matmul import maybe_scaled_matmul
+    except Exception:  # pragma: no cover - environment dependent
+        maybe_scaled_matmul = None
+    if maybe_scaled_matmul is not None:
+        out = maybe_scaled_matmul(eq, qa, qb, scale, out_dtype)
+        if out is not None:
+            return out
+    return (_contract(eq, qa, qb) * scale).astype(out_dtype)
+
+
 def _fp8_einsum_fwd(eq, x, w):
     qx, sx = quantize(x, E4M3)
     qw, sw = quantize(w, E4M3)
-    out = (_contract(eq, qx, qw) * (sx * sw)).astype(x.dtype)
+    out = _scaled_contract(eq, qx, qw, sx * sw, x.dtype)
     # Zero-size sentinels carry the primal dtypes (x and w may differ) so the
     # cotangents come back dtype-exact, as custom_vjp requires.
     return out, (qx, sx, qw, sw, jnp.zeros((), x.dtype), jnp.zeros((), w.dtype))
@@ -142,8 +157,8 @@ def _fp8_einsum_bwd(eq, res, g):
     qx, sx, qw, sw, x_proto, w_proto = res
     dx_eq, dw_eq = _grad_equations(eq)
     qg, sg = quantize(g, E5M2)
-    dx = (_contract(dx_eq, qg, qw) * (sg * sw)).astype(x_proto.dtype)
-    dw = (_contract(dw_eq, qx, qg) * (sx * sg)).astype(w_proto.dtype)
+    dx = _scaled_contract(dx_eq, qg, qw, sg * sw, x_proto.dtype)
+    dw = _scaled_contract(dw_eq, qx, qg, sx * sg, w_proto.dtype)
     return dx, dw
 
 

@@ -202,9 +202,24 @@ def _adamw_slice(
     ``xp`` is the array namespace: jnp (default — the in-jit streamed
     update) or numpy (the disk-tier update runs on the host against
     memmapped moments, `parallel/disk_offload.py`); one body serves both
-    so the two tiers cannot drift numerically."""
+    so the two tiers cannot drift numerically.
+
+    On the jnp path, the `fused_adamw` Pallas kernel (`native/pallas/`)
+    replaces this body with a single in-place pass when enabled and the
+    leaf tiles; the numpy (disk-tier) path never dispatches."""
     if xp is None:
         import jax.numpy as xp  # type: ignore[no-redef]
+
+        try:
+            from ..native.pallas.fused_adamw import maybe_fused_adamw
+        except Exception:  # pragma: no cover - environment dependent
+            maybe_fused_adamw = None
+        if maybe_fused_adamw is not None:
+            fused = maybe_fused_adamw(
+                g, mu, nu, p, count, lr_t, b1, b2, eps, weight_decay, grad_scale
+            )
+            if fused is not None:
+                return fused
 
     g32 = g.astype(mu.dtype)
     if grad_scale is not None:

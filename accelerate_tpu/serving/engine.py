@@ -263,7 +263,13 @@ class Engine:
             """One token for every slot. Free/mid-prefill slots compute too
             (static shapes) — their write lands at their cursor, a position
             the next prefill chunk fully overwrites, and their output is
-            dropped by the host scheduler."""
+            dropped by the host scheduler.
+
+            The T=1 attention inside ``apply_fn`` routes through the
+            `flash-decode Pallas kernel <native/pallas/decode_attention.py>`
+            when enabled (``ATX_KERNELS`` / ``ATX_KERNEL_DECODE_ATTN``,
+            read at trace time): split-K over the slot KV cache, masked by
+            each row's length cursor, with int8 KV dequantized in-kernel."""
             logits, new = apply_fn(params, tokens[:, None], dict(kv, length=lengths))
             nxt = jax.vmap(_sample)(logits[:, -1, :], seeds, steps)
             return nxt, {k: new[k] for k in kv}

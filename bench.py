@@ -156,6 +156,7 @@ def main() -> None:
             ("serve", lambda: _bench_serve(config)),
             ("specdecode", lambda: _bench_specdecode(config)),
             ("int8kv", lambda: _bench_int8_kv(config)),
+            ("kernels", lambda: _bench_kernels(config)),
             ("int8mm", _bench_int8_matmul),
             ("fp8", _bench_fp8),
             ("llama2b", lambda: _bench_llama2b(fetch_latency)),
@@ -472,6 +473,138 @@ def _bench_int8_kv(config) -> dict:
         rates[label] = decode_n / dt_total
         out[f"kv16k_decode_{label}_tokens_per_sec"] = round(rates[label], 1)
     out["kv16k_int8_speedup"] = round(rates["int8"] / rates["bf16"], 3)
+
+    # Same int8 cache, flash-decode kernel pinned OFF, fresh function object
+    # (fresh jit cache): isolates the kernel's contribution at 16k context.
+    # The loop above runs under the default knobs (kernel on where TPU +
+    # pallas), so rates["int8"] / off_rate is the on/off delta.
+    from accelerate_tpu.native.pallas import force_kernels
+
+    with force_kernels("off"):
+        decode_off = jax.jit(
+            lambda p, t, c: llama.forward_with_cache(p, t, c, gen_config),
+            donate_argnums=(2,),
+        )
+        for _ in range(4):  # compile + warm
+            logits, cache = decode_off(params, tok, cache)
+        int(jnp.argmax(logits[0, -1]))
+        t0 = time.perf_counter()
+        for _ in range(decode_n):
+            logits, cache = decode_off(params, tok, cache)
+        int(jnp.argmax(logits[0, -1]))
+        off_rate = decode_n / (time.perf_counter() - t0)
+    out["kv16k_decode_int8_off_tokens_per_sec"] = round(off_rate, 1)
+    out["kv16k_decode_kernel_speedup"] = round(rates["int8"] / off_rate, 3)
+    return out
+
+
+def _bench_kernels(config) -> dict:
+    """Pallas kernel tier on/off deltas (`native/pallas/`): each hot path
+    timed under ``force_kernels("on")`` vs ``"off"`` with fresh function
+    objects per mode (the mode is read at trace time, so each gets its own
+    jit cache). On CPU "on" resolves to the fallback and the ratios sit at
+    ~1.0; on TPU these are the tier's headline numbers."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.native.pallas import force_kernels
+    from accelerate_tpu.ops import fp8 as _fp8
+    from accelerate_tpu.parallel import host_offload
+
+    out = {}
+
+    # --- flash-decode attention: B=8 steady-state decode, on vs off.
+    gen_config = dataclasses.replace(config, remat=False, attention_impl="dot")
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        llama.init(jax.random.PRNGKey(3), gen_config),
+    )
+    B, prompt_len, decode_n = 8, 256, 48
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (B, prompt_len), 0, gen_config.vocab_size, jnp.int32
+    )
+
+    def run_decode(mode: str) -> float:
+        with force_kernels(mode):
+            step = jax.jit(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, gen_config),
+                donate_argnums=(2,),
+            )
+            cache = llama.init_cache(gen_config, B, prompt_len + decode_n + 8)
+            logits, cache = step(params, prompt, cache)
+            tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            for _ in range(4):
+                logits, cache = step(params, tok, cache)
+            int(jnp.argmax(logits[0, -1]))  # sync
+            t0 = time.perf_counter()
+            for _ in range(decode_n):
+                logits, cache = step(params, tok, cache)
+            int(jnp.argmax(logits[0, -1]))  # fetch barrier
+            return decode_n * B / (time.perf_counter() - t0)
+
+    tps_on = run_decode("on")
+    tps_off = run_decode("off")
+    out["decode_kernel_tokens_per_sec"] = round(tps_on, 1)
+    out["decode_kernel_off_tokens_per_sec"] = round(tps_off, 1)
+    out["decode_kernel_speedup"] = round(tps_on / tps_off, 3)
+
+    # --- fp8 contraction kernel: the 1.004 fp8_matmul_speedup target.
+    N = 4096
+    k0 = jax.random.PRNGKey(11)
+    x = jax.random.normal(k0, (N, N), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(k0, 1), (N, N), jnp.bfloat16)
+
+    def run_fp8(mode: str) -> float:
+        with force_kernels(mode):
+
+            def mm(x, w):
+                with _fp8.fp8_matmuls(True):
+                    return _fp8.matmul_einsum("ij,jk->ik", x, w)
+
+            jitted = jax.jit(mm)
+            o = jitted(x, w)
+            float(jnp.sum(o.astype(jnp.float32)))  # warm + barrier
+            reps = 20
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = jitted(x, w)
+            float(jnp.sum(o.astype(jnp.float32)))
+            return (time.perf_counter() - t0) / reps
+
+    dt_off = min(run_fp8("off") for _ in range(2))
+    dt_on = min(run_fp8("on") for _ in range(2))
+    out["fp8_kernel_matmul_speedup"] = round(dt_off / dt_on, 3)
+
+    # --- fused AdamW: one big leaf's worth of update, on vs off.
+    n = 8 * 1024 * 1024
+    keys = jax.random.split(jax.random.PRNGKey(17), 4)
+    g, mu, nu, p = (
+        jax.random.normal(k, (n,), jnp.float32) * s
+        for k, s in zip(keys, (1e-3, 1e-3, 1e-6, 1.0))
+    )
+    nu = jnp.abs(nu)
+
+    def run_adamw(mode: str) -> float:
+        with force_kernels(mode):
+            step = jax.jit(
+                lambda g, mu, nu, p: host_offload._adamw_slice(
+                    g, mu, nu, p, jnp.ones(()), 1e-4, 0.9, 0.999, 1e-8, 1e-4
+                )
+            )
+            u, m2, n2 = step(g, mu, nu, p)
+            float(jnp.sum(u))  # warm + barrier
+            reps = 20
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                u, m2, n2 = step(g, mu, nu, p)
+            float(jnp.sum(u))
+            return (time.perf_counter() - t0) / reps
+
+    ms_on = min(run_adamw("on") for _ in range(2)) * 1000
+    ms_off = min(run_adamw("off") for _ in range(2)) * 1000
+    out["fused_adamw_step_ms"] = round(ms_on, 3)
+    out["fused_adamw_off_step_ms"] = round(ms_off, 3)
+    out["fused_adamw_speedup"] = round(ms_off / max(ms_on, 1e-9), 3)
     return out
 
 

@@ -362,7 +362,27 @@ class TestPeerShardFetch:
                 resume="latest",
             )
         _assert_tree_equal(ref, _snap(restored))
-        # The fetched peer files landed (atomically) in the checkpoint dir.
+        # Ranged restore: the peer's shard members were read by byte range
+        # straight from the store — nothing landed in the checkpoint dir.
+        assert not os.path.exists(os.path.join(ckpt, "train_state", "shards_1.npz"))
+        assert not os.path.exists(os.path.join(ckpt, "train_state", "index_1.json"))
+
+    def test_legacy_whole_file_fetch_still_works(self, tmp_path):
+        """``ATX_RESTORE_RANGED=0`` keeps the PR-10 behaviour: the peer's
+        index+shards pair is downloaded whole (atomically) into the
+        checkpoint dir and the restore is bit-identical."""
+        ckpt, ref = _split_into_two_proc_checkpoint(
+            tmp_path / "proj", tmp_path / "store"
+        )
+        with patch_environment(
+            ATX_REPLICATE_URL=str(tmp_path / "store"), ATX_RESTORE_RANGED="0"
+        ):
+            acc4 = _fsdp_acc(tmp_path / "proj", 4)
+            restored = acc4.load_state(
+                None, acc4.create_train_state(_init_fn, optax.adam(1e-2)),
+                resume="latest",
+            )
+        _assert_tree_equal(ref, _snap(restored))
         assert os.path.exists(os.path.join(ckpt, "train_state", "shards_1.npz"))
 
     def test_corrupt_peer_fetch_rejected_by_remote_manifest(self, tmp_path):

@@ -1,0 +1,420 @@
+"""Pallas kernel tier (`native/pallas/`): interpret-mode parity against the
+exact fallback lowerings, dispatch knob resolution, and ATX-lint cleanliness
+of the kernel-enabled decode and train steps.
+
+Parity expectations are documented per kernel: the fp8 contraction kernel is
+structurally identical to the fallback (quantization stays outside) so it
+matches to f32 tolerance; the int8 kernel's integer accumulation is exact
+but its activation-scale divide lowers with TPU reciprocal semantics (1 ulp
+off IEEE) — ~1e-7 relative, not bitwise; fused AdamW's divides/sqrt likewise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.native.pallas import (
+    force_kernels,
+    kernel_mode,
+    kernel_status,
+    pallas_available,
+)
+from accelerate_tpu.native.pallas import decode_attention, fused_adamw, quant_matmul
+from accelerate_tpu.utils.environment import patch_environment
+
+pytestmark = pytest.mark.skipif(
+    not pallas_available(), reason="jax.experimental.pallas not importable"
+)
+
+
+# ================================================================ dispatch
+class TestDispatch:
+    def test_default_auto_falls_back_off_tpu(self):
+        assert jax.default_backend() != "tpu"
+        assert kernel_mode("decode_attn") is None
+
+    def test_global_and_per_kernel_knobs(self):
+        with patch_environment(ATX_KERNELS="interpret"):
+            assert kernel_mode("decode_attn") == "interpret"
+        with patch_environment(ATX_KERNELS="0"):
+            assert kernel_mode("decode_attn") is None
+        # Per-kernel knob beats the global one.
+        with patch_environment(
+            ATX_KERNELS="0", ATX_KERNEL_DECODE_ATTN="interpret"
+        ):
+            assert kernel_mode("decode_attn") == "interpret"
+            assert kernel_mode("fused_adamw") is None
+        # "on"/"1"/"auto" mean compiled-iff-TPU: fallback on CPU.
+        with patch_environment(ATX_KERNELS="on"):
+            assert kernel_mode("decode_attn") is None
+
+    def test_unknown_knob_value_raises(self):
+        with patch_environment(ATX_KERNELS="fastplease"):
+            with pytest.raises(ValueError, match="unknown kernel knob"):
+                kernel_mode("decode_attn")
+
+    def test_force_kernels_nests_and_restores(self):
+        with force_kernels("off"):
+            assert kernel_mode("decode_attn") is None
+            with force_kernels("interpret", "decode_attn"):
+                assert kernel_mode("decode_attn") == "interpret"
+                assert kernel_mode("fused_adamw") is None  # outer "off"
+            assert kernel_mode("decode_attn") is None
+        assert kernel_mode("decode_attn") is None  # env default again
+
+    def test_force_beats_env(self):
+        with patch_environment(ATX_KERNELS="interpret"):
+            with force_kernels("off"):
+                assert kernel_mode("int8_matmul") is None
+
+    def test_kernel_status_lists_all_kernels(self):
+        names = {row["kernel"] for row in kernel_status()}
+        assert {"decode_attn", "int8_matmul", "fp8_matmul", "fused_adamw"} <= names
+        with force_kernels("interpret"):
+            modes = {row["kernel"]: row["mode"] for row in kernel_status()}
+        assert modes["decode_attn"] == "interpret"
+
+
+# ====================================================== flash-decode attention
+def _ref_decode(q, k, v, lengths):
+    """`models.layers.dot_product_attention` semantics for the T=1 decode
+    read: GQA reshape, fp32 logits/softmax at 1/sqrt(h), -1e30 length mask,
+    probs cast to v.dtype before the value contraction."""
+    B, _, H, h = q.shape
+    T, K = k.shape[1], k.shape[2]
+    group = H // K
+    qf = q.astype(jnp.float32).reshape(B, 1, K, group, h)
+    logits = jnp.einsum("bskgh,btkh->bkgst", qf, k.astype(jnp.float32))
+    logits = logits / np.sqrt(h)
+    lens = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32).reshape(-1, 1), (B, 1))
+    keep = jnp.arange(T)[None, :] < lens  # (B, T)
+    logits = jnp.where(keep[:, None, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+    return out.reshape(B, 1, H, h).astype(q.dtype)
+
+
+def _decode_operands(dtype, B=2, T=64, K=2, group=2, h=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, 1, K * group, h), dtype)
+    k = jax.random.normal(ks[1], (B, T, K, h), dtype)
+    v = jax.random.normal(ks[2], (B, T, K, h), dtype)
+    return q, k, v
+
+
+class TestFlashDecode:
+    def test_f32_parity_scalar_length(self):
+        q, k, v = _decode_operands(jnp.float32)
+        out = decode_attention.flash_decode(q, k, v, 48, interpret=True)
+        ref = _ref_decode(q, k, v, 48)
+        np.testing.assert_allclose(out, ref, rtol=2e-6, atol=2e-6)
+
+    def test_ragged_lengths_gqa(self):
+        q, k, v = _decode_operands(jnp.float32, B=4, T=64, K=2, group=4)
+        lengths = jnp.asarray([3, 17, 64, 40], jnp.int32)
+        out = decode_attention.flash_decode(q, k, v, lengths, interpret=True)
+        ref = _ref_decode(q, k, v, lengths)
+        np.testing.assert_allclose(out, ref, rtol=2e-6, atol=2e-6)
+
+    def test_bf16_parity(self):
+        q, k, v = _decode_operands(jnp.bfloat16)
+        out = decode_attention.flash_decode(q, k, v, 40, interpret=True)
+        ref = _ref_decode(q, k, v, 40)
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32), rtol=2e-2, atol=2e-2
+        )
+
+    def test_int8_kv_dequant_in_kernel(self):
+        from accelerate_tpu.models.llama import _dequant_kv, _quantize_kv
+
+        q, k, v = _decode_operands(jnp.bfloat16, B=2, T=32)
+        kq, ksc = _quantize_kv(k)
+        vq, vsc = _quantize_kv(v)
+        out = decode_attention.flash_decode(
+            q,
+            kq,
+            vq,
+            20,
+            k_scale=ksc,
+            v_scale=vsc,
+            interpret=True,
+        )
+        ref = _ref_decode(
+            q, _dequant_kv(kq, ksc, q.dtype), _dequant_kv(vq, vsc, q.dtype), 20
+        )
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32), rtol=3e-2, atol=3e-2
+        )
+
+    def test_unsupported_shapes_fall_back(self):
+        q, k, v = _decode_operands(jnp.float32, T=12)  # 12 has no block divisor
+        assert not decode_attention.supported(q, k)
+        with force_kernels("interpret"):
+            assert decode_attention.maybe_flash_decode(q, k, v, 8) is None
+        # T_new > 1 (prefill) is never this kernel's shape.
+        q2 = jnp.zeros((2, 3, 4, 16), jnp.float32)
+        assert not decode_attention.supported(q2, jnp.zeros((2, 64, 2, 16)))
+
+    def test_forward_with_cache_off_is_byte_identical_to_default(self):
+        # ATX_KERNELS=0 acceptance: on this backend the default resolves to
+        # the fallback anyway, so forcing "off" must change NOTHING.
+        from accelerate_tpu.models import llama
+
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size, jnp.int32
+        )
+
+        def run():
+            cache = llama.init_cache(config, 2, 64)
+            logits, cache = jax.jit(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config)
+            )(params, tokens, cache)
+            tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            logits, _ = jax.jit(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config)
+            )(params, tok, cache)
+            return np.asarray(logits)
+
+        base = run()
+        with force_kernels("off"):
+            off = run()
+        assert np.array_equal(base, off)
+
+    def test_forward_with_cache_interpret_matches_off(self):
+        from accelerate_tpu.models import llama
+
+        config = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size, jnp.int32
+        )
+
+        def run(cache_dtype):
+            cache = llama.init_cache(config, 2, 64, dtype=cache_dtype)
+            logits, cache = jax.jit(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config)
+            )(params, tokens, cache)
+            tok = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            logits, _ = jax.jit(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config)
+            )(params, tok, cache)
+            return np.asarray(logits, np.float32)
+
+        for cache_dtype in (jnp.float32, jnp.int8):
+            with force_kernels("off"):
+                ref = run(cache_dtype)
+            with force_kernels("interpret"):
+                out = run(cache_dtype)
+            np.testing.assert_allclose(out, ref, rtol=5e-5, atol=5e-5)
+
+
+# ========================================================== quantized matmul
+class TestQuantMatmul:
+    def test_parse_rejects_non_matmul_equations(self):
+        parse = quant_matmul._parse_matmul_eq
+        assert parse("bij,bjk->bik") is None  # shared batch label
+        assert parse("ij,jk->ki") is None  # out != a_rest + b_rest
+        assert parse("ij,kl->ijkl") is None  # no contraction
+        assert parse("ij,jk->ik") == ("trail", "lead", 1, 1)
+        assert parse("ki,kj->ij") == ("lead", "lead", 1, 1)
+
+    def test_int8_kernel_near_bitwise_parity(self):
+        from accelerate_tpu.ops import int8 as int8_ops
+
+        eq = "bsd,df->bsf"
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 64), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (64, 48), jnp.float32)
+        wq, wsc = int8_ops.quantize_act(w, (0,))
+        out = quant_matmul.int8_matmul_fused(eq, x, wq, wsc, interpret=True)
+        assert out is not None and out.shape == (2, 16, 48)
+        with force_kernels("off"):
+            ref = int8_ops.int8_einsum(eq, x, wq, wsc)
+        # Integer accumulation is exact; only the activation-scale divide
+        # (TPU reciprocal semantics in-kernel) can differ, by 1 ulp.
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_int8_einsum_dispatches_under_interpret(self):
+        from accelerate_tpu.ops import int8 as int8_ops
+
+        eq = "sd,df->sf"
+        x = jax.random.normal(jax.random.PRNGKey(4), (8, 32), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(5), (32, 16), jnp.float32)
+        wq, wsc = int8_ops.quantize_act(w, (0,))
+        with force_kernels("off"):
+            ref = int8_ops.int8_einsum(eq, x, wq, wsc)
+        with force_kernels("interpret"):
+            out = jax.jit(lambda x: int8_ops.int8_einsum(eq, x, wq, wsc))(x)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=1e-2, atol=1e-2,  # bf16 output rounding on top of the 1 ulp
+        )
+
+    def test_scaled_matmul_matches_reference_all_orientations(self):
+        f8 = jnp.float8_e4m3fn
+        for eq, ashape, bshape in (
+            ("ij,jk->ik", (32, 64), (64, 16)),
+            ("ki,kj->ij", (64, 32), (64, 16)),
+            ("ik,jk->ij", (32, 64), (16, 64)),
+        ):
+            qa = jax.random.normal(jax.random.PRNGKey(6), ashape).astype(f8)
+            qb = jax.random.normal(jax.random.PRNGKey(7), bshape).astype(f8)
+            scale = jnp.float32(0.37)
+            out = quant_matmul.scaled_matmul(
+                eq, qa, qb, scale, jnp.bfloat16, interpret=True
+            )
+            ref = (
+                jnp.einsum(eq, qa, qb, preferred_element_type=jnp.float32) * scale
+            ).astype(jnp.bfloat16)
+            np.testing.assert_allclose(
+                np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                rtol=1e-5, atol=1e-5,
+            )
+
+    def test_fp8_einsum_fwd_and_bwd_match_fallback(self):
+        from accelerate_tpu.ops import fp8 as fp8_ops
+
+        x = jax.random.normal(jax.random.PRNGKey(8), (16, 64), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(9), (64, 32), jnp.float32)
+
+        def loss(x, w):
+            with fp8_ops.fp8_matmuls(True):
+                return jnp.sum(fp8_ops.matmul_einsum("ij,jk->ik", x, w) ** 2)
+
+        with force_kernels("off"):
+            ref, (rgx, rgw) = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+        with force_kernels("interpret"):
+            out, (gx, gw) = jax.jit(
+                jax.value_and_grad(loss, argnums=(0, 1))
+            )(x, w)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        np.testing.assert_allclose(gx, rgx, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(gw, rgw, rtol=1e-5, atol=1e-5)
+
+
+# =============================================================== fused AdamW
+class TestFusedAdamW:
+    def _leaf(self, n, dtype=jnp.float32, seed=10):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+        g = (jax.random.normal(ks[0], (n,)) * 1e-2).astype(dtype)
+        mu = jax.random.normal(ks[1], (n,)) * 1e-3
+        nu = jnp.abs(jax.random.normal(ks[2], (n,))) * 1e-6
+        p = jax.random.normal(ks[3], (n,))
+        return g, mu, nu, p
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("with_scale", [False, True])
+    def test_parity_vs_adamw_slice(self, dtype, with_scale):
+        from accelerate_tpu.parallel import host_offload
+
+        g, mu, nu, p = self._leaf(2048, dtype)
+        args = (g, mu, nu, p, jnp.asarray(7.0), 1e-3, 0.9, 0.999, 1e-8, 1e-4)
+        scale = jnp.asarray(0.5) if with_scale else None
+        out = fused_adamw.fused_adamw_update(*args, scale, interpret=True)
+        assert out is not None
+        with force_kernels("off"):
+            ref = host_offload._adamw_slice(*args, grad_scale=scale)
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-6, atol=1e-7,
+            )
+
+    def test_tiny_leaf_falls_back(self):
+        g, mu, nu, p = self._leaf(24)
+        out = fused_adamw.fused_adamw_update(
+            g, mu, nu, p, jnp.asarray(1.0), 1e-3, 0.9, 0.999, 1e-8, 0.0
+        )
+        assert out is None
+
+    def test_adamw_slice_dispatches_under_interpret(self):
+        from accelerate_tpu.parallel import host_offload
+
+        g, mu, nu, p = self._leaf(4096)
+        args = (g, mu, nu, p, jnp.asarray(3.0), 1e-3, 0.9, 0.999, 1e-8, 1e-4)
+        with force_kernels("off"):
+            ref = host_offload._adamw_slice(*args)
+        with force_kernels("interpret"):
+            # Hyperparams stay Python floats under jit (the optimizer's real
+            # calling convention); count/lr could be traced.
+            out = jax.jit(
+                lambda g, mu, nu, p, c: host_offload._adamw_slice(
+                    g, mu, nu, p, c, 1e-3, 0.9, 0.999, 1e-8, 1e-4
+                )
+            )(g, mu, nu, p, jnp.asarray(3.0))
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+        # Traced hyperparams can't be baked into the kernel: dispatch must
+        # fall back (None), not crash.
+        with force_kernels("interpret"):
+            traced = jax.jit(lambda *a: host_offload._adamw_slice(*a))(*args)
+        for a, b in zip(traced, ref):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+# ================================================================= ATX lint
+class TestKernelLint:
+    def test_decode_step_has_no_new_donation_or_sync_findings(self):
+        from accelerate_tpu import analysis
+        from accelerate_tpu.generation import GenerationConfig
+        from accelerate_tpu.models import llama
+        from accelerate_tpu.serving import Engine
+
+        config = llama.LlamaConfig.tiny(vocab_size=128, max_seq_len=128)
+        params = llama.init(jax.random.PRNGKey(0), config)
+        with force_kernels("interpret"):
+            engine = Engine(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+                lambda b, m: llama.init_cache(config, b, m),
+                params,
+                GenerationConfig(eos_token_id=0),
+                slots=4,
+                buckets=(16,),
+                max_len=96,
+            )
+            report = analysis.lint_step(
+                engine._decode_fn,
+                *engine.abstract_decode_args(),
+                donate_argnums=(3,),
+                target="kernels.decode",
+            )
+        bad = [
+            f
+            for f in report.findings
+            if f.rule_id.startswith("ATX2") or f.rule_id.startswith("ATX3")
+        ]
+        assert bad == [], [f.format() for f in bad]
+
+    def test_train_step_has_no_new_donation_or_sync_findings(self):
+        import numpy as onp
+
+        from accelerate_tpu import analysis
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.models import gpt
+        from accelerate_tpu.parallel import host_offload
+        from accelerate_tpu.state import AcceleratorState
+
+        AcceleratorState._reset_state()
+        acc = Accelerator(seed=0, mixed_precision="bf16", max_grad_norm=1.0)
+        config = gpt.GPTConfig(
+            vocab_size=128, d_model=64, n_layers=2, num_heads=4, d_ff=128,
+            max_seq_len=32,
+        )
+        batch = {"input_ids": onp.zeros((8, 32), onp.int32)}
+        with force_kernels("interpret"):
+            report = analysis.lint_training(
+                acc,
+                lambda r: gpt.init(r, config),
+                host_offload.host_offloaded_adamw(3e-3),
+                lambda params, b, rng: gpt.loss_fn(params, b, config, rng),
+                batch,
+                target="kernels.train",
+            )
+        bad = [
+            f
+            for f in report.findings
+            if f.rule_id.startswith("ATX2") or f.rule_id.startswith("ATX3")
+        ]
+        assert bad == [], [f.format() for f in bad]
