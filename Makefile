@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -123,8 +123,20 @@ smoke-kernels:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_kernels.py -q -m 'not slow'
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint kernels --severity error
 
+# CPU telemetry lane (docs/observability.md): registry/histogram/span unit
+# tests incl. the zero-device-sync and bit-identity gates, a 16-request
+# `atx serve --metrics-port` run scraped live mid-trace with the Prometheus
+# text cross-checked against the JSON summary, and the telemetry host-loop
+# replay under 2 simulated processes proving metrics + snapshot export add
+# NO collectives (error findings fail).
+smoke-telemetry:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python tests/scripts/serve_scrape.py
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint telemetry --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry
 	python -m pytest tests/ -q --heavy

@@ -30,6 +30,7 @@ from .generation import GenerationConfig, Generator, generate
 from .speculative import SpeculativeGenerator, generate_speculative
 from . import serving
 from . import resilience
+from . import telemetry
 from .resilience import (
     PREEMPTION_EXIT_CODE,
     WATCHDOG_EXIT_CODE,

@@ -38,6 +38,7 @@ Capability parity index (reference `accelerator.py` line refs):
 from __future__ import annotations
 
 import gc
+import os
 from typing import Any, Callable, Iterable, Sequence
 
 import jax
@@ -1214,10 +1215,67 @@ class Accelerator:
         # state, then incremented) so note_step never forces a device sync.
         _host_step = {"n": None}
 
+        # ---- step telemetry (docs/observability.md). ATX_METRICS=0 removes
+        # every hook; with it on (default) the hooks are host clocks + shape
+        # math only — zero device syncs unless ATX_METRICS_SAMPLE_EVERY turns
+        # the block_until_ready sampler on. Nothing here touches rng, step
+        # math, or dispatch order, so losses are bit-identical either way.
+        from . import telemetry as _telemetry
+        from .utils import profiler as _profiler
+        from .utils.environment import get_int_from_env as _get_int
+
+        _stats: Any = None
+        _stats_cell: dict[str, Any] = {"tokens": None, "abstract": None, "calls": 0}
+        _metrics_log_every = 0
+        _metrics_dir = ""
+        if _telemetry.metrics_enabled():
+            peak = _telemetry.peak_device_flops()
+            peak_total = peak * jax.device_count() if peak else None
+
+            def _flops_fn() -> float | None:
+                abstract = _stats_cell["abstract"]
+                if abstract is None:
+                    return None
+                compiled = lower(*abstract).compile()
+                flops = _profiler.estimate_step_flops(compiled)
+                return None if flops is None else flops * jax.device_count()
+
+            _stats = _telemetry.StepStats(
+                flops_fn=_flops_fn, peak_flops_total=peak_total
+            )
+            _metrics_log_every = _get_int(("ATX_METRICS_LOG_EVERY",), 0)
+            _metrics_dir = os.environ.get("ATX_METRICS_DIR", "")
+
+        def _stats_entry(state: TrainState, batch: Any) -> None:
+            if _stats_cell["tokens"] is None:
+                _stats_cell["tokens"] = _telemetry.tokens_in_batch(batch)
+                if _stats.peak_flops_total:
+                    _stats_cell["abstract"] = jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            jnp.shape(x), jnp.result_type(x)
+                        ),
+                        (state, batch),
+                    )
+            _stats.on_entry(_stats_cell["tokens"])
+
+        def _stats_dispatched(metrics: Any) -> None:
+            _stats.on_dispatched(metrics, cache_size=jitted._cache_size())
+            n = _stats_cell["calls"]
+            if _metrics_log_every and n % _metrics_log_every == 0:
+                if self.trackers:
+                    self.log(_stats.latest(), step=n)
+                if _metrics_dir:
+                    _telemetry.write_snapshot(
+                        _metrics_dir, process_index=self.process_index
+                    )
+
         def run_step(state: TrainState, batch: Any):
             from . import resilience
             from .parallel.disk_offload import DiskOffloadedAdamW
 
+            _stats_cell["calls"] += 1
+            if _stats is not None:
+                _stats_entry(state, batch)
             if nan_guard:
                 _drain_guard()
                 # Bound the undrained window so detection can't lag forever
@@ -1261,11 +1319,19 @@ class Accelerator:
             if wd is not None:
                 wd.arm()
             if isinstance(state.tx, DiskOffloadedAdamW):
-                return run_disk_step(state, batch)
+                new_state, metrics = run_disk_step(state, batch)
+                if _stats is not None:
+                    _stats_dispatched(None)
+                return new_state, metrics
             # Trace (and run) under the ambient mesh so the model's
             # activation constraints (parallel.mesh.constrain_batch) bind
-            # to this Accelerator's axes.
-            with use_mesh(self.mesh):
+            # to this Accelerator's axes. While an XPlane capture is live the
+            # step also enters StepTraceAnnotation so traces show numbered
+            # steps (utils/profiler.maybe_step_annotation — a no-op context
+            # otherwise).
+            with use_mesh(self.mesh), _profiler.maybe_step_annotation(
+                _stats_cell["calls"]
+            ):
                 new_state, metrics = jitted(state, batch)
             if self._elastic_timer is not None:
                 # First step after an in-place resize: block on its output
@@ -1274,6 +1340,8 @@ class Accelerator:
                 self._report_elastic_latency(new_state)
             if nan_guard:
                 _guard["pending"].append(metrics["nonfinite_skipped"])
+            if _stats is not None:
+                _stats_dispatched(metrics)
             return new_state, metrics
 
         def lower(*args: Any, **kwargs: Any):
@@ -1283,6 +1351,9 @@ class Accelerator:
         # Keep the jit surface the HLO-verification tooling relies on.
         run_step.lower = lower
         run_step._cache_size = jitted._cache_size
+        # Telemetry read side (None when ATX_METRICS=0): bench and the
+        # tracker glue read EMA'd step timing from here.
+        run_step.step_stats = _stats
         # NaN-guard introspection: counters for tests/metrics, and a blocking
         # drain so a loop's last steps are judged before it declares success.
         run_step._nan_guard = _guard if nan_guard else None
@@ -1416,7 +1487,18 @@ class Accelerator:
         for tracker in self.trackers:
             tracker.finish()
         self.trackers = []
-        from . import checkpointing, resilience
+        from . import checkpointing, resilience, telemetry
+
+        # Final telemetry snapshot so the shared metrics dir reflects the
+        # run's last state even when the step cadence never hit the flush.
+        metrics_dir = os.environ.get("ATX_METRICS_DIR", "")
+        if metrics_dir and telemetry.metrics_enabled():
+            try:
+                telemetry.write_snapshot(
+                    metrics_dir, process_index=self.process_index
+                )
+            except OSError:
+                pass
 
         wd = resilience.watchdog_from_env()
         if wd is not None:

@@ -59,7 +59,7 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="also verify multi-host SPMD consistency (ATX5xx) by replaying "
         "each scenario under N simulated processes; adds the host-loop "
         "scenarios (save_path, preemption_exit, router_drain, "
-        "replicated_save, elastic_restore) to the default set",
+        "replicated_save, elastic_restore, telemetry) to the default set",
     )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
@@ -752,6 +752,86 @@ def _mh_scenario_shrink(processes: int = 2):
     )
 
 
+def _mh_scenario_telemetry(processes: int = 2):
+    """Runtime telemetry (telemetry/): train steps with ATX_METRICS=1 plus
+    the cross-host export path — per-process snapshot write, proc-0 merge,
+    Prometheus render — must add ZERO collectives to the step schedule
+    (PR-11 shared-surface rule: metrics travel as files, never as
+    collectives; a collective here would park survivors when a peer dies
+    mid-step). The replay also pins the schedule identical across
+    processes with metrics armed (the ATX5xx gates)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .. import analysis
+    from ..accelerator import Accelerator, TrainState
+    from ..state import AcceleratorState
+    from ..utils.environment import patch_environment
+
+    def telemetry_loop():
+        from .. import telemetry
+        from ..analysis import host_trace
+
+        AcceleratorState._reset_state()
+        snap_dir = tempfile.mkdtemp(prefix="atx_lint_mh_tel_")
+        with patch_environment(
+            ATX_METRICS="1", ATX_METRICS_SAMPLE_EVERY="2"
+        ):
+            acc = Accelerator(seed=0)
+            params = {
+                "w": jax.random.normal(jax.random.PRNGKey(0), (8, 8), jnp.float32)
+            }
+            state = acc.prepare_train_state(
+                TrainState.create(params=params, tx=optax.sgd(1e-2))
+            )
+            step = acc.make_train_step(
+                lambda p, b, r=None: jnp.mean((b["x"] @ p["w"]) ** 2)
+            )
+            batch = {"x": np.ones((8, 8), np.float32)}
+            for _ in range(3):
+                state, _ = step(state, batch)
+            assert step.step_stats is not None, "ATX_METRICS=1 did not arm"
+            assert step.step_stats.steps == 3
+            # The export surface is pure file IO + host math: pin the
+            # collective count across it.
+            rec = host_trace._ACTIVE_RECORDER
+            before = len(rec.collective_events) if rec is not None else 0
+            telemetry.write_snapshot(snap_dir, process_index=0)
+            telemetry.write_snapshot(snap_dir, process_index=1)
+            merged = telemetry.aggregate_snapshots(snap_dir)
+            text = telemetry.render_snapshot_prometheus(merged)
+            after = len(rec.collective_events) if rec is not None else 0
+            assert after == before, (
+                f"telemetry export added {after - before} collective(s)"
+            )
+            # Two identical snapshots merged: counters double, gauges
+            # reduce — the cross-host invariant the fleet endpoint serves.
+            def _val(snap, name):
+                for entry in snap["metrics"]:
+                    if entry["name"] == name:
+                        return entry["series"][0]["value"]
+                raise AssertionError(f"{name} missing from snapshot")
+
+            local = telemetry.snapshot()
+            assert _val(merged, "train_steps") == 2 * _val(
+                local, "train_steps"
+            ), "cross-host counter merge did not sum"
+            assert "train_steps" in text and "# TYPE" in text
+
+    report = analysis.lint_host_loop(
+        telemetry_loop, processes=processes, target="telemetry"
+    )
+    return (
+        f"3 train steps with ATX_METRICS=1 + snapshot write/merge/render, "
+        f"{processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
@@ -759,6 +839,7 @@ MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "replicated_save": _mh_scenario_replicated_save,
     "elastic_restore": _mh_scenario_elastic_restore,
     "shrink": _mh_scenario_shrink,
+    "telemetry": _mh_scenario_telemetry,
 }
 
 

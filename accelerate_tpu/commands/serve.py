@@ -127,6 +127,16 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="router placement policy: prefix-affinity steering with "
         "least-loaded fallback, or pure least-loaded",
     )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="expose a Prometheus /metrics endpoint on PORT for the whole "
+        "run (0 = pick a free port; the bound URL is printed to stderr). "
+        "The endpoint stays up until the trace — and the router drain, "
+        "with --replicas — has finished (docs/observability.md)",
+    )
     p.set_defaults(func=run)
 
 
@@ -263,79 +273,109 @@ def run(args: argparse.Namespace) -> int:
             seed=args.seed,
             stop_sequences=stop_sequences,
         )
-    t0 = time.perf_counter()
-    if router is not None:
-        completions = router.serve(trace, realtime=args.realtime)
-        router.close()
-    else:
-        completions = engine.serve(trace, realtime=args.realtime)
-    wall = time.perf_counter() - t0
+    metrics_server = None
+    if args.metrics_port is not None:
+        import sys
 
-    total_new = sum(c.n_new for c in completions)
-    # Latency stats over requests that actually finished (a drained or
-    # deadline-cancelled request has no meaningful TTFT/e2e).
-    finished = [
-        c for c in completions if c.finish_reason not in ("cancelled", "failed")
-    ] or completions
-    lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in finished)
-    ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in finished)
-    pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
-    result = {
-        "serve_requests": len(completions),
-        "serve_tokens_per_sec": round(total_new / max(wall, 1e-9), 1),
-        "serve_wall_s": round(wall, 2),
-        "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
-        "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
-        "serve_ttft_p50_ms": round(pick(ttft_ms, 0.50), 1),
-        "serve_ttft_p99_ms": round(pick(ttft_ms, 0.99), 1),
-        "serve_slots": engine.n_slots,
-        "serve_buckets": list(engine.buckets),
-        "serve_prefill_compiles": engine._prefill._cache_size(),
-        "serve_decode_compiles": engine._decode._cache_size(),
-        "serve_occupancy": round(
-            engine.stats["decode_slot_steps"]
-            / max(engine.stats["decode_steps"] * engine.n_slots, 1),
-            3,
-        ),
-    }
-    for key, val in engine.prefix_metrics().items():
-        result["serve_" + key] = val
-    if args.compare_b1:
-        gens: dict[int, Generator] = {}
+        from .. import telemetry
+
+        metrics_server = telemetry.MetricsServer(port=args.metrics_port)
+        print(
+            f"[atx serve] /metrics listening on {metrics_server.url}",
+            file=sys.stderr,
+        )
+    try:
         t0 = time.perf_counter()
-        for r in trace:
-            g = gens.setdefault(
-                r.max_new_tokens,
-                Generator(
-                    apply_fn,
-                    init_cache_fn,
-                    GenerationConfig(
-                        max_new_tokens=r.max_new_tokens,
-                        do_sample=args.do_sample,
-                        temperature=args.temperature,
-                    ),
-                ),
-            )
-            out = g(params, np.asarray(r.prompt)[None])
-            int(np.asarray(out[0, -1]))  # fetch barrier
-        b1_wall = time.perf_counter() - t0
-        result["serve_b1_sequential_s"] = round(b1_wall, 2)
-        result["serve_vs_b1_speedup"] = round(b1_wall / max(wall, 1e-9), 2)
-    if router is not None:
-        from .. import resilience
+        if router is not None:
+            completions = router.serve(trace, realtime=args.realtime)
+            router.close()
+        else:
+            completions = engine.serve(trace, realtime=args.realtime)
+        wall = time.perf_counter() - t0
 
-        fleet = router.metrics()
-        per = fleet.pop("per_replica")
-        for key, val in fleet.items():
-            result["serve_router_" + key] = val
-        result["serve_router_occupancy"] = [p["occupancy"] for p in per]
-        result["serve_router_hit_rates"] = [p["prefix_hit_rate"] for p in per]
-        result["serve_router_quarantined"] = [p["quarantined"] for p in per]
+        total_new = sum(c.n_new for c in completions)
+        # Latency stats over requests that actually finished (a drained or
+        # deadline-cancelled request has no meaningful TTFT/e2e).
+        finished = [
+            c for c in completions if c.finish_reason not in ("cancelled", "failed")
+        ] or completions
+        lat_ms = sorted(1e3 * (c.finished_at - c.submitted_at) for c in finished)
+        ttft_ms = sorted(1e3 * (c.first_token_at - c.submitted_at) for c in finished)
+        pick = lambda xs, q: xs[min(len(xs) - 1, int(q * len(xs)))] if xs else 0.0
+        result = {
+            "serve_requests": len(completions),
+            "serve_tokens_per_sec": round(total_new / max(wall, 1e-9), 1),
+            "serve_wall_s": round(wall, 2),
+            "serve_p50_ms": round(pick(lat_ms, 0.50), 1),
+            "serve_p99_ms": round(pick(lat_ms, 0.99), 1),
+            "serve_ttft_p50_ms": round(pick(ttft_ms, 0.50), 1),
+            "serve_ttft_p99_ms": round(pick(ttft_ms, 0.99), 1),
+            "serve_slots": engine.n_slots,
+            "serve_buckets": list(engine.buckets),
+            "serve_prefill_compiles": engine._prefill._cache_size(),
+            "serve_decode_compiles": engine._decode._cache_size(),
+            "serve_occupancy": round(
+                engine.stats["decode_slot_steps"]
+                / max(engine.stats["decode_steps"] * engine.n_slots, 1),
+                3,
+            ),
+        }
+        if router is None:
+            # Single-engine runs report the registry histograms' estimates —
+            # the SAME series `/metrics` exports, so a scrape and the JSON
+            # line always agree (docs/observability.md).
+            lat = engine.latency_summary()
+            for out_key, reg_key in (
+                ("serve_p50_ms", "p50_ms"),
+                ("serve_p99_ms", "p99_ms"),
+                ("serve_ttft_p50_ms", "ttft_p50_ms"),
+                ("serve_ttft_p99_ms", "ttft_p99_ms"),
+            ):
+                if lat[reg_key] is not None:
+                    result[out_key] = round(lat[reg_key], 1)
+        for key, val in engine.prefix_metrics().items():
+            result["serve_" + key] = val
+        if args.compare_b1:
+            gens: dict[int, Generator] = {}
+            t0 = time.perf_counter()
+            for r in trace:
+                g = gens.setdefault(
+                    r.max_new_tokens,
+                    Generator(
+                        apply_fn,
+                        init_cache_fn,
+                        GenerationConfig(
+                            max_new_tokens=r.max_new_tokens,
+                            do_sample=args.do_sample,
+                            temperature=args.temperature,
+                        ),
+                    ),
+                )
+                out = g(params, np.asarray(r.prompt)[None])
+                int(np.asarray(out[0, -1]))  # fetch barrier
+            b1_wall = time.perf_counter() - t0
+            result["serve_b1_sequential_s"] = round(b1_wall, 2)
+            result["serve_vs_b1_speedup"] = round(b1_wall / max(wall, 1e-9), 2)
+        if router is not None:
+            from .. import resilience
+
+            fleet = router.metrics()
+            per = fleet.pop("per_replica")
+            for key, val in fleet.items():
+                result["serve_router_" + key] = val
+            result["serve_router_occupancy"] = [p["occupancy"] for p in per]
+            result["serve_router_hit_rates"] = [p["prefix_hit_rate"] for p in per]
+            result["serve_router_quarantined"] = [p["quarantined"] for p in per]
+            print(json.dumps(result))
+            if router.draining and router.drain_reason == "preemption":
+                # The launcher resume contract (docs/fault_tolerance.md):
+                # in-flight work finished above; 75 = resume me, free of charge.
+                return resilience.PREEMPTION_EXIT_CODE
+            return 0
         print(json.dumps(result))
-        if router.draining and router.drain_reason == "preemption":
-            # The launcher resume contract (docs/fault_tolerance.md):
-            # in-flight work finished above; 75 = resume me, free of charge.
-            return resilience.PREEMPTION_EXIT_CODE
         return 0
-    print(json.dumps(result))
-    return 0
+    finally:
+        # The endpoint outlives the trace (and the router drain above) so a
+        # late scrape still sees the final counters; closed only on exit.
+        if metrics_server is not None:
+            metrics_server.close()

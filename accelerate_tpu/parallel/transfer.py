@@ -156,6 +156,22 @@ class TransferEngine:
         self._jit_lock = threading.Lock()
         self._fold_jits: dict = {}
         self._alloc_jits: dict = {}
+        # Link-traffic telemetry (docs/observability.md). Counters are
+        # thread-safe; incremented from pool workers alongside the copies
+        # they describe, so the registry view tracks in-flight progress.
+        from .. import telemetry as _telemetry
+
+        self._c_h2d = _telemetry.counter(
+            "transfer_h2d_bytes", "Host-to-device bytes moved by TransferEngine")
+        self._c_d2h = _telemetry.counter(
+            "transfer_d2h_bytes", "Device-to-host bytes drained by TransferEngine")
+        self._c_chunks = _telemetry.counter(
+            "transfer_chunks", "Chunked H2D copy windows dispatched")
+        self._h_chunk = _telemetry.histogram(
+            "transfer_chunk_bytes",
+            "Size of each H2D transfer (whole leaf or chunk window)",
+            buckets=_telemetry.DEFAULT_BYTES_BUCKETS,
+        )
 
     # ------------------------------------------------------------- generic
     def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Future:
@@ -249,6 +265,10 @@ class TransferEngine:
                     x = np.asarray(x, dtype=np.dtype(dtype))
                 elif hasattr(x, "astype"):
                     x = x.astype(dtype)
+            nbytes = int(getattr(x, "nbytes", 0) or 0)
+            if nbytes:
+                self._c_h2d.inc(nbytes)
+                self._h_chunk.observe(nbytes)
             if sharding is None:
                 return jax.device_put(x)
             return jax.device_put(x, sharding)
@@ -267,6 +287,9 @@ class TransferEngine:
             # here on a pool worker — concurrent chunks are the multiple
             # streams that aggregate link bandwidth.
             chunk = np.asarray(x[s : s + rows], dtype=out_dtype)
+            self._c_h2d.inc(chunk.nbytes)
+            self._c_chunks.inc()
+            self._h_chunk.observe(chunk.nbytes)
             if sharding is None:
                 return jax.device_put(chunk)
             return jax.device_put(chunk, sharding)
@@ -331,7 +354,13 @@ class TransferEngine:
                 x.copy_to_host_async()
             except Exception:
                 pass  # backends without async copy fall through to asarray
-        return self._pool.submit(lambda: np.asarray(x))
+
+        def _drain(x=x):
+            out = np.asarray(x)
+            self._c_d2h.inc(out.nbytes)
+            return out
+
+        return self._pool.submit(_drain)
 
     def get_tree(self, tree: Any) -> TreeFuture:
         """`get` over a pytree — all leaves drain concurrently."""

@@ -363,6 +363,19 @@ class ElasticController:
         self._retired_at: dict[int, float] = {}
         self._abandoned = False
         self.transitions = 0
+        # Registry mirrors (docs/observability.md): shrink/grow counts and
+        # the live world size, fleet-visible on /metrics.
+        from .. import telemetry as _telemetry
+
+        self._c_shrinks = _telemetry.counter(
+            "elastic_shrinks", "In-place topology shrinks adopted")
+        self._c_grows = _telemetry.counter(
+            "elastic_grows", "In-place topology grows adopted")
+        self._h_agree = _telemetry.histogram(
+            "elastic_agree_ms", "Escalation-to-adoption agreement wall (ms)")
+        self._g_world = _telemetry.gauge(
+            "elastic_world_size", "Processes in the agreed roster", aggregate="max")
+        self._g_world.set(len(self.roster))
 
     # -- triggers ------------------------------------------------------------
     def _read_devices_file(self) -> tuple[int, int] | None:
@@ -508,6 +521,12 @@ class ElasticController:
         )
         self.escalated_at = None
         self.transitions += 1
+        if len(decision.survivors) < len(old):
+            self._c_shrinks.inc()
+        elif len(decision.survivors) > len(old):
+            self._c_grows.inc()
+        self._h_agree.observe(agree_secs * 1e3)
+        self._g_world.set(len(decision.survivors))
         self.last_transition = {
             "epoch": decision.epoch,
             "survivors": decision.survivors,

@@ -167,6 +167,16 @@ class PeerHealthMonitor:
         self.beats_written = 0
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # Registry mirrors (docs/observability.md): the attributes above
+        # stay the test-facing source of truth; these feed /metrics.
+        from .. import telemetry as _telemetry
+
+        self._c_beats = _telemetry.counter(
+            "health_beats_written", "Heartbeat files/objects written")
+        self._c_escalations = _telemetry.counter(
+            "health_escalations", "Stale-peer escalations to the preemption path")
+        self._g_stale = _telemetry.gauge(
+            "health_stale_peers", "Peers currently flagged stale", aggregate="max")
 
     @staticmethod
     def _default_abort(code: int) -> None:  # pragma: no cover - kills the proc
@@ -198,6 +208,7 @@ class PeerHealthMonitor:
                 },
             )
             self.beats_written += 1
+            self._c_beats.inc()
         except Exception as e:  # diagnostics must never kill training
             logger.warning("[atx health] beat write failed: %s", e)
 
@@ -266,6 +277,7 @@ class PeerHealthMonitor:
                 self._peer_state[peer] = (seq, now, step)
                 if peer in self.stale_peers:
                     self.stale_peers.discard(peer)
+                    self._g_stale.set(len(self.stale_peers))
                     logger.warning(
                         "[atx health] peer %d recovered (beat advanced)", peer
                     )
@@ -287,6 +299,8 @@ class PeerHealthMonitor:
                     PREEMPTION_EXIT_CODE,
                 )
                 self.escalations += 1
+                self._c_escalations.inc()
+                self._g_stale.set(len(self.stale_peers))
                 try:
                     self._escalate()
                 except Exception as e:  # pragma: no cover - diagnostics only

@@ -335,6 +335,20 @@ class Replicator:
         self.checkpoints_replicated = 0
         self.failures = 0
         self.last_error: str | None = None
+        # Registry mirrors of the attributes above — same names prefixed
+        # replicate_* on /metrics (docs/observability.md). The attributes
+        # stay the source of truth for tests/log lines; the counters are
+        # the fleet-visible copy.
+        from .. import telemetry as _telemetry
+
+        self._c_parts_uploaded = _telemetry.counter(
+            "replicate_parts_uploaded", "Checkpoint parts uploaded to the object store")
+        self._c_parts_skipped = _telemetry.counter(
+            "replicate_parts_skipped", "Checkpoint parts skipped (already durable)")
+        self._c_checkpoints = _telemetry.counter(
+            "replicate_checkpoints", "Checkpoint directories fully replicated")
+        self._c_failures = _telemetry.counter(
+            "replicate_failures", "Checkpoint replications abandoned after retries")
 
     # ------------------------------------------------------------- lifecycle
     def enqueue(
@@ -394,8 +408,10 @@ class Replicator:
             try:
                 self._replicate(job)
                 self.checkpoints_replicated += 1
+                self._c_checkpoints.inc()
             except BaseException as e:  # NEVER crash the step loop
                 self.failures += 1
+                self._c_failures.inc()
                 self.last_error = f"{type(e).__name__}: {e}"
                 logger.warning(
                     "checkpoint replication of %s failed (%s) — training "
@@ -496,10 +512,12 @@ class Replicator:
                 and (remote.sha256 is None or remote.sha256 == info["sha256"])
             ):
                 self.parts_skipped += 1
+                self._c_parts_skipped.inc()
                 return
         self._throttle(os.path.getsize(local))
         self._with_retries(key, lambda: self.store.put_file(local, key), deadline)
         self.parts_uploaded += 1
+        self._c_parts_uploaded.inc()
         fault_point("replicate.part_uploaded")
 
     def _throttle(self, nbytes: int) -> None:

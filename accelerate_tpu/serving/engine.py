@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..generation import GenerationConfig, warp_logits
 from ..models.layers import cache_slot_copy, cache_slot_view, cache_slot_write
 from ..utils.environment import (
@@ -343,19 +344,52 @@ class Engine:
         self._decode_credit = 0
         self._next_rid = 0
         self.prefill_signatures: list[int] = []  # bucket length per issued chunk
-        self.stats = {
-            "admitted": 0,
-            "completed": 0,
-            "prefill_chunks": 0,
-            "decode_steps": 0,
-            "decode_slot_steps": 0,  # active rows summed over decode steps
-            "prompt_tokens": 0,
-            "prefix_hits": 0,
-            "prefill_tokens_saved": 0,  # prompt tokens served by copy, not prefill
-            "prefix_copy_chunks": 0,
-            "prefix_promotions": 0,
-            "cancelled": 0,
-        }
+        # Counters live on the telemetry registry (docs/observability.md):
+        # this dict-shaped view keeps every historical `stats[...]` use and
+        # snapshot working while `/metrics` reads the same series — one
+        # source of truth. Keys: decode_slot_steps sums active rows over
+        # decode steps; prefill_tokens_saved counts prompt tokens served by
+        # KV copy instead of prefill compute.
+        self.stats = _telemetry.StatsView(
+            "serve",
+            (
+                "admitted",
+                "completed",
+                "prefill_chunks",
+                "decode_steps",
+                "decode_slot_steps",
+                "prompt_tokens",
+                "prefix_hits",
+                "prefill_tokens_saved",
+                "prefix_copy_chunks",
+                "prefix_promotions",
+                "cancelled",
+            ),
+            label="engine",
+        )
+        _labels = ("engine",)
+        self._tel_labels = self.stats.labels
+        self._h_queue_wait = _telemetry.histogram(
+            "serve_queue_wait_ms", "submit -> slot admission", labels=_labels
+        )
+        self._h_prefill_ms = _telemetry.histogram(
+            "serve_prefill_step_ms", "wall per prefill scheduler step",
+            labels=_labels,
+        )
+        self._h_decode_ms = _telemetry.histogram(
+            "serve_decode_step_ms",
+            "wall per decode scheduler step (includes the token fetch sync)",
+            labels=_labels,
+        )
+        self._h_ttft = _telemetry.histogram(
+            "serve_ttft_ms", "engine submit -> first token", labels=_labels
+        )
+        self._h_e2e = _telemetry.histogram(
+            "serve_e2e_ms", "engine submit -> completion", labels=_labels
+        )
+        self._c_tokens = _telemetry.counter(
+            "serve_generated_tokens", "tokens emitted", labels=_labels
+        )
         self.actions: list[str] = []  # "prefill" / "decode", for tests/traces
 
     # ------------------------------------------------------------- submit
@@ -550,6 +584,11 @@ class Engine:
             )
             self._prefill_order.append(slot_id)
             self.stats["admitted"] += 1
+            submitted = getattr(req, "submitted_at", 0.0)
+            if submitted:
+                self._h_queue_wait.observe(
+                    (time.perf_counter() - submitted) * 1e3, **self._tel_labels
+                )
             self.stats["prompt_tokens"] += len(req.prompt)
             if matched:
                 self.stats["prefix_hits"] += 1
@@ -565,11 +604,23 @@ class Engine:
         if self._prefill_order and (not decoding or self._decode_credit <= 0):
             self._decode_credit = self.prefill_interleave
             self.actions.append("prefill")
-            return self._prefill_step()
+            t0 = time.perf_counter()
+            with _telemetry.span("serve_prefill"):
+                out = self._prefill_step()
+            self._h_prefill_ms.observe(
+                (time.perf_counter() - t0) * 1e3, **self._tel_labels
+            )
+            return out
         if decoding:
             self._decode_credit -= 1
             self.actions.append("decode")
-            return self._decode_step(decoding)
+            t0 = time.perf_counter()
+            with _telemetry.span("serve_decode"):
+                out = self._decode_step(decoding)
+            self._h_decode_ms.observe(
+                (time.perf_counter() - t0) * 1e3, **self._tel_labels
+            )
+            return out
         return []
 
     def run_until_idle(self) -> list[Completion]:
@@ -741,6 +792,17 @@ class Engine:
         self._slots[slot_id] = None  # evict: the slot is immediately reusable
         self._free.append(slot_id)
         self.stats["completed"] += 1
+        self._c_tokens.inc(slot.n_new, **self._tel_labels)
+        submitted = completion.submitted_at
+        if submitted:
+            if completion.first_token_at:
+                self._h_ttft.observe(
+                    (completion.first_token_at - submitted) * 1e3,
+                    **self._tel_labels,
+                )
+            self._h_e2e.observe(
+                (completion.finished_at - submitted) * 1e3, **self._tel_labels
+            )
         return [completion]
 
     def _promote(self, slot_id: int, slot: _Slot) -> None:
@@ -776,6 +838,20 @@ class Engine:
         self.stats["prefix_promotions"] += 1
 
     # ------------------------------------------------------------ metrics
+    def latency_summary(self) -> dict:
+        """Registry-backed request-latency percentiles (ms, None until the
+        first completion) — the numbers behind `atx serve`'s ``serve_p50_ms``
+        / ``serve_ttft_p50_ms`` fields, estimated from the same histogram
+        series the `/metrics` endpoint exports."""
+        labels = self._tel_labels
+        return {
+            "p50_ms": self._h_e2e.quantile(0.50, **labels),
+            "p99_ms": self._h_e2e.quantile(0.99, **labels),
+            "ttft_p50_ms": self._h_ttft.quantile(0.50, **labels),
+            "ttft_p99_ms": self._h_ttft.quantile(0.99, **labels),
+            "mean_ms": self._h_e2e.mean(**labels),
+        }
+
     def prefix_metrics(self) -> dict:
         """Prefix-cache counters in reporting shape (`atx serve` JSON /
         bench.py serve phase). ``prefill_saved_frac`` is the fraction of

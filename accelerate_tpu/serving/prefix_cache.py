@@ -88,15 +88,24 @@ class PrefixCache:
         self._root = CacheNode(np.empty((0,), np.int32), 0, None)
         self._entries: set[CacheNode] = set()  # row-bearing nodes
         self._clock = 0
-        self.stats = {
-            "lookups": 0,
-            "hits": 0,
-            "tokens_matched": 0,
-            "insertions": 0,
-            "dedup_skips": 0,
-            "evictions": 0,
-            "insert_denied": 0,  # no free row and every row pinned
-        }
+        # Registry-backed dict view (docs/observability.md): the same
+        # counters feed `engine.prefix_metrics()` and the `/metrics`
+        # endpoint's serve_prefix_cache_* series.
+        from .. import telemetry as _telemetry
+
+        self.stats = _telemetry.StatsView(
+            "serve_prefix_cache",
+            (
+                "lookups",
+                "hits",
+                "tokens_matched",
+                "insertions",
+                "dedup_skips",
+                "evictions",
+                "insert_denied",  # no free row and every row pinned
+            ),
+            label="cache",
+        )
         # Reachability DP over [0, max_len]: _chunkable[n] is the LARGEST
         # bucket completing a decomposition of n into bucket lengths (0 =
         # not decomposable). Handles bucket sets that aren't multiples of

@@ -82,6 +82,7 @@ import numpy as np
 # on the package during lint replay, so the router must read them through
 # the package or the router_drain scenario would dodge the simulation.
 from .. import resilience
+from .. import telemetry as _telemetry
 from ..utils.environment import get_int_from_env
 from .engine import Completion, Engine, Request
 
@@ -286,6 +287,13 @@ def _pct(xs: list[float], q: float) -> float | None:
     return round(s[min(len(s) - 1, int(q * len(s)))], 2)
 
 
+def _hq(hist: Any, q: float, labels: dict) -> float | None:
+    """Histogram-estimated percentile, rounded like the old exact `_pct`
+    (None until data) so `metrics()` keeps its field contract."""
+    value = hist.quantile(q, **labels)
+    return None if value is None else round(value, 2)
+
+
 class Router:
     """Bounded-admission front-end over N `Engine` replicas (module
     docstring has the full design). Typical use::
@@ -373,20 +381,43 @@ class Router:
         self._outstanding = 0
         self._draining = False
         self.drain_reason: str | None = None
-        self._ttft_ms: list[float] = []
-        self._e2e_ms: list[float] = []
-        self.stats = {
-            "submitted": 0,
-            "rejects": 0,
-            "drain_rejected": 0,
-            "dispatched": 0,
-            "completed": 0,
-            "retries": 0,
-            "cancelled": 0,
-            "failed": 0,
-            "replicas_lost": 0,
-            "queue_peak": 0,
-        }
+        # Latency recording + counters live on the telemetry registry
+        # (docs/observability.md): fixed-bucket histograms replace the old
+        # unbounded p50/p99 lists, and `metrics()` reads its percentiles
+        # from the same series the `/metrics` endpoint exports.
+        self._tel_labels = {"router": _telemetry.views._next_instance()}
+        _labels = ("router",)
+        self._h_ttft = _telemetry.histogram(
+            "router_ttft_ms", "admission -> first token", labels=_labels
+        )
+        self._h_e2e = _telemetry.histogram(
+            "router_e2e_ms", "admission -> completion", labels=_labels
+        )
+        self._h_queue_wait = _telemetry.histogram(
+            "router_queue_wait_ms", "admission -> replica dispatch",
+            labels=_labels,
+        )
+        self._g_queue = _telemetry.gauge(
+            "router_queue_depth", "pending admissions", labels=_labels
+        )
+        self.stats = _telemetry.StatsView(
+            "router",
+            (
+                "submitted",
+                "rejects",
+                "drain_rejected",
+                "dispatched",
+                "completed",
+                "retries",
+                "cancelled",
+                "failed",
+                "replicas_lost",
+                "queue_peak",
+            ),
+            label="router",
+            instance=self._tel_labels["router"],
+            gauges=("queue_peak",),
+        )
         if threads:
             for r in self.replicas:
                 r.start()
@@ -447,6 +478,7 @@ class Router:
         self.stats["queue_peak"] = max(
             self.stats["queue_peak"], len(self._pending)
         )
+        self._g_queue.set(len(self._pending), **self._tel_labels)
         return req.rid
 
     # ------------------------------------------------------------- cancel
@@ -587,6 +619,10 @@ class Router:
         r.inflight.add(t.req.rid)
         r.dispatched += 1
         self.stats["dispatched"] += 1
+        self._h_queue_wait.observe(
+            (time.perf_counter() - t.submitted_at) * 1e3, **self._tel_labels
+        )
+        self._g_queue.set(len(self._pending), **self._tel_labels)
         if self.affinity == "prefix":
             # Record at dispatch (not completion) so a burst of same-prefix
             # requests steers together from the second one on.
@@ -670,10 +706,13 @@ class Router:
             self.stats["cancelled"] += 1
         if c.finish_reason not in ("cancelled", "failed"):
             if c.first_token_at:
-                self._ttft_ms.append(
-                    (c.first_token_at - t.submitted_at) * 1000.0
+                self._h_ttft.observe(
+                    (c.first_token_at - t.submitted_at) * 1000.0,
+                    **self._tel_labels,
                 )
-            self._e2e_ms.append((c.finished_at - t.submitted_at) * 1000.0)
+            self._h_e2e.observe(
+                (c.finished_at - t.submitted_at) * 1000.0, **self._tel_labels
+            )
         self.stats["completed"] += 1
         self._outstanding -= 1
         self._completions.append(c)
@@ -823,10 +862,10 @@ class Router:
             queue_capacity=self.queue_depth,
             draining=int(self._draining),
             drain_reason=self.drain_reason,
-            ttft_p50_ms=_pct(self._ttft_ms, 0.50),
-            ttft_p99_ms=_pct(self._ttft_ms, 0.99),
-            e2e_p50_ms=_pct(self._e2e_ms, 0.50),
-            e2e_p99_ms=_pct(self._e2e_ms, 0.99),
+            ttft_p50_ms=_hq(self._h_ttft, 0.50, self._tel_labels),
+            ttft_p99_ms=_hq(self._h_ttft, 0.99, self._tel_labels),
+            e2e_p50_ms=_hq(self._h_e2e, 0.50, self._tel_labels),
+            e2e_p99_ms=_hq(self._h_e2e, 0.99, self._tel_labels),
             per_replica=per,
         )
         return m

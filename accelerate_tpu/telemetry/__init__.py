@@ -1,0 +1,98 @@
+"""Unified runtime telemetry (docs/observability.md).
+
+Dependency-free, hot-path-safe metrics + tracing for training and serving:
+
+- `telemetry.registry` — counters / gauges / fixed-bucket histograms with
+  label sets, Prometheus text rendering, and cross-host aggregation via
+  per-process JSON snapshots merged by proc 0 (no collectives).
+- `telemetry.spans` — wall-clock host spans as Chrome-trace JSONL, bridged
+  into XPlane via ``jax.profiler.TraceAnnotation`` when a
+  `utils/profiler.profile()` capture is running.
+- `telemetry.stepstats` — per-step dispatch-gap vs device-compute split,
+  EMA tokens/sec + achieved MFU, and a recompile counter, wired into the
+  `Accelerator` step helper behind ``ATX_METRICS`` (default on; zero device
+  syncs unless ``ATX_METRICS_SAMPLE_EVERY`` turns the sampler on).
+- `telemetry.export` — stdlib-only Prometheus ``/metrics`` HTTP endpoint
+  (`atx serve --metrics-port`).
+- `telemetry.views.StatsView` — the registry-backed dict view behind the
+  serving engine/router/prefix-cache ``stats`` so the old snapshot shapes
+  and the endpoint read one source of truth.
+
+Knobs: ``ATX_METRICS`` (default 1), ``ATX_METRICS_SAMPLE_EVERY`` (default 0),
+``ATX_METRICS_LOG_EVERY`` (default 0), ``ATX_METRICS_DIR`` (shared snapshot
+dir), ``ATX_METRICS_EMA`` (default 0.2), ``ATX_TRACE_DIR`` (span JSONL).
+"""
+
+from __future__ import annotations
+
+from ..utils.environment import parse_flag_from_env
+from . import export, registry, spans, stepstats, views
+from .export import MetricsServer
+from .registry import (
+    DEFAULT_BYTES_BUCKETS,
+    DEFAULT_MS_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    Registry,
+    aggregate_snapshots,
+    counter,
+    gauge,
+    histogram,
+    merge_snapshots,
+    read_snapshots,
+    render_prometheus,
+    render_snapshot_prometheus,
+    snapshot,
+    write_snapshot,
+)
+from .spans import chrome_trace, span, spans_enabled, start_trace_log, step_span, stop_trace_log
+from .stepstats import StepStats, peak_device_flops, tokens_in_batch
+from .views import StatsView
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "MetricsServer",
+    "Registry",
+    "REGISTRY",
+    "StatsView",
+    "StepStats",
+    "DEFAULT_BYTES_BUCKETS",
+    "DEFAULT_MS_BUCKETS",
+    "aggregate_snapshots",
+    "chrome_trace",
+    "counter",
+    "gauge",
+    "histogram",
+    "merge_snapshots",
+    "metrics_enabled",
+    "peak_device_flops",
+    "read_snapshots",
+    "render_prometheus",
+    "render_snapshot_prometheus",
+    "snapshot",
+    "span",
+    "spans_enabled",
+    "start_trace_log",
+    "step_span",
+    "stop_trace_log",
+    "tokens_in_batch",
+    "write_snapshot",
+    "export",
+    "registry",
+    "spans",
+    "stepstats",
+    "views",
+]
+
+
+def metrics_enabled() -> bool:
+    """The ``ATX_METRICS`` master switch (default ON). Gates the training
+    step-stats hooks and span emission; registry counters themselves always
+    work — they ARE the serving stats."""
+    return parse_flag_from_env("ATX_METRICS", True)

@@ -1,0 +1,120 @@
+"""Prometheus `/metrics` HTTP endpoint (stdlib ``http.server`` only).
+
+One daemon thread serves three routes off the shared registry:
+
+- ``GET /metrics`` — Prometheus text exposition 0.0.4. When constructed
+  with ``snapshot_dir`` (a shared metrics directory, see
+  `registry.write_snapshot`), ``/metrics?fleet=1`` serves the proc-0 merge
+  of every per-process snapshot instead of the local registry — the fleet
+  view for multi-host runs.
+- ``GET /metrics.json`` — the raw `telemetry.snapshot()` dict.
+- ``GET /healthz`` — liveness probe.
+
+Lifecycle: ``close()`` shuts the listener down and joins the thread;
+`atx serve --metrics-port` keeps the endpoint up until the router finishes
+draining so a scraper sees the final counters (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import urlparse, parse_qs
+
+from .registry import (
+    REGISTRY,
+    Registry,
+    aggregate_snapshots,
+    render_snapshot_prometheus,
+)
+
+__all__ = ["MetricsServer", "PROMETHEUS_CONTENT_TYPE"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Background `/metrics` endpoint over a registry.
+
+    ``port=0`` binds an ephemeral port (``.port`` reports the real one —
+    the tests and the smoke lane use this to avoid collisions).
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        host: str = "0.0.0.0",
+        registry: Registry | None = None,
+        snapshot_dir: str | None = None,
+    ):
+        self.registry = registry if registry is not None else REGISTRY
+        self.snapshot_dir = snapshot_dir
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass  # scrapes must not spam the serving logs
+
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                parsed = urlparse(self.path)
+                if parsed.path == "/metrics":
+                    query = parse_qs(parsed.query)
+                    fleet = query.get("fleet", ["0"])[0] not in ("0", "", "false")
+                    body = server.render(fleet=fleet).encode()
+                    self._reply(200, PROMETHEUS_CONTENT_TYPE, body)
+                elif parsed.path == "/metrics.json":
+                    body = json.dumps(server.registry.snapshot()).encode()
+                    self._reply(200, "application/json", body)
+                elif parsed.path == "/healthz":
+                    self._reply(200, "text/plain", b"ok\n")
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="atx-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return int(self._httpd.server_address[1])
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        return f"http://{host}:{self.port}/metrics"
+
+    def render(self, *, fleet: bool = False) -> str:
+        if fleet and self.snapshot_dir:
+            merged = aggregate_snapshots(self.snapshot_dir)
+            if merged.get("metrics"):
+                return render_snapshot_prometheus(merged)
+        return self.registry.render_prometheus()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

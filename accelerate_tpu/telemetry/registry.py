@@ -1,0 +1,594 @@
+"""Metrics registry: counters, gauges, and fixed-bucket histograms.
+
+Dependency-free (stdlib only, no jax import) and hot-path-safe: an update is
+a dict lookup plus a float add under a per-metric lock — no device access,
+no collectives, no allocation after the first observation of a label set.
+
+Cross-host aggregation follows the shared-surface pattern from the elastic
+controller (docs/fault_tolerance.md): each process periodically writes an
+atomic JSON snapshot (``metrics_<proc>.json``) into a shared directory and
+process 0 merges them on read — counters and histogram buckets sum, gauges
+reduce per-metric (``max`` by default). No collectives anywhere; the merge
+is plain file I/O, so it is safe to run from the host loop of a pod
+(pinned by ``atx lint telemetry --multihost 2``).
+
+Prometheus text exposition (rendered by :meth:`Registry.render_prometheus`,
+served by `telemetry.export.MetricsServer`) follows the 0.0.4 format:
+``# HELP`` / ``# TYPE`` headers, histogram ``_bucket{le=...}`` series with a
+cumulative ``+Inf`` bucket plus ``_sum`` / ``_count``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Iterable, Iterator, Mapping, Sequence
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "DEFAULT_MS_BUCKETS",
+    "DEFAULT_BYTES_BUCKETS",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "render_prometheus",
+    "write_snapshot",
+    "read_snapshots",
+    "merge_snapshots",
+    "aggregate_snapshots",
+    "render_snapshot_prometheus",
+]
+
+# Latency buckets (milliseconds): sub-ms dispatch gaps up to 30 s tails.
+DEFAULT_MS_BUCKETS: tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+# Transfer-size buckets (bytes): 1 KiB chunks up to multi-GiB checkpoints.
+DEFAULT_BYTES_BUCKETS: tuple[float, ...] = (
+    1024.0, 65536.0, 1048576.0, 16777216.0, 67108864.0,
+    268435456.0, 1073741824.0, 4294967296.0,
+)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class MetricError(ValueError):
+    """Registration/usage conflict: kind, label names, or bucket mismatch."""
+
+
+class _Metric:
+    """Base: one named metric holding a family of labelled series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: Sequence[str] = ()):
+        self.name = _sanitize_name(name)
+        self.help = help
+        self.label_names: tuple[str, ...] = tuple(labels)
+        self._series: dict[tuple[str, ...], Any] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Mapping[str, Any]) -> tuple[str, ...]:
+        if len(labels) != len(self.label_names) or any(
+            n not in labels for n in self.label_names
+        ):
+            raise MetricError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def series(self) -> list[tuple[dict[str, str], Any]]:
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, key)), self._copy_state(state))
+                for key, state in sorted(self._series.items())
+            ]
+
+    def _copy_state(self, state: Any) -> Any:
+        return state
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    """Monotonic count. ``inc`` on the hot path; ``set_value`` exists only so
+    registry-backed stats views (serving engine/router dicts) can mirror
+    absolute assignments — it is not part of the exposition contract."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def set_value(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+
+class Gauge(_Metric):
+    """Point-in-time value. ``aggregate`` names the cross-process reduction
+    used by :func:`merge_snapshots`: ``max`` (default), ``min``, ``sum``,
+    or ``mean``."""
+
+    kind = "gauge"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        aggregate: str = "max",
+    ):
+        super().__init__(name, help, labels)
+        if aggregate not in ("max", "min", "sum", "mean"):
+            raise MetricError(f"unknown gauge aggregate {aggregate!r}")
+        self.aggregate = aggregate
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram. State per series: per-bucket counts (last
+    entry is the implicit ``+Inf`` overflow), running sum, and count.
+    Quantiles are estimated by linear interpolation inside the bucket that
+    holds the target rank — the same math a PromQL ``histogram_quantile``
+    would do on the exported series."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+    ):
+        super().__init__(name, help, labels)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise MetricError(f"histogram {self.name!r} needs >= 1 bucket bound")
+        self.buckets: tuple[float, ...] = bounds
+
+    def _new_state(self) -> list[Any]:
+        return [[0] * (len(self.buckets) + 1), 0.0, 0]
+
+    def _copy_state(self, state: list[Any]) -> list[Any]:
+        return [list(state[0]), state[1], state[2]]
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = self._series[key] = self._new_state()
+            state[0][idx] += 1
+            state[1] += value
+            state[2] += 1
+
+    def count(self, **labels: Any) -> int:
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            return 0 if state is None else int(state[2])
+
+    def sum(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            return 0.0 if state is None else float(state[1])
+
+    def quantile(self, q: float, **labels: Any) -> float | None:
+        """Estimated q-quantile (q in [0, 1]); None when the series is empty."""
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None or state[2] == 0:
+                return None
+            counts, _, total = list(state[0]), state[1], state[2]
+        return _bucket_quantile(self.buckets, counts, total, q)
+
+    def mean(self, **labels: Any) -> float | None:
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None or state[2] == 0:
+                return None
+            return float(state[1]) / float(state[2])
+
+
+def _bucket_quantile(
+    bounds: Sequence[float], counts: Sequence[int], total: int, q: float
+) -> float:
+    rank = max(0.0, min(1.0, q)) * total
+    cum = 0.0
+    lo = 0.0
+    for i, ub in enumerate(bounds):
+        c = counts[i]
+        if c and cum + c >= rank:
+            frac = (rank - cum) / c
+            return lo + (ub - lo) * frac
+        cum += c
+        lo = ub
+    # Rank fell in the +Inf overflow bucket: clamp to the top finite bound.
+    return float(bounds[-1])
+
+
+class Registry:
+    """Named collection of metrics. ``counter``/``gauge``/``histogram`` are
+    get-or-create and raise :class:`MetricError` on a kind/label/bucket
+    conflict so two call sites cannot silently fork one name."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls: type, name: str, kwargs: dict) -> Any:
+        name = _sanitize_name(name)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise MetricError(
+                        f"metric {name!r} already registered as {existing.kind}"
+                    )
+                if tuple(kwargs.get("labels", ())) != existing.label_names:
+                    raise MetricError(
+                        f"metric {name!r} label mismatch: "
+                        f"{existing.label_names} vs {tuple(kwargs.get('labels', ()))}"
+                    )
+                if cls is Histogram:
+                    want = tuple(sorted(float(b) for b in kwargs.get(
+                        "buckets", DEFAULT_MS_BUCKETS)))
+                    if want != existing.buckets:
+                        raise MetricError(f"metric {name!r} bucket mismatch")
+                return existing
+            metric = cls(name, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "", labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, {"help": help, "labels": labels})
+
+    def gauge(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        aggregate: str = "max",
+    ) -> Gauge:
+        return self._get_or_create(
+            Gauge, name, {"help": help, "labels": labels, "aggregate": aggregate}
+        )
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, {"help": help, "labels": labels, "buckets": buckets}
+        )
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(_sanitize_name(name))
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def clear(self) -> None:
+        """Drop every metric (test isolation; never called at runtime)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-serialisable dump of every series (the `telemetry.snapshot()`
+        API and the cross-process exchange format)."""
+        out: list[dict[str, Any]] = []
+        for metric in self.metrics():
+            entry: dict[str, Any] = {
+                "name": metric.name,
+                "kind": metric.kind,
+                "help": metric.help,
+                "label_names": list(metric.label_names),
+                "series": [],
+            }
+            if isinstance(metric, Gauge):
+                entry["aggregate"] = metric.aggregate
+            if isinstance(metric, Histogram):
+                entry["buckets"] = list(metric.buckets)
+                for labels, state in metric.series():
+                    entry["series"].append(
+                        {
+                            "labels": labels,
+                            "bucket_counts": list(state[0]),
+                            "sum": state[1],
+                            "count": state[2],
+                        }
+                    )
+            else:
+                for labels, value in metric.series():
+                    entry["series"].append({"labels": labels, "value": value})
+            out.append(entry)
+        return {"version": 1, "time_unix": time.time(), "metrics": out}
+
+    def scalars(self, prefix: str = "") -> dict[str, float]:
+        """Flat name -> value view of counters/gauges (labelled series sum),
+        for bench lines and tracker glue."""
+        flat: dict[str, float] = {}
+        for metric in self.metrics():
+            if not metric.name.startswith(prefix):
+                continue
+            if isinstance(metric, Histogram):
+                continue
+            total = 0.0
+            seen = False
+            for _, value in metric.series():
+                total += float(value)
+                seen = True
+            if seen:
+                flat[metric.name] = total
+        return flat
+
+    def render_prometheus(self) -> str:
+        return render_snapshot_prometheus(self.snapshot())
+
+
+# -- Prometheus text rendering (works on live registries and merged snapshots)
+
+
+def _format_value(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _render_labels(labels: Mapping[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_snapshot_prometheus(snap: Mapping[str, Any]) -> str:
+    """Render a snapshot dict (live or merged) as Prometheus text 0.0.4."""
+    lines: list[str] = []
+    for entry in snap.get("metrics", []):
+        name = _sanitize_name(entry["name"])
+        kind = entry["kind"]
+        if entry.get("help"):
+            help_text = str(entry["help"]).replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            bounds = entry.get("buckets", [])
+            for series in entry["series"]:
+                labels = series["labels"]
+                cum = 0
+                for bound, c in zip(bounds, series["bucket_counts"]):
+                    cum += c
+                    extra = 'le="%s"' % _format_value(float(bound))
+                    lines.append(f"{name}_bucket{_render_labels(labels, extra)} {cum}")
+                cum += series["bucket_counts"][len(bounds)]
+                inf = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_render_labels(labels, inf)} {cum}")
+                lines.append(
+                    f"{name}_sum{_render_labels(labels)} "
+                    f"{_format_value(series['sum'])}"
+                )
+                lines.append(f"{name}_count{_render_labels(labels)} {series['count']}")
+        else:
+            for series in entry["series"]:
+                lines.append(
+                    f"{name}{_render_labels(series['labels'])} "
+                    f"{_format_value(series['value'])}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- cross-process snapshot exchange (shared-surface pattern, no collectives)
+
+
+def write_snapshot(
+    directory: str,
+    *,
+    registry: "Registry | None" = None,
+    process_index: int = 0,
+) -> str:
+    """Atomically write this process's snapshot as ``metrics_<proc>.json``."""
+    reg = registry if registry is not None else REGISTRY
+    os.makedirs(directory, exist_ok=True)
+    snap = reg.snapshot()
+    snap["process_index"] = int(process_index)
+    path = os.path.join(directory, f"metrics_{int(process_index)}.json")
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_snapshots(directory: str) -> list[dict[str, Any]]:
+    snaps: list[dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return snaps
+    for fname in names:
+        if not (fname.startswith("metrics_") and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, fname)) as f:
+                snaps.append(json.load(f))
+        except (OSError, ValueError):
+            continue  # torn write loses one interval, never the merge
+    return snaps
+
+
+def merge_snapshots(snaps: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
+    """Proc-0 merge: counters and histogram buckets sum across processes;
+    gauges reduce per their declared aggregate (max/min/sum/mean)."""
+    merged: dict[str, dict[str, Any]] = {}
+    gauge_samples: dict[tuple[str, tuple], list[float]] = {}
+    n_procs = 0
+    for snap in snaps:
+        n_procs += 1
+        for entry in snap.get("metrics", []):
+            name = entry["name"]
+            slot = merged.setdefault(
+                name,
+                {
+                    "name": name,
+                    "kind": entry["kind"],
+                    "help": entry.get("help", ""),
+                    "label_names": list(entry.get("label_names", [])),
+                    "series": {},
+                },
+            )
+            if entry["kind"] == "gauge":
+                slot["aggregate"] = entry.get("aggregate", "max")
+            if entry["kind"] == "histogram":
+                slot.setdefault("buckets", list(entry.get("buckets", [])))
+            for series in entry.get("series", []):
+                key = tuple(sorted(series["labels"].items()))
+                if entry["kind"] == "histogram":
+                    state = slot["series"].get(key)
+                    if state is None:
+                        slot["series"][key] = {
+                            "labels": dict(series["labels"]),
+                            "bucket_counts": list(series["bucket_counts"]),
+                            "sum": series["sum"],
+                            "count": series["count"],
+                        }
+                    else:
+                        state["bucket_counts"] = [
+                            a + b
+                            for a, b in zip(
+                                state["bucket_counts"], series["bucket_counts"]
+                            )
+                        ]
+                        state["sum"] += series["sum"]
+                        state["count"] += series["count"]
+                elif entry["kind"] == "gauge":
+                    gauge_samples.setdefault((name, key), []).append(
+                        float(series["value"])
+                    )
+                    slot["series"][key] = {"labels": dict(series["labels"])}
+                else:
+                    state = slot["series"].get(key)
+                    if state is None:
+                        slot["series"][key] = {
+                            "labels": dict(series["labels"]),
+                            "value": float(series["value"]),
+                        }
+                    else:
+                        state["value"] += float(series["value"])
+    for (name, key), values in gauge_samples.items():
+        agg = merged[name].get("aggregate", "max")
+        if agg == "max":
+            value = max(values)
+        elif agg == "min":
+            value = min(values)
+        elif agg == "sum":
+            value = sum(values)
+        else:
+            value = sum(values) / len(values)
+        merged[name]["series"][key]["value"] = value
+    out_metrics = []
+    for name in sorted(merged):
+        entry = merged[name]
+        entry["series"] = [entry["series"][k] for k in sorted(entry["series"])]
+        out_metrics.append(entry)
+    return {"version": 1, "processes": n_procs, "metrics": out_metrics}
+
+
+def aggregate_snapshots(directory: str) -> dict[str, Any]:
+    """Read + merge every per-process snapshot under ``directory``."""
+    return merge_snapshots(read_snapshots(directory))
+
+
+# -- module-level default registry ----------------------------------------
+
+REGISTRY = Registry()
+
+
+def counter(name: str, help: str = "", labels: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labels)
+
+
+def gauge(
+    name: str, help: str = "", labels: Sequence[str] = (), aggregate: str = "max"
+) -> Gauge:
+    return REGISTRY.gauge(name, help, labels, aggregate)
+
+
+def histogram(
+    name: str,
+    help: str = "",
+    labels: Sequence[str] = (),
+    buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+) -> Histogram:
+    return REGISTRY.histogram(name, help, labels, buckets)
+
+
+def snapshot() -> dict[str, Any]:
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()

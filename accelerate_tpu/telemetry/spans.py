@@ -1,0 +1,184 @@
+"""Span tracer: wall-clock host spans as Chrome-trace JSONL + XPlane bridge.
+
+``span("name")`` times a host-side block. When a span log is open
+(:func:`start_trace_log`, or ``ATX_TRACE_DIR`` at first use) each span is
+appended to ``spans_<proc>.jsonl`` as one Chrome-trace complete event
+(``"ph": "X"``, microsecond ``ts``/``dur``) per line — load with
+:func:`chrome_trace` (wraps the lines into the JSON array Perfetto /
+chrome://tracing expect). Nesting is tracked with a ``contextvars`` stack so
+events carry their parent span and spans in worker threads don't corrupt
+each other.
+
+When a `utils/profiler.py` XPlane capture is active, every span also enters
+a ``jax.profiler.TraceAnnotation`` so the same names line up against the
+device timeline in TensorBoard; ``step_span`` uses ``StepTraceAnnotation``
+so step-time views group ops by step number.
+
+Hot-path safety: with no span log open and no profiler trace running,
+``span()`` yields immediately — one contextvar read, no timestamps, no I/O.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+from ..utils import profiler as _profiler
+
+__all__ = [
+    "span",
+    "step_span",
+    "start_trace_log",
+    "stop_trace_log",
+    "trace_log_path",
+    "spans_enabled",
+    "chrome_trace",
+]
+
+_SPAN_STACK: contextvars.ContextVar[tuple[str, ...]] = contextvars.ContextVar(
+    "atx_span_stack", default=()
+)
+
+_writer_lock = threading.Lock()
+_writer: "_JsonlWriter | None" = None
+_env_checked = False
+
+
+class _JsonlWriter:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def write(self, event: dict[str, Any]) -> None:
+        line = json.dumps(event, separators=(",", ":"))
+        with self._lock:
+            self._f.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def start_trace_log(path: str | None = None) -> str:
+    """Open the span JSONL log. Default path:
+    ``$ATX_TRACE_DIR/spans_<proc>.jsonl``."""
+    global _writer, _env_checked
+    with _writer_lock:
+        if _writer is not None:
+            return _writer.path
+        if path is None:
+            base = os.environ.get("ATX_TRACE_DIR", "atx_trace")
+            path = os.path.join(base, f"spans_{_process_index()}.jsonl")
+        _writer = _JsonlWriter(path)
+        _env_checked = True
+        return path
+
+
+def stop_trace_log() -> None:
+    global _writer, _env_checked
+    with _writer_lock:
+        if _writer is not None:
+            _writer.close()
+            _writer = None
+        _env_checked = True
+
+
+def trace_log_path() -> str | None:
+    writer = _writer
+    return writer.path if writer is not None else None
+
+
+def _maybe_open_from_env() -> "_JsonlWriter | None":
+    # ATX_TRACE_DIR opt-in checked once, on the first span after import.
+    global _env_checked
+    if _env_checked:
+        return _writer
+    with _writer_lock:
+        _env_checked = True
+    if os.environ.get("ATX_TRACE_DIR"):
+        start_trace_log()
+    return _writer
+
+
+def spans_enabled() -> bool:
+    """True when spans do real work (log open or XPlane capture running)."""
+    writer = _writer if _env_checked else _maybe_open_from_env()
+    return writer is not None or _profiler.trace_active()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[None]:
+    """Time a host-side block; near-zero cost while tracing is off."""
+    writer = _writer if _env_checked else _maybe_open_from_env()
+    xplane = _profiler.trace_active()
+    if writer is None and not xplane:
+        yield
+        return
+    stack = _SPAN_STACK.get()
+    token = _SPAN_STACK.set(stack + (name,))
+    cm = _profiler.annotate(name) if xplane else contextlib.nullcontext()
+    start = time.perf_counter()
+    wall_us = time.time() * 1e6
+    try:
+        with cm:
+            yield
+    finally:
+        dur_us = (time.perf_counter() - start) * 1e6
+        _SPAN_STACK.reset(token)
+        if writer is not None:
+            event: dict[str, Any] = {
+                "name": name,
+                "ph": "X",
+                "ts": wall_us,
+                "dur": dur_us,
+                "pid": _process_index(),
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+            }
+            args = dict(attrs)
+            if stack:
+                args["parent"] = stack[-1]
+            if args:
+                event["args"] = args
+            writer.write(event)
+
+
+@contextlib.contextmanager
+def step_span(step: int, name: str = "train") -> Iterator[None]:
+    """Span for one training step, bridged to ``StepTraceAnnotation`` when an
+    XPlane capture is running so TensorBoard numbers the steps."""
+    with _profiler.maybe_step_annotation(step, name=name):
+        with span(f"{name}_step", step=int(step)):
+            yield
+
+
+def current_span() -> str | None:
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else None
+
+
+def chrome_trace(jsonl_path: str) -> dict[str, Any]:
+    """Load a span JSONL file as a Chrome-trace/Perfetto ``traceEvents``
+    object (``json.dump`` the result to get a loadable ``.json`` trace)."""
+    events = []
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

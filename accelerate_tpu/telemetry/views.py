@@ -1,0 +1,107 @@
+"""Dict-shaped views over registry metrics.
+
+The serving engine, router, and prefix cache historically kept plain
+``self.stats`` dicts; their snapshot methods (`Router.metrics()`,
+`engine.prefix_metrics()`, the `atx serve` JSON line) are load-bearing for
+bench compatibility. :class:`StatsView` keeps that dict shape — ``stats["x"] += 1``,
+``dict(stats)``, key iteration — while storing every value in the registry,
+so the `/metrics` endpoint and the old JSON summaries read the SAME numbers
+(one source of truth, no second bookkeeping path).
+
+Each view gets an instance label (e.g. ``engine="3"``): two routers in one
+process never share a series, so per-instance snapshots stay exact while a
+Prometheus ``sum by (__name__)`` still gives the fleet total.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections.abc import MutableMapping
+from typing import Any, Iterator, Mapping, Sequence
+
+from .registry import Counter, Gauge, REGISTRY, Registry
+
+__all__ = ["StatsView"]
+
+_instance_ids = itertools.count()
+_id_lock = threading.Lock()
+
+
+def _next_instance() -> str:
+    with _id_lock:
+        return str(next(_instance_ids))
+
+
+class StatsView(MutableMapping):
+    """Fixed-key mutable mapping backed by labelled registry metrics.
+
+    ``keys`` become counters named ``{prefix}_{key}`` (keys listed in
+    ``gauges`` become gauges — e.g. high-water marks that are assigned, not
+    accumulated). The key set is fixed at construction: assigning an unknown
+    key raises, so a typo cannot silently mint a new metric.
+    """
+
+    def __init__(
+        self,
+        prefix: str,
+        keys: Sequence[str],
+        *,
+        label: str = "instance",
+        instance: str | None = None,
+        gauges: Sequence[str] = (),
+        registry: Registry | None = None,
+    ):
+        reg = registry if registry is not None else REGISTRY
+        self._label = label
+        self._instance = _next_instance() if instance is None else str(instance)
+        self._labels = {label: self._instance}
+        self._metrics: dict[str, Counter | Gauge] = {}
+        gauge_keys = set(gauges)
+        for key in keys:
+            name = f"{prefix}_{key}"
+            if key in gauge_keys:
+                metric: Counter | Gauge = reg.gauge(name, labels=(label,))
+                metric.set(0.0, **self._labels)
+            else:
+                metric = reg.counter(name, labels=(label,))
+                metric.set_value(0.0, **self._labels)
+            self._metrics[key] = metric
+
+    @property
+    def instance(self) -> str:
+        return self._instance
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return dict(self._labels)
+
+    def __getitem__(self, key: str) -> int | float:
+        value = self._metrics[key].value(**self._labels)
+        return int(value) if float(value).is_integer() else value
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        metric = self._metrics[key]  # unknown key -> KeyError, by design
+        if isinstance(metric, Gauge):
+            metric.set(float(value), **self._labels)
+        else:
+            metric.set_value(float(value), **self._labels)
+
+    def __delitem__(self, key: str) -> None:
+        raise TypeError("StatsView has a fixed key set")
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._metrics)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._metrics
+
+    def __repr__(self) -> str:
+        return f"StatsView({dict(self)!r})"
+
+    def update_from(self, other: Mapping[str, Any]) -> None:
+        for key, value in other.items():
+            self[key] = value

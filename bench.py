@@ -56,6 +56,24 @@ _PEAK_FLOPS = {
 }
 
 
+def _phase_snapshot(phase: str) -> None:
+    """Drop a per-phase registry snapshot under ATX_METRICS_DIR (no-op when
+    unset): `<dir>/<phase>/metrics_0.json`, the same exchange format the
+    fleet /metrics endpoint merges — post-hoc phase attribution without
+    parsing the JSON line (docs/observability.md)."""
+    import os
+
+    root = os.environ.get("ATX_METRICS_DIR", "")
+    if not root:
+        return
+    try:
+        from accelerate_tpu import telemetry
+
+        telemetry.write_snapshot(os.path.join(root, phase), process_index=0)
+    except Exception:
+        pass  # telemetry must never sink a bench run
+
+
 def _peak_flops(device: jax.Device) -> float | None:
     kind = getattr(device, "device_kind", "")
     for name, flops in _PEAK_FLOPS.items():
@@ -137,11 +155,23 @@ def main() -> None:
             "loss": final_loss,
         }
     )
+    # Runtime-telemetry view of the same loop (ATX_METRICS, default on):
+    # dispatch-gap exposes a host-bound loop the external wall clock can't
+    # see, and train_mfu cross-checks the hand-computed MFU above from
+    # XLA's own cost analysis of the compiled step.
+    stats = getattr(step, "step_stats", None)
+    if stats is not None:
+        latest = stats.latest()
+        _RESULT["train_dispatch_gap_ms"] = round(latest["train_dispatch_gap_ms"], 2)
+        _RESULT["train_mfu"] = round(latest["train_mfu"], 4)
+        _RESULT["train_compiles"] = int(latest["train_compiles"])
+    _phase_snapshot("train")
     state, batch, metrics = acc.free_memory(state, batch, metrics)
     try:
         _RESULT.update(_bench_bert(on_tpu, fetch_latency))
     except Exception as e:  # never lose the headline MFU number
         _RESULT["bert_error"] = f"{type(e).__name__}: {e}"[:200]
+    _phase_snapshot("bert")
     try:
         # Runs on CPU too (tiny buffer): the engine-vs-blocking comparison
         # is the before/after for the whole transfer-bound family
@@ -149,6 +179,7 @@ def main() -> None:
         _RESULT.update(_bench_transfer(on_tpu))
     except Exception as e:
         _RESULT["transfer_error"] = f"{type(e).__name__}: {e}"[:200]
+    _phase_snapshot("transfer")
     if on_tpu:
         extra_benches = [
             ("longctx", _bench_long_context),
@@ -170,6 +201,7 @@ def main() -> None:
                 _RESULT.update(fn())
             except Exception as e:  # keep the headline fields no matter what
                 _RESULT[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+            _phase_snapshot(name)
 
     signal.signal(signal.SIGTERM, signal.SIG_DFL)  # past the point of partials
     print(json.dumps(_RESULT))
