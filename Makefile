@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -135,8 +135,23 @@ smoke-telemetry:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint telemetry --multihost 2 \
 		--severity error
 
+# CPU chaos lane (docs/fault_tolerance.md, "Chaos campaigns"): the
+# FaultSchedule seed-replay + campaign-digest unit tests, a fixed-seed
+# 12-episode inline campaign over router/engine/replication (exactly-once,
+# bit-identity, drain, no-torn-commit — any violation exits 1), and the
+# router_recovery host-loop replay under 2 simulated processes proving
+# quarantine -> probe -> re-admit -> prefix migration adds NO collectives
+# (error findings fail).
+smoke-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli chaos \
+		--episodes 12 --seed 0 --no-subprocess-episodes
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint router_recovery --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos
 	python -m pytest tests/ -q --heavy

@@ -1,0 +1,62 @@
+"""`accelerate-tpu chaos` — seeded chaos campaign over the serving fleet
+and the checkpoint-replication path (`resilience/chaos.py`,
+docs/fault_tolerance.md "Chaos campaigns").
+
+Every episode's fault schedule derives from ``--seed`` alone, so a
+failing campaign is replayed exactly by re-running with the seed it
+printed; ``--report`` captures one JSON line per episode for triage."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "chaos",
+        help="Run a seeded fault-injection campaign (serving + replication)",
+    )
+    p.add_argument(
+        "--episodes", type=int, default=20,
+        help="Inline episodes to run (default 20)",
+    )
+    p.add_argument(
+        "--seed", type=int, default=None,
+        help="Campaign seed (default: ATX_FAULT_SEED, else 0); the whole "
+        "fault assignment replays from it",
+    )
+    p.add_argument(
+        "--kinds", default="router,engine,replication",
+        help="Comma-separated episode subsystems to rotate through "
+        "(router, engine, replication)",
+    )
+    p.add_argument(
+        "--report", default=None, metavar="PATH",
+        help="Write a JSON-lines per-episode report here",
+    )
+    p.add_argument(
+        "--subprocess-episodes", action="store_true", default=True,
+        help="Append the kill-137 and SIGTERM-drain-75 subprocess episodes "
+        "(default on)",
+    )
+    p.add_argument(
+        "--no-subprocess-episodes", dest="subprocess_episodes",
+        action="store_false",
+        help="Inline episodes only (faster; no worker processes)",
+    )
+    p.set_defaults(func=run)
+
+
+def run(args: argparse.Namespace) -> int:
+    from ..resilience import chaos
+
+    summary = chaos.run_campaign(
+        episodes=args.episodes,
+        seed=args.seed,
+        kinds=tuple(k.strip() for k in args.kinds.split(",") if k.strip()),
+        report_path=args.report,
+        subprocess_episodes=args.subprocess_episodes,
+    )
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if summary["ok"] else 1

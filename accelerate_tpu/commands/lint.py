@@ -832,10 +832,114 @@ def _mh_scenario_telemetry(processes: int = 2):
     )
 
 
+def _mh_scenario_router_recovery(processes: int = 2):
+    """Self-healing router path (docs/serving.md): quarantine ->
+    prefix-cache migration -> probation probe -> re-admission is pure host
+    logic plus single-replica device steps, so it must add ZERO collectives
+    to the schedule (a collective inside recovery would park every healthy
+    process on the dead peer), and the recovery schedule every process
+    replays must be identical."""
+    from .. import analysis
+
+    def recovery_loop():
+        import time as _time
+
+        import jax
+        import numpy as np
+
+        from ..analysis import host_trace
+        from ..generation import GenerationConfig
+        from ..models import llama
+        from ..serving import Engine, Request, Router
+        from ..test_utils import faults
+        from ..utils.environment import patch_environment
+
+        config = llama.LlamaConfig.tiny(vocab_size=64, max_seq_len=64)
+        params = llama.init(jax.random.PRNGKey(0), config)
+
+        def mk_engine() -> Engine:
+            return Engine(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+                lambda b, m: llama.init_cache(config, b, m),
+                params,
+                GenerationConfig(
+                    max_new_tokens=4, eos_token_id=None, pad_token_id=0
+                ),
+                slots=2,
+                buckets=(8,),
+                max_len=32,
+                prefix_cache=True,
+            )
+
+        rng = np.random.RandomState(0)
+        prefix = rng.randint(1, 64, (8,)).astype(np.int32)
+
+        def req(i):
+            tail = rng.randint(1, 64, (2,)).astype(np.int32)
+            return Request(prompt=np.concatenate([prefix, tail]), rid=i)
+
+        engines = [mk_engine(), mk_engine()]
+        # Warm replica 0's prefix cache (and both compile caches) OUTSIDE
+        # the router so quarantine deterministically has a hot committed
+        # prefix to migrate.
+        for eng in engines:
+            eng.submit(np.concatenate([prefix, np.asarray([1, 2], np.int32)]), 2)
+            eng.run_until_idle()
+        reqs = [req(i) for i in range(4)]
+        faults._reset_counters()  # the @N counter must restart per process
+        rec = host_trace._ACTIVE_RECORDER
+
+        def n_collectives() -> int:
+            # Jitted single-replica dispatches (canary replay, migration
+            # warm-ups) are aligned schedule events but not cross-process
+            # traffic; the recovery ban is on TRUE collectives.
+            if rec is None:
+                return 0
+            return sum(1 for e in rec.collective_events if e.kind != "dispatch")
+
+        before = n_collectives()
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@2"):
+            router = Router(
+                engines,
+                threads=False,
+                readmit_secs=0.001,
+                probation_completions=1,
+                engine_factory=mk_engine,
+            )
+            for r in reqs:
+                router.submit_request(r)
+            out = router.join()
+            deadline = _time.time() + 30.0
+            while int(router.metrics()["readmissions"]) < 1:
+                assert _time.time() < deadline, "no re-admission within 30s"
+                router.poll(0.002)
+            router.close()
+        after = n_collectives()
+        m = router.metrics()
+        assert len(out) == len(reqs), f"recovery lost requests: {len(out)}"
+        assert m["replicas_lost"] == 1 and m["readmissions"] >= 1, m
+        assert m["migrated_prefixes"] >= 1, m
+        assert m["replicas_alive"] == 2, m
+        assert after == before, (
+            f"quarantine/probe/readmit/migration added {after - before} "
+            "collective(s)"
+        )
+
+    report = analysis.lint_host_loop(
+        recovery_loop, processes=processes, target="router_recovery"
+    )
+    return (
+        f"2-replica router: replica-0 fault, prefix migration, probe + "
+        f"re-admission, {processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
     "router_drain": _mh_scenario_router_drain,
+    "router_recovery": _mh_scenario_router_recovery,
     "replicated_save": _mh_scenario_replicated_save,
     "elastic_restore": _mh_scenario_elastic_restore,
     "shrink": _mh_scenario_shrink,

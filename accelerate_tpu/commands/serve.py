@@ -15,8 +15,10 @@ acceptance bar is >= 3x on a real chip).
 
 ``--replicas N`` (N >= 2) serves the trace through the multi-replica
 `serving.Router` instead — N identically configured engines behind
-prefix-affinity routing and a bounded admission queue (``--queue-depth``,
-``--affinity``); router fleet metrics join the JSON line as
+prefix-affinity routing, a bounded EDF/priority admission queue
+(``--queue-depth``, ``--affinity``, ``--scheduling``), and optional
+replica re-admission after quarantine (``--readmit-secs``); router
+fleet metrics join the JSON line as
 ``serve_router_*`` keys, and a SIGTERM mid-trace drains gracefully and
 exits 75 (the elastic-launcher resume contract — docs/serving.md).
 """
@@ -126,6 +128,24 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         default="prefix",
         help="router placement policy: prefix-affinity steering with "
         "least-loaded fallback, or pure least-loaded",
+    )
+    p.add_argument(
+        "--scheduling",
+        choices=("edf", "fifo"),
+        default="edf",
+        help="router admission order: earliest-deadline-first over "
+        "priority classes with load shedding (edf, default) or plain "
+        "arrival order (fifo — the pre-self-healing behaviour)",
+    )
+    p.add_argument(
+        "--readmit-secs",
+        type=float,
+        default=None,
+        metavar="SECS",
+        help="probe a quarantined replica after SECS (capped-exponential "
+        "backoff) and re-admit it under probation once its canary replays "
+        "bit-identically (ATX_SERVE_READMIT_SECS; default: off — a lost "
+        "replica stays quarantined)",
     )
     p.add_argument(
         "--metrics-port",
@@ -247,7 +267,14 @@ def run(args: argparse.Namespace) -> int:
         engines = [mk_engine() for _ in range(args.replicas)]
         engine = engines[0]
         router = Router(
-            engines, queue_depth=args.queue_depth, affinity=args.affinity
+            engines,
+            queue_depth=args.queue_depth,
+            affinity=args.affinity,
+            scheduling=args.scheduling,
+            readmit_secs=args.readmit_secs,
+            # A fatally wedged replica is rebuilt from scratch at probe
+            # time rather than trusting mid-step engine state.
+            engine_factory=mk_engine,
         )
     else:
         engine = mk_engine()

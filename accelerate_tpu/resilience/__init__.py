@@ -45,6 +45,12 @@ notice, or a hung step a *recoverable* event:
   emergency-save → relaunch → restore cycle, which stays as the fallback
   whenever agreement or the reshard fails.
 
+- :mod:`~accelerate_tpu.resilience.chaos` — seeded, replayable chaos
+  campaigns (`atx chaos`): episodes sample fault schedules over the
+  registered crash points and assert exactly-once/bit-identity/drain/
+  no-lost-checkpoint invariants. Imported lazily (it pulls in serving);
+  not re-exported here.
+
 Fault-injection hooks (`commit.fault_point`) are no-ops unless one of the
 ``ATX_FAULT_{KILL,RAISE}_AT`` env vars is set; the test harness that drives
 them lives in `test_utils/faults.py`. See docs/fault_tolerance.md.

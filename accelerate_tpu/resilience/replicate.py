@@ -116,6 +116,14 @@ class ObjectStore:
             raise ValueError(f"invalid range start={start} length={length}")
         return self.get_bytes(key)[start : start + length]
 
+    def copy(self, src_key: str, dst_key: str) -> None:
+        """Copy one object to a new key inside the store. The base
+        implementation round-trips through the client (get + put — correct
+        for any store); LocalObjectStore overrides with a server-side file
+        copy, and real object stores should use their native server-side
+        copy so differential replication never re-sends unchanged bytes."""
+        self.put_bytes(self.get_bytes(src_key), dst_key)
+
     def exists(self, key: str) -> bool:
         return self.stat(key) is not None
 
@@ -214,6 +222,16 @@ class LocalObjectStore(ObjectStore):
                 if key.startswith(prefix):
                     out.append(key)
         return sorted(out)
+
+    def copy(self, src_key: str, dst_key: str) -> None:
+        src = self._path(src_key)
+        if not os.path.isfile(src):
+            raise ObjectStoreError(f"no object {src_key!r} in {self.root}")
+        dst = self._path(dst_key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + f".put.{os.getpid()}"
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dst)
 
     def delete(self, key: str) -> None:
         try:
@@ -332,6 +350,7 @@ class Replicator:
         # Observability counters (read by tests and the drain log line).
         self.parts_uploaded = 0
         self.parts_skipped = 0
+        self.parts_unchanged = 0
         self.checkpoints_replicated = 0
         self.failures = 0
         self.last_error: str | None = None
@@ -345,6 +364,10 @@ class Replicator:
             "replicate_parts_uploaded", "Checkpoint parts uploaded to the object store")
         self._c_parts_skipped = _telemetry.counter(
             "replicate_parts_skipped", "Checkpoint parts skipped (already durable)")
+        self._c_parts_unchanged = _telemetry.counter(
+            "replicate_parts_unchanged",
+            "Checkpoint parts satisfied by server-side copy from the "
+            "previous remote checkpoint (SHA-256 unchanged)")
         self._c_checkpoints = _telemetry.counter(
             "replicate_checkpoints", "Checkpoint directories fully replicated")
         self._c_failures = _telemetry.counter(
@@ -461,11 +484,19 @@ class Replicator:
                 f"{directory} has no manifests; pre-manifest legacy "
                 "checkpoints are not replicated"
             )
+        # Differential replication: shards whose SHA-256 already exists in
+        # the previous remote checkpoint's aggregate manifest are satisfied
+        # by a server-side copy instead of a re-upload (frozen params, EMA
+        # shadows, and data-loader state are often byte-identical between
+        # consecutive checkpoints).
+        prev_index = self._previous_manifest_index(job, prefix)
         for mname in manifests:
             with open(os.path.join(directory, mname)) as f:
                 manifest = json.load(f)
             for rel, info in manifest["files"].items():
-                self._upload_part(directory, prefix, rel, info, deadline)
+                self._upload_part(
+                    directory, prefix, rel, info, deadline, prev_index=prev_index
+                )
         # 2. the manifests themselves, then the aggregate — a restore needs
         #    them to verify, so they precede the marker.
         for mname in manifests:
@@ -494,6 +525,35 @@ class Replicator:
         if job.total_limit is not None:
             self._rotate_remote(job, prefix)
 
+    def _previous_manifest_index(self, job: _Job, current_prefix: str) -> dict[str, str]:
+        """``{sha256: remote_key}`` over every file of the NEWEST previous
+        committed remote checkpoint, parsed from its aggregate manifest.
+        Any failure (no previous checkpoint, missing/corrupt aggregate,
+        store error) degrades to an empty index — differential copy is an
+        optimization, never a correctness dependency."""
+        try:
+            root = (
+                f"node_{job.process_index}/"
+                if (job.each_node and job.num_processes > 1)
+                else ""
+            )
+            committed = remote_committed_checkpoints(self.store, node_prefix=root)
+            prev = next(
+                (p for _, p in reversed(committed) if p != current_prefix), None
+            )
+            if prev is None:
+                return {}
+            agg = json.loads(
+                self.store.get_bytes(f"{prev}/{_commit.AGG_MANIFEST}").decode("utf-8")
+            )
+            index: dict[str, str] = {}
+            for proc in agg.get("processes", {}).values():
+                for rel, info in proc.get("files", {}).items():
+                    index[info["sha256"]] = f"{prev}/{rel.replace(os.sep, '/')}"
+            return index
+        except Exception:
+            return {}
+
     def _upload_part(
         self,
         directory: str,
@@ -501,6 +561,8 @@ class Replicator:
         rel: str,
         info: dict[str, Any] | None,
         deadline: float,
+        *,
+        prev_index: dict[str, str] | None = None,
     ) -> None:
         local = os.path.join(directory, rel)
         key = f"{prefix}/{rel.replace(os.sep, '/')}"
@@ -514,6 +576,19 @@ class Replicator:
                 self.parts_skipped += 1
                 self._c_parts_skipped.inc()
                 return
+            src = (prev_index or {}).get(info["sha256"])
+            if src is not None and src != key:
+                # Single attempt, no retries: a failed copy costs one round
+                # trip and the part simply uploads the normal way.
+                try:
+                    self.store.copy(src, key)
+                except Exception:
+                    pass
+                else:
+                    self.parts_unchanged += 1
+                    self._c_parts_unchanged.inc()
+                    fault_point("replicate.part_uploaded")
+                    return
         self._throttle(os.path.getsize(local))
         self._with_retries(key, lambda: self.store.put_file(local, key), deadline)
         self.parts_uploaded += 1

@@ -1,9 +1,11 @@
 """Continuous-batching serving: slot-paged KV cache, bucketed chunked
 prefill, iteration-level scheduling, automatic prefix caching
 (radix-tree KV reuse across requests), and a multi-replica front-end
-(prefix-affinity routing, bounded admission, graceful drain, replica
-failover). See `serving/engine.py`, `serving/prefix_cache.py`,
-`serving/router.py`, and docs/serving.md."""
+(prefix-affinity routing, EDF/priority admission scheduling with
+load shedding, graceful drain, replica failover with probation &
+re-admission, and prefix-cache migration on quarantine). See
+`serving/engine.py`, `serving/prefix_cache.py`, `serving/router.py`,
+and docs/serving.md."""
 
 from .engine import (
     Completion,
@@ -16,6 +18,7 @@ from .engine import (
 from .prefix_cache import PrefixCache
 from .router import (
     AffinityIndex,
+    DeadlineInfeasibleError,
     NoHealthyReplicaError,
     QueueFullError,
     Router,
@@ -34,5 +37,6 @@ __all__ = [
     "AffinityIndex",
     "QueueFullError",
     "RouterDraining",
+    "DeadlineInfeasibleError",
     "NoHealthyReplicaError",
 ]

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import resilience
 from .. import telemetry as _telemetry
 from ..generation import GenerationConfig, warp_logits
 from ..models.layers import cache_slot_copy, cache_slot_view, cache_slot_write
@@ -98,7 +99,11 @@ class Request:
     depend on which other requests share the batch. ``max_new_tokens=None``
     falls back to the engine config's budget; ``stop_sequences`` are
     multi-token stop strings matched HOST-side against the emitted tail
-    (the device step never sees them — no recompiles per stop set)."""
+    (the device step never sees them — no recompiles per stop set).
+    ``priority`` is the request's admission class for `serving.Router`
+    (lower = more important, default 1; the engine itself ignores it):
+    under EDF scheduling a lower class is dispatched first at equal
+    deadlines and is the last to be shed under overload."""
 
     prompt: np.ndarray
     max_new_tokens: int | None = None
@@ -111,6 +116,7 @@ class Request:
     # (the engine itself never expires a request): on expiry the request
     # is cancelled mid-queue or mid-decode with finish_reason="cancelled".
     timeout: float | None = None
+    priority: int = 1
 
 
 @dataclasses.dataclass
@@ -123,7 +129,9 @@ class Completion:
     ``tokens``) / ``"length"`` (budget exhausted) / ``"cancelled"``
     (`Engine.cancel` — deadline expiry or caller cancellation; ``tokens``
     holds whatever was generated before the cancel) / ``"failed"``
-    (`serving.Router` only: replica deaths exhausted the retry budget)."""
+    (`serving.Router` only: replica deaths exhausted the retry budget) /
+    ``"shed"`` (`serving.Router` only: evicted from the admission queue
+    under overload to make room for a higher-priority request)."""
 
     rid: int
     prompt: np.ndarray
@@ -517,6 +525,21 @@ class Engine:
             finish_reason="cancelled",
         )
 
+    def abort_inflight(self) -> list[Completion]:
+        """Cancel EVERYTHING queued or in a slot, returning the cancelled
+        completions. Leaves the engine idle with every slot free — used to
+        sanitize an engine between chaos episodes and before a re-admission
+        probe replays the canary on a quarantined replica (whatever the
+        fault left mid-flight must not contaminate the probe)."""
+        rids = [req.rid for req in self._queue]
+        rids += [s.req.rid for s in self._slots if s is not None]
+        out = []
+        for rid in rids:
+            c = self.cancel(rid)
+            if c is not None:
+                out.append(c)
+        return out
+
     # ---------------------------------------------------------- scheduler
     @property
     def busy(self) -> bool:
@@ -599,6 +622,9 @@ class Engine:
         prefill chunk OR one decode step over the slot batch (prefill and
         decode alternate per ``prefill_interleave`` when both are pending).
         Returns the requests that finished this iteration."""
+        # Engine-level chaos injection point (test_utils/faults.py): a
+        # cheap env-membership check when no fault is armed.
+        resilience.fault_point("engine.step")
         self._admit()
         decoding = [i for i, s in enumerate(self._slots) if s is not None and s.decoding]
         if self._prefill_order and (not decoding or self._decode_credit <= 0):

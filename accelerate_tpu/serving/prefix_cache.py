@@ -217,6 +217,27 @@ class PrefixCache:
             raise RuntimeError("release() without a matching match() pin")
         node.refs -= 1
 
+    def hot_entries(self, k: int) -> list[np.ndarray]:
+        """Token paths of the ``k`` most-recently-used cached prefixes —
+        HOST-side token ids only, newest first. Each path is the full
+        root-to-node token sequence truncated to the node's committed
+        ``end`` (chunk-aligned by construction). This is the migration
+        surface the Router uses on quarantine: the dying replica's hottest
+        prefixes are re-seeded into survivors by re-PREFILLING these
+        tokens there — KV bytes never cross devices."""
+        if k <= 0:
+            return []
+        out: list[np.ndarray] = []
+        for node in sorted(self._entries, key=lambda n: -n.last_use)[:k]:
+            parts: list[np.ndarray] = []
+            cur: CacheNode | None = node
+            while cur is not None and len(cur.edge):
+                parts.append(cur.edge)
+                cur = cur.parent
+            path = np.concatenate(list(reversed(parts))) if parts else np.empty((0,), np.int32)
+            out.append(np.asarray(path[: node.end], np.int32))
+        return out
+
     # ------------------------------------------------------------- insert
     def insert(self, tokens: np.ndarray) -> int | None:
         """Register ``tokens`` (an `aligned`-length committed prefix) and

@@ -18,30 +18,60 @@ one-engine-per-host. Five mechanics:
   ``affinity_max_imbalance`` (how many extra in-flight requests affinity
   may pile onto one replica before balance wins) set the trade-off;
   ``affinity="least-loaded"`` disables steering entirely.
-- **Admission control & backpressure** — the queue of
+- **Admission scheduling & backpressure** — the queue of
   accepted-but-undispatched requests is bounded (``queue_depth``, env
-  ``ATX_SERVE_QUEUE_DEPTH``, default 4x total fleet slots); a full queue
-  raises `QueueFullError` (a reject the caller SEES, counted in
-  ``stats["rejects"]``). Per-request deadlines (`Request.timeout`
-  seconds) cancel mid-queue or mid-decode with
-  ``finish_reason="cancelled"``; `Router.cancel` does the same on demand.
+  ``ATX_SERVE_QUEUE_DEPTH``, default 4x total fleet slots) and, by
+  default (``scheduling="edf"``), dispatched earliest-deadline-first
+  within priority classes (`Request.priority`, lower = more important;
+  requests without deadlines order after deadlined peers, FIFO within a
+  class — so a homogeneous trace reproduces the old FIFO order exactly).
+  Under overload a full queue *sheds*: an arriving request of a strictly
+  more important class evicts the newest queued request of the least
+  important class (``finish_reason="shed"``, `router_shed_total{class}`)
+  instead of being rejected; arrivals that don't outrank anyone still get
+  `QueueFullError`. Requests whose deadline is already infeasible given
+  the observed service time and the work ahead of them are rejected at
+  the front door (`DeadlineInfeasibleError`,
+  `router_deadline_infeasible_total`) once the e2e histogram has data.
+  Per-request deadlines (`Request.timeout` seconds) still cancel
+  mid-queue or mid-decode with ``finish_reason="cancelled"``;
+  `Router.cancel` does the same on demand. ``scheduling="fifo"`` restores
+  strict arrival order with reject-only overload behaviour.
 - **Graceful drain** — every `poll` reads
   ``resilience.preemption_requested()`` (SIGTERM / the GCE maintenance
   poller); when set, the router stops admitting (`RouterDraining`),
   finishes everything already accepted, and the caller exits with
   ``resilience.PREEMPTION_EXIT_CODE`` (75) so an elastic launcher resumes
   it (`atx serve --replicas` does exactly this).
-- **Replica failover** — a replica whose thread raises (including
-  `test_utils.faults` injection at the ``router.replica<i>.step`` crash
-  points) or wedges (per-replica `resilience.Watchdog` on step-entry
-  heartbeats; ``watchdog_secs`` / ``ATX_SERVE_REPLICA_WATCHDOG_SECS``) is
-  **quarantined**: its in-flight requests are re-dispatched to healthy
-  replicas (up to ``max_retries`` attempts, then
-  ``finish_reason="failed"``). Greedy outputs stay bit-identical to a
-  solo `Engine` regardless of routing, retries, or replica death: tokens
-  are a pure function of (prompt, seed, config, params), so a retry is a
-  replay — and per-ticket stream dedup delivers each token's callback
-  exactly once even when an attempt died mid-decode.
+- **Replica failover, probation & re-admission** — a replica whose
+  thread raises (including `test_utils.faults` injection at the
+  ``router.replica<i>.step`` crash points) or wedges (per-replica
+  `resilience.Watchdog` on step-entry heartbeats; ``watchdog_secs`` /
+  ``ATX_SERVE_REPLICA_WATCHDOG_SECS``) is **quarantined**: its in-flight
+  requests are re-dispatched to healthy replicas (up to ``max_retries``
+  attempts, then ``finish_reason="failed"``), metered by a fleet-wide
+  **retry budget** (token bucket: ``ATX_SERVE_RETRY_BUDGET`` capacity,
+  ``ATX_SERVE_RETRY_REFILL_PER_SEC`` refill) so a sick fleet degrades to
+  visible ``failed`` completions instead of a retry storm. With
+  ``readmit_secs`` / ``ATX_SERVE_READMIT_SECS`` set, quarantine is not
+  forever: after a capped-exponential + jittered backoff the replica is
+  **probed** — a canary request recorded from real traffic is replayed
+  directly on the idle quarantined engine and must reproduce the healthy
+  fleet's tokens bit-for-bit (greedy determinism makes this exact) — and
+  on success re-admitted under **probation** (dispatch capped to one
+  in-flight request until ``ATX_SERVE_PROBATION_COMPLETIONS`` clean
+  completions). A probe failure (or a wedged engine) rebuilds the
+  replica from ``engine_factory`` (fresh engine, same weights) when one
+  is provided. On quarantine the dead replica's hottest committed
+  prefix-cache entries (HOST-side token ids) are **migrated**: re-seeded
+  into a surviving replica by internal warm-up prefills (KV is
+  re-prefilled, never copied cross-device) and the `AffinityIndex`
+  retargeted so the family's future traffic steers at the warm survivor.
+  Greedy outputs stay bit-identical to a solo `Engine` regardless of
+  routing, retries, replica death, or re-admission: tokens are a pure
+  function of (prompt, seed, config, params), so a retry is a replay —
+  and per-ticket stream dedup delivers each token's callback exactly
+  once even when an attempt died mid-decode.
 - **Aggregate observability** — `Router.metrics()` snapshots fleet
   counters (queue depth/peak, rejects, retries, cancels, drains,
   TTFT/e2e p50/p99) plus per-replica occupancy, prefix hit rate, and
@@ -70,6 +100,7 @@ from __future__ import annotations
 
 import os
 import queue
+import random
 import threading
 import time
 from collections import deque
@@ -91,14 +122,28 @@ __all__ = [
     "AffinityIndex",
     "QueueFullError",
     "RouterDraining",
+    "DeadlineInfeasibleError",
     "NoHealthyReplicaError",
 ]
+
+# Internal warm-up requests (prefix-cache migration) ride the normal
+# dispatch path at a priority no user class should ever use: they fill
+# idle capacity, never displace traffic, and are first to be shed.
+_INTERNAL_PRIORITY = 1_000_000
 
 
 class QueueFullError(RuntimeError):
     """Admission queue at ``queue_depth``: the request was REJECTED (never
     queued). Callers retry with backoff or shed load — the visible
     backpressure signal (`stats["rejects"]` counts these)."""
+
+
+class DeadlineInfeasibleError(QueueFullError):
+    """The request's deadline cannot be met given the observed service
+    time and the queue ahead of it — rejected at admission so the caller
+    can fail over instead of burning fleet time on a doomed request.
+    Subclasses `QueueFullError` so overload-aware callers (retry with
+    backoff / shed) handle both the same way."""
 
 
 class RouterDraining(RuntimeError):
@@ -135,6 +180,18 @@ class AffinityIndex:
         steering traffic at it would be pure imbalance."""
         self._entries = deque((p, r) for p, r in self._entries if r != replica)
 
+    def retarget(self, replica: int, target: int) -> int:
+        """Re-point a quarantined replica's entries at ``target`` — the
+        survivor its hot prefixes were migrated to — so the prefix
+        families keep steering at warm KV instead of being forgotten.
+        Returns how many entries moved."""
+        moved = 0
+        for i, (p, r) in enumerate(self._entries):
+            if r == replica:
+                self._entries[i] = (p, int(target))
+                moved += 1
+        return moved
+
     def best(self, prompt: np.ndarray) -> dict[int, int]:
         """Longest shared-prefix length per replica for ``prompt``."""
         prompt = np.asarray(prompt, np.int32)
@@ -156,9 +213,10 @@ class _Ticket:
     __slots__ = (
         "req", "user_stream", "submitted_at", "deadline", "replica",
         "attempts", "generation", "streamed", "cancel_sent", "done",
+        "seq", "internal",
     )
 
-    def __init__(self, req: Request) -> None:
+    def __init__(self, req: Request, seq: int = 0) -> None:
         self.req = req
         self.user_stream = req.stream
         self.submitted_at = time.perf_counter()
@@ -174,6 +232,14 @@ class _Ticket:
         self.streamed = 0  # tokens delivered to the user stream so far
         self.cancel_sent = False
         self.done = False
+        # Admission sequence number: the EDF tiebreak (FIFO within a
+        # class) — retries keep their original seq so age order survives
+        # a re-dispatch, exactly like the old appendleft requeue.
+        self.seq = seq
+        # Internal tickets (prefix-cache migration warm-ups) bypass the
+        # admission bound and are invisible to callers: no completion
+        # surfaced, no latency observed, not counted as submissions.
+        self.internal = False
 
 
 class _Replica:
@@ -207,6 +273,15 @@ class _Replica:
         self.dispatched = 0
         self.completed = 0
         self._stopping = False
+        self._watchdog_secs = watchdog_secs
+        # Re-admission state (router thread only): when the router has
+        # readmit enabled, a quarantine schedules a probe at ``probe_at``;
+        # a readmitted replica serves under probation (dispatch capped to
+        # one in-flight) until ``probation_left`` clean completions.
+        self.quarantines = 0
+        self.probe_at: float | None = None
+        self.probation_left = 0
+        self.rebuilds = 0
         self.watchdog: resilience.Watchdog | None = None
         if watchdog_secs:
             # The abort seam turns the watchdog's process-kill into a
@@ -279,6 +354,32 @@ class _Replica:
             if self.watchdog is not None:
                 self.watchdog.stop()
 
+    def respawn(self) -> None:
+        """Bring a quarantined replica back after a successful probe:
+        fresh liveness state, fresh watchdog, and (threads mode) a fresh
+        driver thread. The old thread is guaranteed gone or permanently
+        parked (a wedged replica is only respawned after an engine
+        rebuild), so single-thread engine ownership is preserved."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        with self.inbox_lock:
+            self.inbox.clear()
+        self.dead = False
+        self.error = None
+        self.wedged = threading.Event()
+        self.wake = threading.Event()
+        self._stopping = False
+        self.probe_at = None
+        self.watchdog = None
+        if self._watchdog_secs:
+            self.watchdog = resilience.Watchdog(
+                self._watchdog_secs,
+                first_deadline_secs=self._watchdog_secs * 10.0,
+                abort=self._wedge,
+            )
+        if self.router.threads:
+            self.start()
+
 
 def _pct(xs: list[float], q: float) -> float | None:
     if not xs:
@@ -319,6 +420,13 @@ class Router:
         max_retries: int = 2,
         watchdog_secs: float | None = None,
         threads: bool = True,
+        scheduling: str = "edf",
+        readmit_secs: float | None = None,
+        probation_completions: int | None = None,
+        retry_budget: int | None = None,
+        retry_refill_per_sec: float | None = None,
+        migrate_prefixes: int | None = None,
+        engine_factory: Callable[[], Engine] | None = None,
     ) -> None:
         engines = list(engines)
         if not engines:
@@ -358,6 +466,57 @@ class Router:
             else max(1, ref.n_slots - 1)
         )
         self.max_retries = max_retries
+        if scheduling not in ("edf", "fifo"):
+            raise ValueError(
+                f"scheduling must be 'edf' or 'fifo', got {scheduling!r}"
+            )
+        self.scheduling = scheduling
+        # Re-admission: None/<=0 disables (a quarantined replica stays
+        # dead forever — the pre-PR-14 behaviour, and what the fail-stop
+        # tests rely on). Env: ATX_SERVE_READMIT_SECS.
+        if readmit_secs is None:
+            raw = os.environ.get("ATX_SERVE_READMIT_SECS", "")
+            try:
+                readmit_secs = float(raw) if raw else None
+            except ValueError:
+                readmit_secs = None
+        if readmit_secs is not None and readmit_secs <= 0:
+            readmit_secs = None
+        self.readmit_secs = readmit_secs
+        self.probation_completions = (
+            probation_completions
+            if probation_completions is not None
+            else get_int_from_env(("ATX_SERVE_PROBATION_COMPLETIONS",), 3)
+        )
+        # Fleet-wide failover retry budget (token bucket). Capacity < 0
+        # means unlimited (the pre-PR-14 behaviour).
+        self.retry_budget = (
+            retry_budget
+            if retry_budget is not None
+            else get_int_from_env(("ATX_SERVE_RETRY_BUDGET",), 16)
+        )
+        if retry_refill_per_sec is None:
+            raw = os.environ.get("ATX_SERVE_RETRY_REFILL_PER_SEC", "")
+            try:
+                retry_refill_per_sec = float(raw) if raw else 1.0
+            except ValueError:
+                retry_refill_per_sec = 1.0
+        self.retry_refill_per_sec = max(0.0, retry_refill_per_sec)
+        self._retry_tokens = float(max(self.retry_budget, 0))
+        self._retry_refill_at = time.perf_counter()
+        self.migrate_prefixes = (
+            migrate_prefixes
+            if migrate_prefixes is not None
+            else get_int_from_env(("ATX_SERVE_MIGRATE_PREFIXES",), 4)
+        )
+        self.engine_factory = engine_factory
+        # Probe-backoff jitter only perturbs WHEN a probe runs, never what
+        # any request computes, so a fixed seed keeps runs comparable.
+        self._rng = random.Random(0xA7C)
+        # Canary recorded from real traffic: (prompt, seed, ref_tokens, k).
+        # A probe replays it on the quarantined engine and the first k
+        # tokens must match bit-for-bit (greedy determinism).
+        self._canary: tuple[np.ndarray, int, np.ndarray, int] | None = None
         if watchdog_secs is None:
             raw = os.environ.get("ATX_SERVE_REPLICA_WATCHDOG_SECS", "")
             try:
@@ -378,9 +537,13 @@ class Router:
         self._tickets: dict[int, _Ticket] = {}
         self._completions: list[Completion] = []
         self._next_rid = 0
+        self._next_seq = 0
         self._outstanding = 0
         self._draining = False
         self.drain_reason: str | None = None
+        self._classes_seen: set[int] = set()
+        self._shed_by_class: dict[int, int] = {}
+        self._migrated_prefixes = 0
         # Latency recording + counters live on the telemetry registry
         # (docs/observability.md): fixed-bucket histograms replace the old
         # unbounded p50/p99 lists, and `metrics()` reads its percentiles
@@ -399,6 +562,46 @@ class Router:
         )
         self._g_queue = _telemetry.gauge(
             "router_queue_depth", "pending admissions", labels=_labels
+        )
+        # Self-healing / overload series (ISSUE names keep the Prometheus
+        # `_total` suffix convention for monotone counters).
+        self._c_shed = _telemetry.counter(
+            "router_shed_total",
+            "requests evicted from the admission queue under overload",
+            labels=("router", "class"),
+        )
+        self._c_readmit = _telemetry.counter(
+            "router_readmissions_total",
+            "quarantined replicas probed healthy and re-admitted",
+            labels=_labels,
+        )
+        self._c_probe_fail = _telemetry.counter(
+            "router_probe_failures_total",
+            "re-admission probes that failed (canary mismatch or error)",
+            labels=_labels,
+        )
+        self._c_retry_exhausted = _telemetry.counter(
+            "router_retry_budget_exhausted_total",
+            "failover retries denied by the fleet retry budget",
+            labels=_labels,
+        )
+        self._c_infeasible = _telemetry.counter(
+            "router_deadline_infeasible_total",
+            "requests rejected at admission: deadline unmeetable",
+            labels=_labels,
+        )
+        self._c_migrated = _telemetry.counter(
+            "router_migrated_prefixes_total",
+            "hot prefix-cache entries re-seeded into survivors on quarantine",
+            labels=_labels,
+        )
+        self._h_class_ttft = _telemetry.histogram(
+            "router_class_ttft_ms", "admission -> first token, per class",
+            labels=("router", "class"),
+        )
+        self._h_class_e2e = _telemetry.histogram(
+            "router_class_e2e_ms", "admission -> completion, per class",
+            labels=("router", "class"),
         )
         self.stats = _telemetry.StatsView(
             "router",
@@ -433,11 +636,15 @@ class Router:
         arrival: float | None = None,
         stop_sequences: Sequence[Sequence[int]] | None = None,
         timeout: float | None = None,
+        priority: int = 1,
     ) -> int:
         """Admit one request; returns its fleet-global request id. Raises
         `QueueFullError` when the admission queue is at ``queue_depth``
-        and `RouterDraining` once drain has started. ``timeout`` is the
-        request's deadline in seconds from now."""
+        (unless this request outranks a queued one, which is then shed),
+        `DeadlineInfeasibleError` when ``timeout`` is unmeetable, and
+        `RouterDraining` once drain has started. ``timeout`` is the
+        request's deadline in seconds from now; ``priority`` its class
+        (lower = more important)."""
         return self.submit_request(
             Request(
                 prompt=np.asarray(prompt, np.int32).reshape(-1),
@@ -447,8 +654,14 @@ class Router:
                 stream=stream,
                 stop_sequences=stop_sequences,
                 timeout=timeout,
+                priority=priority,
             )
         )
+
+    def _public_pending(self) -> int:
+        """Queued tickets that count against ``queue_depth`` (internal
+        migration warm-ups don't — they must never cause user rejects)."""
+        return sum(1 for t in self._pending if not t.internal)
 
     def submit_request(self, req: Request) -> int:
         if self._draining:
@@ -457,29 +670,108 @@ class Router:
                 f"router is draining ({self.drain_reason}): "
                 "not admitting new requests"
             )
-        if len(self._pending) >= self.queue_depth:
-            self.stats["rejects"] += 1
-            raise QueueFullError(
-                f"admission queue full ({len(self._pending)}/"
-                f"{self.queue_depth} pending; ATX_SERVE_QUEUE_DEPTH raises "
-                "the bound) — retry with backoff"
-            )
+        if self._public_pending() >= self.queue_depth:
+            # Priority shedding (EDF mode): an arrival that strictly
+            # outranks the least important queued class evicts that
+            # class's newest ticket instead of being rejected.
+            if not (self.scheduling == "edf" and self._shed_for(req)):
+                self.stats["rejects"] += 1
+                raise QueueFullError(
+                    f"admission queue full ({self._public_pending()}/"
+                    f"{self.queue_depth} pending; ATX_SERVE_QUEUE_DEPTH raises "
+                    "the bound) — retry with backoff"
+                )
         # Validate at the front door (engine capacity, bucket-padded plan
         # fit) so a bad request raises HERE, not inside a replica thread.
         self._ref.validate_request(req)
+        if self.scheduling == "edf" and self._deadline_infeasible(req):
+            self._c_infeasible.inc(**self._tel_labels)
+            raise DeadlineInfeasibleError(
+                f"deadline {req.timeout:.3f}s is infeasible given observed "
+                "service time and the queue ahead — rejected at admission"
+            )
         if req.rid < 0:
             req.rid = self._next_rid
         self._next_rid = max(self._next_rid, req.rid) + 1
-        t = _Ticket(req)
+        t = _Ticket(req, seq=self._next_seq)
+        self._next_seq += 1
         self._tickets[req.rid] = t
         self._pending.append(t)
         self._outstanding += 1
+        self._classes_seen.add(int(req.priority))
         self.stats["submitted"] += 1
         self.stats["queue_peak"] = max(
-            self.stats["queue_peak"], len(self._pending)
+            self.stats["queue_peak"], self._public_pending()
         )
-        self._g_queue.set(len(self._pending), **self._tel_labels)
+        self._g_queue.set(self._public_pending(), **self._tel_labels)
         return req.rid
+
+    def _shed_for(self, req: Request) -> bool:
+        """Make room for ``req`` by shedding the newest queued ticket of
+        the least important class, IF ``req`` strictly outranks it.
+        (Internal warm-ups don't count against the bound, so shedding
+        them can't make room — only real tickets are candidates.)"""
+        victims = [t for t in self._pending if not t.done and not t.internal]
+        if not victims:
+            return False
+        worst = max(t.req.priority for t in victims)
+        if int(req.priority) >= worst:
+            return False
+        victim = max(
+            (t for t in victims if t.req.priority == worst),
+            key=lambda t: t.seq,
+        )
+        self._pending.remove(victim)
+        cls = int(victim.req.priority)
+        self._c_shed.inc(**{**self._tel_labels, "class": str(cls)})
+        self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
+        c = self._local_cancel_completion(victim)
+        c.finish_reason = "shed"
+        self._resolve(victim, c)
+        return True
+
+    def _deadline_infeasible(self, req: Request) -> bool:
+        """Admission-time feasibility: estimated finish = now + observed
+        service time x (1 + work ahead / fleet slots). Conservative only
+        once the e2e histogram has >= 5 samples (a cold router admits
+        everything — there is nothing to estimate from)."""
+        if req.timeout is None:
+            return False
+        labels = self._tel_labels
+        if self._h_e2e.count(**labels) < 5:
+            return False
+        e2e = self._h_e2e.mean(**labels)
+        if not e2e:
+            return False
+        queue_wait = self._h_queue_wait.mean(**labels) or 0.0
+        service_ms = e2e - queue_wait
+        if service_ms <= 0.0:
+            service_ms = e2e
+        slots = sum(
+            r.engine.n_slots for r in self.replicas if not r.dead
+        ) or 1
+        key = (int(req.priority), time.perf_counter() + req.timeout, self._next_seq)
+        ahead = sum(
+            1
+            for t in self._pending
+            if not t.done and self._order_key(t) <= key
+        )
+        est_ms = service_ms * (1.0 + ahead / slots)
+        return est_ms > req.timeout * 1000.0
+
+    def _internal_submit(self, req: Request) -> None:
+        """Queue a router-internal warm-up request (prefix migration):
+        bypasses the admission bound and drain, surfaces no completion,
+        but counts against ``_outstanding`` so `join` finishes it."""
+        self._ref.validate_request(req)
+        req.rid = self._next_rid
+        self._next_rid += 1
+        t = _Ticket(req, seq=self._next_seq)
+        self._next_seq += 1
+        t.internal = True
+        self._tickets[req.rid] = t
+        self._pending.append(t)
+        self._outstanding += 1
 
     # ------------------------------------------------------------- cancel
     def cancel(self, rid: int) -> bool:
@@ -536,6 +828,8 @@ class Router:
             self.drain("preemption")
         if self.threads:
             self._check_threads()
+        self._refill_retry_budget()
+        self._maybe_readmit()
         self._check_deadlines()
         self._dispatch()
         if self.threads:
@@ -578,19 +872,42 @@ class Router:
                     t.cancel_sent = True
                     r.send(("cancel", rid))
 
+    def _order_key(self, t: _Ticket) -> tuple:
+        """EDF dispatch order: priority class first (lower = more
+        important), earliest absolute deadline within a class (no deadline
+        sorts last), admission seq as the FIFO tiebreak."""
+        return (
+            int(t.req.priority),
+            t.deadline if t.deadline is not None else float("inf"),
+            t.seq,
+        )
+
     def _dispatch(self) -> None:
-        # Strict FIFO: only the head dispatches (no slot, no overtaking).
+        # EDF: the best-ranked pending ticket dispatches first; FIFO mode
+        # keeps the old strict head-only order. Either way a ticket that
+        # can't place (no replica capacity) stops dispatch — capacity is
+        # request-agnostic, so nothing behind it could place either.
         while self._pending:
-            r = self._pick_replica(self._pending[0].req)
+            if self.scheduling == "edf":
+                t = min(self._pending, key=self._order_key)
+            else:
+                t = self._pending[0]
+            r = self._pick_replica(t.req)
             if r is None:
                 return
-            self._dispatch_to(self._pending.popleft(), r)
+            self._pending.remove(t)
+            self._dispatch_to(t, r)
+
+    def _replica_capacity(self, r: _Replica) -> int:
+        # Probation: a freshly re-admitted replica gets one request at a
+        # time until it proves itself with clean completions.
+        return 1 if r.probation_left > 0 else r.engine.n_slots
 
     def _pick_replica(self, req: Request) -> _Replica | None:
         cands = [
             r
             for r in self.replicas
-            if not r.dead and len(r.inflight) < r.engine.n_slots
+            if not r.dead and len(r.inflight) < self._replica_capacity(r)
         ]
         if not cands:
             return None
@@ -618,11 +935,12 @@ class Router:
         t.req.stream = self._make_stream(t)
         r.inflight.add(t.req.rid)
         r.dispatched += 1
-        self.stats["dispatched"] += 1
-        self._h_queue_wait.observe(
-            (time.perf_counter() - t.submitted_at) * 1e3, **self._tel_labels
-        )
-        self._g_queue.set(len(self._pending), **self._tel_labels)
+        if not t.internal:
+            self.stats["dispatched"] += 1
+            self._h_queue_wait.observe(
+                (time.perf_counter() - t.submitted_at) * 1e3, **self._tel_labels
+            )
+        self._g_queue.set(self._public_pending(), **self._tel_labels)
         if self.affinity == "prefix":
             # Record at dispatch (not completion) so a burst of same-prefix
             # requests steers together from the second one on.
@@ -691,7 +1009,10 @@ class Router:
         t = self._tickets.get(c.rid)
         if t is None or t.done or t.replica != replica_id:
             return  # stale: resolved elsewhere or reassigned after quarantine
-        self.replicas[replica_id].completed += 1
+        r = self.replicas[replica_id]
+        r.completed += 1
+        if r.probation_left > 0 and c.finish_reason not in ("cancelled", "failed"):
+            r.probation_left -= 1  # one clean completion toward full share
         self._resolve(t, c)
 
     def _resolve(self, t: _Ticket, c: Completion) -> None:
@@ -700,19 +1021,43 @@ class Router:
         if t.replica is not None:
             self.replicas[t.replica].inflight.discard(t.req.rid)
             t.replica = None
+        if t.internal:
+            # Migration warm-up: no caller to surface it to. A successful
+            # prefill means the survivor's radix cache now holds the path.
+            if c.finish_reason not in ("cancelled", "failed", "shed"):
+                self._migrated_prefixes += 1
+                self._c_migrated.inc(**self._tel_labels)
+            self._outstanding -= 1
+            return
         # Router admission time, so latency includes queueing delay.
         c.submitted_at = t.submitted_at
         if c.finish_reason == "cancelled":
             self.stats["cancelled"] += 1
-        if c.finish_reason not in ("cancelled", "failed"):
+        if c.finish_reason not in ("cancelled", "failed", "shed"):
+            cls_labels = {
+                **self._tel_labels, "class": str(int(t.req.priority)),
+            }
             if c.first_token_at:
-                self._h_ttft.observe(
-                    (c.first_token_at - t.submitted_at) * 1000.0,
-                    **self._tel_labels,
+                ttft_ms = (c.first_token_at - t.submitted_at) * 1000.0
+                self._h_ttft.observe(ttft_ms, **self._tel_labels)
+                self._h_class_ttft.observe(ttft_ms, **cls_labels)
+            e2e_ms = (c.finished_at - t.submitted_at) * 1000.0
+            self._h_e2e.observe(e2e_ms, **self._tel_labels)
+            self._h_class_e2e.observe(e2e_ms, **cls_labels)
+            if (
+                self._canary is None
+                and c.finish_reason in ("eos", "length")
+                and c.n_new > 0
+                and t.req.stop_sequences is None
+            ):
+                # Record the probe canary from real traffic: replaying
+                # this prompt/seed must reproduce these first k tokens on
+                # ANY healthy replica (greedy determinism).
+                k = min(4, int(c.n_new))
+                self._canary = (
+                    t.req.prompt.copy(), int(t.req.seed),
+                    c.tokens[:k].copy(), k,
                 )
-            self._h_e2e.observe(
-                (c.finished_at - t.submitted_at) * 1000.0, **self._tel_labels
-            )
         self.stats["completed"] += 1
         self._outstanding -= 1
         self._completions.append(c)
@@ -724,7 +1069,20 @@ class Router:
         r.dead = True
         r.error = reason
         self.stats["replicas_lost"] += 1
-        self._affinity.remove_replica(replica_id)
+        # Prefix-cache migration: re-seed the dead replica's hottest
+        # committed radix paths into a survivor (host token ids only — the
+        # warm-up PREFILLS there; KV bytes never cross devices) and
+        # re-point its affinity entries at that survivor so the families
+        # keep steering at warm KV.
+        survivors = [x for x in self.replicas if not x.dead]
+        migrated = 0
+        if survivors and not self._draining:
+            migrated = self._migrate_prefix_cache(r)
+        if survivors and migrated:
+            target = min(survivors, key=lambda x: (len(x.inflight), x.id))
+            self._affinity.retarget(replica_id, target.id)
+        else:
+            self._affinity.remove_replica(replica_id)
         orphans = [
             self._tickets[rid]
             for rid in sorted(r.inflight)
@@ -732,7 +1090,11 @@ class Router:
         ]
         r.inflight.clear()
         # Retries jump the queue (appendleft, original order preserved):
-        # they already waited once, and FIFO age order stays intact.
+        # they already waited once, and FIFO age order stays intact. (In
+        # EDF mode the kept original seq achieves the same thing.) Each
+        # retry costs a token from the fleet-wide budget — a sick fleet
+        # runs out and degrades to visible ``failed`` completions instead
+        # of a retry storm.
         for t in reversed(orphans):
             if t.done:
                 continue
@@ -744,8 +1106,161 @@ class Router:
                 fc.finish_reason = "failed"
                 self._resolve(t, fc)
                 continue
+            if self.retry_budget >= 0:
+                if self._retry_tokens < 1.0:
+                    self._c_retry_exhausted.inc(**self._tel_labels)
+                    self.stats["failed"] += 1
+                    fc = self._local_cancel_completion(t)
+                    fc.finish_reason = "failed"
+                    self._resolve(t, fc)
+                    continue
+                self._retry_tokens -= 1.0
             self.stats["retries"] += 1
             self._pending.appendleft(t)
+        if self.readmit_secs is not None:
+            self._schedule_probe(r)
+
+    def _migrate_prefix_cache(self, r: _Replica) -> int:
+        """Queue internal warm-up prefills of the dead replica's hottest
+        cached prefixes. Best-effort: any failure just skips the entry."""
+        if self.migrate_prefixes <= 0 or r.engine.prefix_cache is None:
+            return 0
+        try:
+            paths = r.engine.prefix_cache.hot_entries(self.migrate_prefixes)
+        except Exception:
+            return 0
+        n = 0
+        for toks in paths:
+            if len(toks) < 1 or len(toks) + 1 > self._ref.max_len:
+                continue
+            try:
+                self._internal_submit(
+                    Request(
+                        prompt=np.asarray(toks, np.int32),
+                        max_new_tokens=1,
+                        seed=0,
+                        priority=_INTERNAL_PRIORITY,
+                    )
+                )
+            except ValueError:
+                continue  # e.g. bucket-padded plan doesn't fit — skip
+            n += 1
+        return n
+
+    # --------------------------------------------------- retry budget
+    def _refill_retry_budget(self) -> None:
+        now = time.perf_counter()
+        if self.retry_budget < 0:
+            self._retry_refill_at = now
+            return
+        dt = now - self._retry_refill_at
+        self._retry_refill_at = now
+        self._retry_tokens = min(
+            float(self.retry_budget),
+            self._retry_tokens + dt * self.retry_refill_per_sec,
+        )
+
+    # ------------------------------------------------- probation & probe
+    def _schedule_probe(self, r: _Replica) -> None:
+        """Capped-exponential + jittered backoff before the next probe."""
+        r.quarantines += 1
+        base = self.readmit_secs * (2.0 ** (r.quarantines - 1))
+        backoff = min(base, max(self.readmit_secs, 60.0))
+        r.probe_at = time.perf_counter() + backoff * (
+            1.0 + 0.1 * self._rng.random()
+        )
+
+    def _maybe_readmit(self) -> None:
+        if self.readmit_secs is None:
+            return
+        now = time.perf_counter()
+        for r in self.replicas:
+            if r.dead and r.probe_at is not None and now >= r.probe_at:
+                self._probe(r)
+
+    def _probe(self, r: _Replica) -> None:
+        """Health-check a quarantined replica from the router thread (the
+        old driver thread is gone — it raised — or permanently parked — it
+        wedged; either way nothing else touches the engine, so a direct
+        canary run preserves single-thread ownership). On success the
+        replica re-enters dispatch under probation; on failure the engine
+        is rebuilt from ``engine_factory`` (when available) and re-probed
+        once, else the backoff doubles."""
+        r.probe_at = None
+        ok = False
+        if r.wedged.is_set():
+            # A wedged engine may have been interrupted mid-step (an
+            # arbitrary stall, not just the pre-step fault hook): its
+            # device state is not trustworthy. Only a rebuild recovers it.
+            if self.engine_factory is None:
+                self._c_probe_fail.inc(**self._tel_labels)
+                return  # permanently quarantined (join() may fail the fleet)
+            self._rebuild(r)
+            ok = self._canary_ok(r.engine)
+            if not ok:
+                self._c_probe_fail.inc(**self._tel_labels)
+        else:
+            ok = self._canary_ok(r.engine)
+            if not ok:
+                self._c_probe_fail.inc(**self._tel_labels)
+                if self.engine_factory is not None:
+                    self._rebuild(r)
+                    ok = self._canary_ok(r.engine)
+                    if not ok:
+                        self._c_probe_fail.inc(**self._tel_labels)
+        if ok:
+            self._readmit(r)
+        else:
+            self._schedule_probe(r)
+
+    def _rebuild(self, r: _Replica) -> None:
+        r.engine = self.engine_factory()
+        r.rebuilds += 1
+        r.wedged = threading.Event()
+
+    def _canary_ok(self, engine: Engine) -> bool:
+        """Replay the recorded canary directly on ``engine``; healthy
+        means bit-identical first-k tokens (or, before any traffic has
+        recorded a canary, simply completing a synthetic request)."""
+        try:
+            engine.abort_inflight()  # whatever the fault left mid-flight
+            if self._canary is not None:
+                prompt, seed, ref, k = self._canary
+                req = Request(
+                    prompt=prompt.copy(), max_new_tokens=k, seed=seed
+                )
+            else:
+                ref, k = None, 0
+                req = Request(
+                    prompt=np.asarray(
+                        [int(self._ref.config.pad_token_id)], np.int32
+                    ),
+                    max_new_tokens=2,
+                    seed=0,
+                )
+            rid = engine.submit_request(req)
+            for _ in range(10_000):
+                for c in engine.step():
+                    if c.rid != rid:
+                        continue  # stale orphan unwound by abort_inflight
+                    if ref is not None:
+                        return bool(np.array_equal(c.tokens[:k], ref))
+                    return c.finish_reason in ("eos", "length", "stop")
+                if not engine.busy:
+                    return False
+            engine.abort_inflight()  # step cap hit: leave the engine idle
+            return False
+        except Exception:
+            try:
+                engine.abort_inflight()
+            except Exception:
+                pass
+            return False
+
+    def _readmit(self, r: _Replica) -> None:
+        r.respawn()
+        r.probation_left = max(0, self.probation_completions)
+        self._c_readmit.inc(**self._tel_labels)
 
     # ---------------------------------------------------------- lifecycle
     def pop_completions(self) -> list[Completion]:
@@ -759,7 +1274,13 @@ class Router:
         work outstanding, `TimeoutError` past ``timeout`` seconds."""
         t0 = time.perf_counter()
         while self._outstanding > 0:
-            if all(r.dead for r in self.replicas):
+            if all(r.dead for r in self.replicas) and not any(
+                # With re-admission enabled a fully-dead fleet can still
+                # recover: keep polling while any probe is scheduled
+                # (``timeout`` still bounds the wait).
+                r.probe_at is not None
+                for r in self.replicas
+            ):
                 errors = "; ".join(
                     f"replica {r.id}: {r.error}" for r in self.replicas
                 )
@@ -796,7 +1317,7 @@ class Router:
             if realtime and (reqs[i].arrival or 0.0) > time.perf_counter() - t0:
                 self.poll(0.002)
                 continue
-            if not realtime and len(self._pending) >= self.queue_depth:
+            if not realtime and self._public_pending() >= self.queue_depth:
                 self.poll(0.002)  # backpressure: wait for queue space
                 continue
             try:
@@ -851,17 +1372,43 @@ class Router:
                     "prefix_hit_rate": pm.get("prefix_hit_rate", 0.0),
                     "quarantined": int(r.dead),
                     "wedged": int(r.wedged.is_set()),
+                    "probation": r.probation_left,
+                    "quarantines": r.quarantines,
+                    "rebuilds": r.rebuilds,
                     "error": r.error,
                 }
             )
+        labels = self._tel_labels
+        per_class = {}
+        for cls in sorted(self._classes_seen):
+            cl = {**labels, "class": str(cls)}
+            per_class[str(cls)] = {
+                "completed": self._h_class_e2e.count(**cl),
+                "ttft_p50_ms": _hq(self._h_class_ttft, 0.50, cl),
+                "e2e_p50_ms": _hq(self._h_class_e2e, 0.50, cl),
+                "e2e_p99_ms": _hq(self._h_class_e2e, 0.99, cl),
+                "shed": self._shed_by_class.get(cls, 0),
+            }
         m: dict = dict(self.stats)
         m.update(
             replicas=len(self.replicas),
             replicas_alive=sum(1 for r in self.replicas if not r.dead),
-            queue_depth=len(self._pending),
+            queue_depth=self._public_pending(),
             queue_capacity=self.queue_depth,
             draining=int(self._draining),
             drain_reason=self.drain_reason,
+            scheduling=self.scheduling,
+            shed=sum(self._shed_by_class.values()),
+            shed_by_class={str(k): v for k, v in sorted(self._shed_by_class.items())},
+            deadline_infeasible=int(self._c_infeasible.value(**labels)),
+            readmissions=int(self._c_readmit.value(**labels)),
+            probe_failures=int(self._c_probe_fail.value(**labels)),
+            retry_budget_exhausted=int(self._c_retry_exhausted.value(**labels)),
+            retry_tokens=(
+                round(self._retry_tokens, 2) if self.retry_budget >= 0 else None
+            ),
+            migrated_prefixes=self._migrated_prefixes,
+            per_class=per_class,
             ttft_p50_ms=_hq(self._h_ttft, 0.50, self._tel_labels),
             ttft_p99_ms=_hq(self._h_ttft, 0.99, self._tel_labels),
             e2e_p50_ms=_hq(self._h_e2e, 0.50, self._tel_labels),

@@ -64,16 +64,28 @@ Instrumented points:
                                 replicate store during an in-memory
                                 reshard, next NOT yet
                                 (`checkpointing.StoreShardSource`)
+``engine.step``                 serving engine scheduler step, BEFORE
+                                admission/prefill/decode dispatch
+                                (`serving/engine.py` — the engine-level
+                                chaos injection point)
 ==============================  =================================================
+
+For randomized campaigns (`atx chaos`), :class:`FaultSchedule` samples a
+seeded fault assignment over `active_points()` — probability-per-point, at
+most one point per fault kind (each ``ATX_FAULT_*_AT`` env var holds one
+spec) — and renders it as the env dict the existing ``<point>@N`` machinery
+consumes, so a campaign episode is replayable from its seed alone
+(``ATX_FAULT_SEED`` names the default seed).
 """
 
 from __future__ import annotations
 
 import os
+import random
 import sys
 import time
 from contextlib import contextmanager
-from typing import Iterator
+from typing import Iterator, Sequence
 
 from ..utils.environment import patch_environment
 
@@ -85,6 +97,50 @@ HANG_AT_ENV = "ATX_FAULT_HANG_AT"
 DELAY_AT_ENV = "ATX_FAULT_DELAY_AT"
 DELAY_SECS_ENV = "ATX_FAULT_DELAY_SECS"
 NAN_AT_ENV = "ATX_FAULT_NAN_AT"
+FAULT_SEED_ENV = "ATX_FAULT_SEED"
+
+# Fault kind -> the env var its spec lives in. Each var holds exactly ONE
+# spec, so a FaultSchedule assigns at most one point per kind.
+FAULT_KIND_ENVS: dict[str, str] = {
+    "raise": RAISE_AT_ENV,
+    "hang": HANG_AT_ENV,
+    "delay": DELAY_AT_ENV,
+    "kill": KILL_AT_ENV,
+}
+
+# The static catalog of instrumented crash points (the docstring table).
+# Parameterized points list their concrete everyday instances.
+_KNOWN_POINTS: tuple[str, ...] = (
+    "save.files_written",
+    "save.manifest_written",
+    "commit.before_rename",
+    "commit.before_marker",
+    "disk.after_sentinel",
+    "router.replica0.step",
+    "router.replica1.step",
+    "engine.step",
+    "replicate.part_uploaded",
+    "replicate.before_marker",
+    "restore.peer_shard_fetched",
+    "shrink.agreement_proposed",
+    "shrink.before_reshard",
+    "shrink.peer_slice_fetched",
+)
+
+# Points seen live by `crash_point` this process (covers dynamically named
+# instances, e.g. router.replica7.step in a wide fleet).
+_SEEN_POINTS: set[str] = set()
+
+
+def active_points(prefix: str | None = None) -> tuple[str, ...]:
+    """Every injectable crash point known to this process: the static
+    catalog plus any dynamically named instance `crash_point` has actually
+    visited. ``prefix`` filters (e.g. ``"router."`` for the campaign driver
+    to scope a schedule to one subsystem)."""
+    points = sorted(set(_KNOWN_POINTS) | _SEEN_POINTS)
+    if prefix is not None:
+        points = [p for p in points if p.startswith(prefix)]
+    return tuple(points)
 
 # Hits seen per counted spec ("point@N"); plain specs never touch this.
 _HIT_COUNTS: dict[str, int] = {}
@@ -119,6 +175,7 @@ def _should_fire(spec: str | None, name: str) -> bool:
 def crash_point(name: str) -> None:
     """The hook body `resilience.commit.fault_point` dispatches to once a
     fault env var is present."""
+    _SEEN_POINTS.add(name)
     if _should_fire(os.environ.get(DELAY_AT_ENV), name):
         try:
             delay = float(os.environ.get(DELAY_SECS_ENV, "") or 1.0)
@@ -195,6 +252,77 @@ def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
     with open(path, "r+b") as f:
         f.truncate(keep)
     return keep
+
+
+class FaultSchedule:
+    """A seeded, replayable fault assignment over the crash-point registry.
+
+    Samples — with a stdlib `random.Random(seed)` so the draw is stable
+    across platforms and numpy versions — an independent
+    probability-``probability`` coin per fault kind; a kind that comes up
+    faulty gets one point from ``points`` and a hit count in
+    ``[1, max_hits]``, rendered as the existing ``<point>@N`` counted spec.
+    At most one point per kind because each ``ATX_FAULT_*_AT`` env var
+    holds a single spec. The same ``(seed, points, kinds, probability,
+    max_hits)`` always reproduces the same assignment — that is the chaos
+    campaign's replay contract.
+
+    ``seed=None`` reads ``ATX_FAULT_SEED`` (default 0).
+    """
+
+    def __init__(
+        self,
+        seed: int | None = None,
+        *,
+        points: Sequence[str] | None = None,
+        kinds: Sequence[str] = ("raise", "delay"),
+        probability: float = 0.5,
+        max_hits: int = 4,
+    ) -> None:
+        if seed is None:
+            try:
+                seed = int(os.environ.get(FAULT_SEED_ENV, "") or 0)
+            except ValueError:
+                seed = 0
+        unknown = [k for k in kinds if k not in FAULT_KIND_ENVS]
+        if unknown:
+            raise ValueError(
+                f"unknown fault kinds {unknown}; choose from "
+                f"{sorted(FAULT_KIND_ENVS)}"
+            )
+        self.seed = seed
+        self.points = tuple(points if points is not None else active_points())
+        self.kinds = tuple(kinds)
+        self.probability = probability
+        self.max_hits = max(1, int(max_hits))
+        self.assignments: dict[str, str] = {}
+        rng = random.Random(seed)
+        for kind in self.kinds:
+            # Draw the coin AND the would-be assignment every iteration so
+            # one kind's outcome never shifts another kind's stream.
+            coin = rng.random()
+            point = rng.choice(self.points) if self.points else None
+            hits = rng.randint(1, self.max_hits)
+            if point is not None and coin < probability:
+                self.assignments[kind] = f"{point}@{hits}"
+
+    def env(self) -> dict[str, str]:
+        """The env-var dict (`ATX_FAULT_<KIND>_AT` -> ``point@N``) the
+        existing `crash_point` machinery consumes — hand it to
+        `utils.environment.patch_environment` or a subprocess env."""
+        return {FAULT_KIND_ENVS[k]: spec for k, spec in self.assignments.items()}
+
+    def describe(self) -> dict:
+        """Stable JSON-serializable description for the episode report."""
+        return {
+            "seed": self.seed,
+            "kinds": list(self.kinds),
+            "probability": self.probability,
+            "assignments": dict(sorted(self.assignments.items())),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"FaultSchedule(seed={self.seed}, assignments={self.assignments})"
 
 
 def flip_bit(path: str, byte_offset: int | None = None, bit: int = 0) -> int:

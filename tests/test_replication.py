@@ -121,6 +121,14 @@ class TestLocalObjectStore:
         with pytest.raises(replicate.ObjectStoreError, match="escapes"):
             s.put_bytes(b"x", "../../etc/passwd")
 
+    def test_copy_is_server_side(self, tmp_path):
+        s = replicate.LocalObjectStore(str(tmp_path / "store"))
+        s.put_bytes(b"shard-bytes", "a/src.bin")
+        s.copy("a/src.bin", "b/dst.bin")
+        assert s.get_bytes("b/dst.bin") == b"shard-bytes"
+        with pytest.raises(replicate.ObjectStoreError):
+            s.copy("missing", "x.bin")
+
     def test_missing_object_raises(self, tmp_path):
         s = replicate.LocalObjectStore(str(tmp_path / "store"))
         with pytest.raises(replicate.ObjectStoreError):
@@ -477,6 +485,102 @@ class TestUploadFaults:
             commit_mod.fault_point("replication.test.nth")  # hit 2: delayed
             second = time.monotonic() - t1
         assert first < 0.2 and second >= 0.25
+
+
+def _committed_dir_named(tmp_path, name, step, files):
+    """A committed checkpoint directory with explicit file contents."""
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    for rel, data in files.items():
+        with open(os.path.join(d, rel), "wb") as f:
+            f.write(data)
+    commit_mod.write_manifest(d, 0, sorted(files), step=step)
+    commit_mod.write_aggregate_manifest(d)
+    import json
+
+    with open(os.path.join(d, commit_mod.COMMIT_MARKER), "w") as f:
+        json.dump({"version": 1, "step": step, "num_processes": 1}, f)
+    assert commit_mod.verify_checkpoint(d) == []
+    return d
+
+
+# ======================================================= differential upload
+class TestDifferentialReplication:
+    def test_unchanged_shards_server_side_copied(self, tmp_path):
+        files0 = {f"part_{i}.bin": bytes([i]) * 200 for i in range(4)}
+        d0 = _committed_dir_named(tmp_path, "checkpoint_0", 1, files0)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0, timeout_secs=60)
+        rep.enqueue(d0)
+        assert rep.drain(60)
+        assert rep.parts_unchanged == 0  # nothing to diff against yet
+        files1 = dict(files0, **{"part_0.bin": b"\xff" * 128})
+        d1 = _committed_dir_named(tmp_path, "checkpoint_1", 2, files1)
+        copies = []
+        orig_copy = store.copy
+
+        def spying_copy(src, dst):
+            copies.append((src, dst))
+            orig_copy(src, dst)
+
+        store.copy = spying_copy
+        rep.enqueue(d1)
+        assert rep.drain(60)
+        assert rep.failures == 0
+        # The 3 unchanged data shards were server-side copied from the
+        # previous remote checkpoint, not re-sent over the wire.
+        assert rep.parts_unchanged == 3
+        assert sorted(dst for _, dst in copies) == [
+            f"checkpoint_1/part_{i}.bin" for i in (1, 2, 3)
+        ]
+        assert all(src.startswith("checkpoint_0/") for src, _ in copies)
+        for rel, data in files1.items():
+            assert store.get_bytes(f"checkpoint_1/{rel}") == data
+        assert replicate.remote_committed_checkpoints(store) == [
+            (0, "checkpoint_0"), (1, "checkpoint_1"),
+        ]
+        restored = replicate.restore_latest(store, str(tmp_path / "restored"))
+        assert restored and commit_mod.verify_checkpoint(restored) == []
+
+    def test_copy_failure_falls_back_to_upload(self, tmp_path):
+        class NoCopyStore(replicate.LocalObjectStore):
+            def copy(self, src_key, dst_key):
+                raise OSError("server-side copy unsupported")
+
+        files0 = {f"part_{i}.bin": bytes([i]) * 150 for i in range(3)}
+        d0 = _committed_dir_named(tmp_path, "checkpoint_0", 1, files0)
+        store = NoCopyStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0, timeout_secs=60)
+        rep.enqueue(d0)
+        assert rep.drain(60)
+        d1 = _committed_dir_named(tmp_path, "checkpoint_1", 2, dict(files0))
+        rep.enqueue(d1)
+        assert rep.drain(60)
+        # The optimization failing must never fail the checkpoint: every
+        # shard falls back to a plain upload and the commit still lands.
+        assert rep.failures == 0
+        assert rep.parts_unchanged == 0
+        assert replicate.remote_committed_checkpoints(store)[-1] == (
+            1, "checkpoint_1",
+        )
+        restored = replicate.restore_latest(store, str(tmp_path / "restored"))
+        assert restored and commit_mod.verify_checkpoint(restored) == []
+
+    def test_unreadable_previous_manifest_degrades_to_upload(self, tmp_path):
+        files0 = {f"part_{i}.bin": bytes([i]) * 100 for i in range(3)}
+        d0 = _committed_dir_named(tmp_path, "checkpoint_0", 1, files0)
+        store = replicate.LocalObjectStore(str(tmp_path / "remote"))
+        rep = replicate.Replicator(store, retries=0, timeout_secs=60)
+        rep.enqueue(d0)
+        assert rep.drain(60)
+        store.put_bytes(b"not json", f"checkpoint_0/{commit_mod.AGG_MANIFEST}")
+        d1 = _committed_dir_named(tmp_path, "checkpoint_1", 2, dict(files0))
+        rep.enqueue(d1)
+        assert rep.drain(60)
+        assert rep.failures == 0 and rep.parts_unchanged == 0
+        assert replicate.remote_committed_checkpoints(store)[-1] == (
+            1, "checkpoint_1",
+        )
 
 
 # ==================================================== aggregate manifest / agg

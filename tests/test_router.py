@@ -37,6 +37,7 @@ from accelerate_tpu.generation import GenerationConfig
 from accelerate_tpu.models import llama
 from accelerate_tpu.serving import (
     AffinityIndex,
+    DeadlineInfeasibleError,
     NoHealthyReplicaError,
     QueueFullError,
     Router,
@@ -494,6 +495,253 @@ class TestAcceptanceMatrix:
         assert router.draining and router.drain_reason == "preemption"
         assert len(accepted) == 8  # the two post-drain submissions refused
         _assert_matches_solo(solo, accepted, completions)
+
+
+class TestEDFScheduling:
+    def test_edf_orders_by_deadline_within_class(self, params):
+        """Same class, reverse-deadline submission order: dispatch (and so
+        completion, on one slot) runs tightest-deadline-first."""
+        with Router([_engine(params, slots=1)], threads=False) as router:
+            router.submit(np.arange(6, dtype=np.int32), 6, seed=0)  # blocker
+            router.poll()  # blocker owns the only slot
+            r_loose = router.submit(np.arange(5, dtype=np.int32), 2, seed=1, timeout=30.0)
+            r_mid = router.submit(np.arange(5, dtype=np.int32), 2, seed=2, timeout=20.0)
+            r_tight = router.submit(np.arange(5, dtype=np.int32), 2, seed=3, timeout=10.0)
+            out = {c.rid: c for c in router.join()}
+        assert (
+            out[r_tight].finished_at
+            < out[r_mid].finished_at
+            < out[r_loose].finished_at
+        ), {r: out[r].finished_at for r in (r_tight, r_mid, r_loose)}
+        assert all(c.finish_reason in ("eos", "length") for c in out.values())
+
+    def test_edf_priority_class_overtakes_fifo_does_not(self, params):
+        """The EDF-vs-FIFO acceptance proxy: a priority-0 arrival behind
+        two queued priority-2 requests is served FIRST under EDF (its
+        deadline odds improve at the background class's expense) and LAST
+        under fifo (arrival order, the pre-PR-14 behaviour)."""
+        order = {}
+        for scheduling in ("edf", "fifo"):
+            with Router(
+                [_engine(params, slots=1)], threads=False, scheduling=scheduling
+            ) as router:
+                router.submit(np.arange(6, dtype=np.int32), 6, seed=0)
+                router.poll()
+                lo = [
+                    router.submit(
+                        np.arange(5, dtype=np.int32), 2, seed=s, priority=2
+                    )
+                    for s in (1, 2)
+                ]
+                hi = router.submit(
+                    np.arange(5, dtype=np.int32), 2, seed=3, priority=0
+                )
+                out = {c.rid: c for c in router.join()}
+            order[scheduling] = out[hi].finished_at < min(
+                out[r].finished_at for r in lo
+            )
+        assert order == {"edf": True, "fifo": False}
+
+    def test_priority_shed_on_full_queue(self, params):
+        """A full queue rejects same-or-lower classes but SHEDS the newest
+        ticket of the least important class for a strictly higher one; the
+        victim resolves visibly with ``finish_reason="shed"``."""
+        with Router(
+            [_engine(params, slots=1)], queue_depth=2, threads=False
+        ) as router:
+            router.submit(np.arange(6, dtype=np.int32), 6, seed=0)
+            router.poll()  # blocker out of the queue, into the slot
+            lo1 = router.submit(np.arange(5, dtype=np.int32), 2, seed=1, priority=2)
+            lo2 = router.submit(np.arange(5, dtype=np.int32), 2, seed=2, priority=2)
+            with pytest.raises(QueueFullError):  # equal class: no shed
+                router.submit(np.arange(5, dtype=np.int32), 2, seed=3, priority=2)
+            hi = router.submit(np.arange(5, dtype=np.int32), 2, seed=4, priority=0)
+            out = {c.rid: c for c in router.join()}
+        assert out[lo2].finish_reason == "shed" and out[lo2].n_new == 0
+        assert out[lo1].finish_reason in ("eos", "length")
+        assert out[hi].finish_reason in ("eos", "length")
+        m = router.metrics()
+        assert m["shed"] == 1 and m["shed_by_class"] == {"2": 1}
+        assert m["rejects"] == 1
+        assert m["per_class"]["2"]["shed"] == 1
+
+    def test_fifo_never_sheds(self, params):
+        with Router(
+            [_engine(params, slots=1)], queue_depth=2, threads=False,
+            scheduling="fifo",
+        ) as router:
+            router.submit(np.arange(6, dtype=np.int32), 6, seed=0)
+            router.poll()
+            router.submit(np.arange(5, dtype=np.int32), 2, seed=1, priority=2)
+            router.submit(np.arange(5, dtype=np.int32), 2, seed=2, priority=2)
+            with pytest.raises(QueueFullError):
+                router.submit(np.arange(5, dtype=np.int32), 2, seed=3, priority=0)
+            assert len(router.join()) == 3
+        assert router.metrics()["shed"] == 0
+
+    def test_deadline_infeasible_rejected_at_admission(self, params):
+        """Once the e2e histogram is warm (>= 5 samples), a deadline the
+        observed service time cannot meet raises at submit instead of
+        burning a slot on work that will be cancelled anyway."""
+        with Router([_engine(params, slots=1)], threads=False) as router:
+            for s in range(5):  # warm the service-time estimate
+                router.submit(np.arange(6, dtype=np.int32), 2, seed=s)
+                router.join()
+            router.submit(np.arange(6, dtype=np.int32), 30, seed=9)
+            router.poll()
+            with pytest.raises(DeadlineInfeasibleError):
+                router.submit(
+                    np.arange(5, dtype=np.int32), 4, seed=10, timeout=0.0005
+                )
+            assert router.metrics()["deadline_infeasible"] == 1
+            rid = router.submit(  # a generous deadline is still admitted
+                np.arange(5, dtype=np.int32), 2, seed=11, timeout=60.0
+            )
+            out = {c.rid: c for c in router.join()}
+        assert out[rid].finish_reason in ("eos", "length")
+        assert isinstance(
+            DeadlineInfeasibleError("x"), QueueFullError
+        )  # callers catching QueueFullError keep working
+
+
+class TestSelfHealing:
+    def test_quarantine_probe_readmit_bit_identical(self, params, solo):
+        """The tentpole cycle: replica 0 dies mid-trace, failover finishes
+        the batch bit-identically, the probe replays the canary after
+        ``readmit_secs`` and re-admits the replica under probation — and
+        the readmitted replica serves NEW traffic bit-identically too."""
+        reqs = _mixed_requests(8, seed=21)
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@3"):
+            with Router(
+                [_engine(params), _engine(params)],
+                threads=False,
+                readmit_secs=0.01,
+                probation_completions=2,
+                engine_factory=lambda: _engine(params),
+            ) as router:
+                completions = router.serve(reqs)
+                deadline = time.time() + 30.0
+                while router.metrics()["readmissions"] < 1:
+                    assert time.time() < deadline, "no re-admission within 30s"
+                    router.poll(0.002)
+                m = router.metrics()
+                assert m["replicas_alive"] == 2
+                assert m["per_replica"][0]["quarantines"] == 1
+                d0 = m["per_replica"][0]["dispatched"]
+                reqs2 = _mixed_requests(6, seed=22)
+                for r in reqs2:
+                    r.rid += 100
+                completions2 = router.serve(reqs2)
+        _assert_matches_solo(solo, reqs, completions)
+        _assert_matches_solo(solo, reqs2, completions2)
+        m = router.metrics()
+        assert m["replicas_lost"] == 1 and m["readmissions"] == 1
+        assert m["per_replica"][0]["dispatched"] > d0  # probation lifted
+        assert m["per_replica"][0]["probation"] == 0
+
+    def test_probation_caps_inflight_to_one(self, params):
+        """A just-readmitted replica takes at most ONE in-flight request
+        until it clears probation; the healthy replica absorbs the rest."""
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@1"):
+            with Router(
+                [_engine(params), _engine(params)],
+                threads=False,
+                readmit_secs=0.005,
+                probation_completions=8,
+                engine_factory=lambda: _engine(params),
+            ) as router:
+                router.submit(np.arange(6, dtype=np.int32), 3, seed=0)
+                router.join()
+                deadline = time.time() + 30.0
+                while router.metrics()["readmissions"] < 1:
+                    assert time.time() < deadline, "no re-admission within 30s"
+                    router.poll(0.002)
+                for s in range(4):
+                    router.submit(np.arange(8, dtype=np.int32), 3, seed=s)
+                router.poll()  # one dispatch pass while all four are queued
+                placed = [len(rep.inflight) for rep in router.replicas]
+                assert placed[0] <= 1, placed  # probation cap
+                router.join()
+
+    def test_readmit_disabled_by_default_stays_fail_stop(self, params):
+        """Without ``readmit_secs`` a quarantined replica never comes back
+        — the pre-PR-14 fail-stop contract the failover tests pin."""
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@1"):
+            with Router(
+                [_engine(params), _engine(params)], threads=False
+            ) as router:
+                router.submit(np.arange(6, dtype=np.int32), 3, seed=0)
+                router.join()
+                for _ in range(50):
+                    router.poll(0.001)
+                m = router.metrics()
+        assert m["replicas_alive"] == 1 and m["readmissions"] == 0
+
+    def test_retry_budget_exhaustion_fails_fast(self, params):
+        """With a zero fleet retry budget the orphaned request fails
+        instead of replaying — the retry-storm brake — and the exhaustion
+        is visible in telemetry."""
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@1"):
+            with Router(
+                [_engine(params), _engine(params)],
+                threads=False,
+                retry_budget=0,
+                retry_refill_per_sec=0.0,
+            ) as router:
+                router.submit(np.arange(6, dtype=np.int32), 4, seed=0)
+                (c,) = router.join()
+        assert c.finish_reason == "failed"
+        m = router.metrics()
+        assert m["retry_budget_exhausted"] == 1 and m["retry_tokens"] == 0
+        assert m["replicas_alive"] == 1
+
+    def test_retry_budget_token_absorbs_one_failover(self, params, solo):
+        prompt = np.arange(6, dtype=np.int32)
+        with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@1"):
+            with Router(
+                [_engine(params), _engine(params)],
+                threads=False,
+                retry_budget=1,
+                retry_refill_per_sec=0.0,
+            ) as router:
+                router.submit(prompt, 4, seed=0)
+                (c,) = router.join()
+        assert c.finish_reason in ("eos", "length")
+        np.testing.assert_array_equal(c.tokens, solo(prompt, 4, seed=0))
+        m = router.metrics()
+        assert m["retries"] == 1 and m["retry_budget_exhausted"] == 0
+        assert m["retry_tokens"] == 0
+
+    def test_prefix_migration_reseeds_survivor(self, params):
+        """Quarantining the replica that owns a hot prefix re-prefills that
+        prefix into the survivor (host token ids only — KV never crosses
+        devices) and retargets affinity, so follow-up family traffic hits
+        the survivor's cache immediately."""
+        rng = np.random.RandomState(13)
+        prefix = rng.randint(0, 61, (16,)).astype(np.int32)
+
+        def fam():
+            return np.concatenate(
+                [prefix, rng.randint(0, 61, (4,)).astype(np.int32)]
+            )
+
+        engines = [
+            _engine(params, prefix_cache=True),
+            _engine(params, prefix_cache=True),
+        ]
+        with Router(engines, threads=False) as router:
+            router.submit(fam(), 3, seed=0)  # warms family A onto replica 0
+            router.join()
+            with patch_environment(ATX_FAULT_RAISE_AT="router.replica0.step@1"):
+                router.submit(fam(), 3, seed=1)
+                router.join()
+            m = router.metrics()
+            assert m["replicas_lost"] == 1
+            assert m["migrated_prefixes"] >= 1, m
+            hits0 = engines[1].stats["prefix_hits"]
+            router.submit(fam(), 3, seed=2)
+            router.join()
+            assert engines[1].stats["prefix_hits"] > hits0
 
 
 class TestServeCLIFlags:
