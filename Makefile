@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace lint-graph lint-multihost
 
 test:
 	python -m pytest tests/ -q
@@ -150,8 +150,23 @@ smoke-chaos:
 		python -m accelerate_tpu.commands.cli lint router_recovery --multihost 2 \
 		--severity error
 
+# CPU tracing lane (docs/observability.md, "Request tracing & the flight
+# recorder"): flight-recorder ring / postmortem-bundle / bench --compare
+# unit tests incl. the exactly-once-through-failover and SystemExit-flush
+# subprocess gates, a 16-request Poisson trace served twice proving
+# ATX_TRACE_REQUESTS=1 is bit-identical to =0 with `atx trace --check
+# 0.05` passing on both the bundle and the live JSONL dir (phase spans
+# must sum to each request's e2e within 5%), and the tracing host-loop
+# replay under 2 simulated processes proving span recording + the bundle
+# dump add NO collectives (error findings fail).
+smoke-trace:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python tests/scripts/trace_smoke.py
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint tracing --multihost 2 \
+		--severity error
+
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos
+test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace
 	python -m pytest tests/ -q --heavy

@@ -1199,6 +1199,16 @@ class Accelerator:
                 _guard["skipped_total"] += skipped
                 _guard["streak"] = _guard["streak"] + 1 if skipped else 0
                 if _guard["streak"] >= nan_guard_budget:
+                    from .telemetry import flight as _flight
+
+                    _flight.dump_postmortem(
+                        "nan_guard",
+                        extra={
+                            "streak": _guard["streak"],
+                            "skipped_total": _guard["skipped_total"],
+                            "budget": nan_guard_budget,
+                        },
+                    )
                     raise NonFiniteGuardError(
                         f"ATX_NAN_GUARD: {_guard['streak']} consecutive "
                         "training steps produced a non-finite loss or "
@@ -1606,6 +1616,9 @@ class Accelerator:
         if not self.project_config.automatic_checkpoint_naming:
             return
         if self._preemption_exit_started:  # re-entry (e.g. user caught it)
+            from .telemetry import flight as _flight
+
+            _flight.dump_postmortem("preemption_exit_75_reentry")
             raise SystemExit(resilience.PREEMPTION_EXIT_CODE)
         self._preemption_exit_started = True
         # The emergency save may legitimately exceed the per-step deadline;
@@ -1651,6 +1664,14 @@ class Accelerator:
             "launchers resume without consuming a restart attempt)\n"
         )
         _sys.stderr.flush()
+        # Black-box bundle (no-op unless ATX_POSTMORTEM_DIR): what the
+        # process was doing when the preemption notice landed. After the
+        # checkpoint commit, so a slow collector can't eat grace time.
+        from .telemetry import flight as _flight
+
+        _flight.dump_postmortem(
+            "preemption_exit_75", extra={"checkpoint": str(path)}
+        )
         raise SystemExit(resilience.PREEMPTION_EXIT_CODE)
 
     def _ship_collective_log(self) -> None:

@@ -23,7 +23,7 @@ def main(argv: list[str] | None = None) -> int:
 
     for name in (
         "env", "config", "launch", "estimate", "lint", "serve", "test",
-        "merge", "tpu", "chaos",
+        "merge", "tpu", "chaos", "trace",
     ):
         try:
             module = importlib.import_module(f".{name}", package=__package__)

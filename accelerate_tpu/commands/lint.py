@@ -59,7 +59,8 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="also verify multi-host SPMD consistency (ATX5xx) by replaying "
         "each scenario under N simulated processes; adds the host-loop "
         "scenarios (save_path, preemption_exit, router_drain, "
-        "replicated_save, elastic_restore, telemetry) to the default set",
+        "replicated_save, elastic_restore, telemetry, tracing) to the "
+        "default set",
     )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
@@ -935,6 +936,103 @@ def _mh_scenario_router_recovery(processes: int = 2):
     )
 
 
+def _mh_scenario_tracing(processes: int = 2):
+    """Request-scoped tracing (telemetry/flight.py): a full 2-replica serve
+    pass with ATX_TRACE_REQUESTS=1 — admission/dispatch spans, prefix
+    match, prefill chunks, decode residency, stream + completion, and a
+    postmortem bundle dump — must add ZERO collectives to the schedule
+    (spans are host dicts in a preallocated ring; a collective here would
+    couple request latency to peer health), and greedy outputs must be
+    bit-identical to the same trace served with tracing off."""
+    from .. import analysis
+
+    def tracing_loop():
+        import tempfile
+
+        import jax
+        import numpy as np
+
+        from ..analysis import host_trace
+        from ..generation import GenerationConfig
+        from ..models import llama
+        from ..serving import Engine, Request, Router
+        from ..telemetry import flight
+        from ..utils.environment import patch_environment
+
+        config = llama.LlamaConfig.tiny(vocab_size=64, max_seq_len=64)
+        params = llama.init(jax.random.PRNGKey(0), config)
+
+        def mk_engine() -> Engine:
+            return Engine(
+                lambda p, t, c: llama.forward_with_cache(p, t, c, config),
+                lambda b, m: llama.init_cache(config, b, m),
+                params,
+                GenerationConfig(
+                    max_new_tokens=4, eos_token_id=None, pad_token_id=0
+                ),
+                slots=2,
+                buckets=(8,),
+                max_len=32,
+                prefix_cache=True,
+            )
+
+        def trace_reqs() -> list[Request]:
+            rng = np.random.RandomState(1)
+            return [
+                Request(prompt=rng.randint(1, 64, (6,)).astype(np.int32), rid=i)
+                for i in range(4)
+            ]
+
+        def serve_once() -> dict[int, np.ndarray]:
+            router = Router([mk_engine(), mk_engine()], threads=False)
+            for r in trace_reqs():
+                router.submit_request(r)
+            out = {c.rid: c.tokens.copy() for c in router.join()}
+            router.close()
+            return out
+
+        base = serve_once()  # tracing off: the bit-identity reference
+        rec = host_trace._ACTIVE_RECORDER
+
+        def n_collectives() -> int:
+            if rec is None:
+                return 0
+            return sum(1 for e in rec.collective_events if e.kind != "dispatch")
+
+        before = n_collectives()
+        with patch_environment(ATX_TRACE_REQUESTS="1"):
+            flight.reset_recorder()
+            traced = serve_once()
+            pm_dir = tempfile.mkdtemp(prefix="atx_lint_pm_")
+            path = flight.dump_postmortem("lint_tracing", pm_dir)
+            assert path is not None, "postmortem dump returned no path"
+            bundle = flight.read_bundle(path)
+            assert bundle["spans"], "flight recorder captured no spans"
+        after = n_collectives()
+        names = {e["name"] for e in flight.recorder().last()}
+        for want in (
+            "admission", "dispatch", "prefix_match", "prefill_chunk",
+            "phase_decode", "stream", "complete",
+        ):
+            assert want in names, f"missing span {want!r}: {sorted(names)}"
+        for rid, toks in base.items():
+            assert np.array_equal(toks, traced[rid]), (
+                f"rid {rid} diverged with ATX_TRACE_REQUESTS=1"
+            )
+        assert after == before, (
+            f"request tracing added {after - before} collective(s)"
+        )
+
+    report = analysis.lint_host_loop(
+        tracing_loop, processes=processes, target="tracing"
+    )
+    return (
+        f"2-replica traced serve vs untraced bit-identity + postmortem "
+        f"bundle, {processes} processes",
+        report,
+    )
+
+
 MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "save_path": _mh_scenario_save_path,
     "preemption_exit": _mh_scenario_preemption_exit,
@@ -944,6 +1042,7 @@ MULTIHOST_SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "elastic_restore": _mh_scenario_elastic_restore,
     "shrink": _mh_scenario_shrink,
     "telemetry": _mh_scenario_telemetry,
+    "tracing": _mh_scenario_tracing,
 }
 
 

@@ -397,6 +397,12 @@ def run(args: argparse.Namespace) -> int:
             if router.draining and router.drain_reason == "preemption":
                 # The launcher resume contract (docs/fault_tolerance.md):
                 # in-flight work finished above; 75 = resume me, free of charge.
+                from ..telemetry import flight as _flight
+
+                _flight.dump_postmortem(
+                    "preemption_drain_75",
+                    extra={"drain_reason": router.drain_reason},
+                )
                 return resilience.PREEMPTION_EXIT_CODE
             return 0
         print(json.dumps(result))

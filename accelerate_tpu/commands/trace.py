@@ -1,0 +1,306 @@
+"""`accelerate-tpu trace` / `atx trace` — render request-scoped traces.
+
+Reads either surface the flight recorder writes (docs/observability.md):
+
+- a **postmortem bundle** (``postmortem_*.json`` from
+  `telemetry.flight.dump_postmortem`) — span records with monotonic
+  ``t0``/``t1`` plus the recorder's perf/wall anchors;
+- a **live trace dir** (``ATX_TRACE_DIR`` holding ``spans_*.jsonl``
+  Chrome-trace lines) — complete events with wall-clock ``ts``/``dur``.
+
+Both normalize to the same record shape, and two views render:
+
+- per-request **waterfalls**: each request's spans as time-offset bars,
+  so "where did THIS request spend its time" is one glance;
+- a tail-latency **attribution table**: per-phase (queue / prefill /
+  decode / emit) p50 and p99 durations plus each phase's share of e2e —
+  the "you cannot optimize a tail you cannot attribute" view.
+
+``--check TOL`` turns the renderer into a gate (the `make smoke-trace`
+lane): for every completed request the four contiguous phase spans must
+sum to its e2e latency within TOL (fraction, e.g. 0.05), else exit 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any
+
+PHASES = ("phase_queue", "phase_prefill", "phase_decode", "phase_emit")
+_BAR_WIDTH = 48
+
+
+def register(subparsers: argparse._SubParsersAction) -> None:
+    p = subparsers.add_parser(
+        "trace",
+        help="Render a postmortem bundle or live trace dir as per-request "
+        "waterfalls + a tail-latency attribution table",
+    )
+    p.add_argument(
+        "source",
+        help="a postmortem bundle (.json) or a trace directory of "
+        "spans_*.jsonl files (ATX_TRACE_DIR / ATX_POSTMORTEM_DIR)",
+    )
+    p.add_argument(
+        "--rid", type=int, default=None,
+        help="render only this request id's waterfall",
+    )
+    p.add_argument(
+        "--limit", type=int, default=8,
+        help="max waterfalls to render (default 8; the attribution table "
+        "always covers every request)",
+    )
+    p.add_argument(
+        "--check", type=float, default=None, metavar="TOL",
+        help="gate mode: exit 1 unless every completed request's phase "
+        "spans sum to its e2e within TOL (fraction, e.g. 0.05)",
+    )
+    p.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="emit the normalized per-request summary as one JSON object "
+        "instead of the rendered views",
+    )
+    p.set_defaults(func=run)
+
+
+# ------------------------------------------------------------ normalization
+
+
+def _from_bundle(path: str) -> list[dict[str, Any]]:
+    from ..telemetry import flight
+
+    bundle = flight.read_bundle(path)
+    out = []
+    for rec in bundle.get("spans") or []:
+        if not isinstance(rec, dict) or "name" not in rec:
+            continue
+        out.append(
+            {
+                "name": rec["name"],
+                "rid": int(rec.get("rid", -1)),
+                "t0": float(rec.get("t0", 0.0)),
+                "t1": float(rec.get("t1", rec.get("t0", 0.0))),
+                "attrs": dict(rec.get("attrs") or {}),
+            }
+        )
+    return out
+
+
+def _from_trace_dir(path: str) -> list[dict[str, Any]]:
+    out = []
+    for jsonl in sorted(glob.glob(os.path.join(path, "*.jsonl"))):
+        with open(jsonl) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # a truncated tail line from a killed process
+                if ev.get("ph") != "X":
+                    continue
+                args = dict(ev.get("args") or {})
+                rid = args.pop("rid", -1)
+                t0 = float(ev.get("ts", 0.0)) / 1e6
+                out.append(
+                    {
+                        "name": ev.get("name", "?"),
+                        "rid": int(rid) if isinstance(rid, (int, float)) else -1,
+                        "t0": t0,
+                        "t1": t0 + float(ev.get("dur", 0.0)) / 1e6,
+                        "attrs": args,
+                    }
+                )
+    return out
+
+
+def load_records(source: str) -> list[dict[str, Any]]:
+    """Normalize a bundle file or a trace dir into span records sorted by
+    start time: ``{"name", "rid", "t0", "t1", "attrs"}`` (seconds; the
+    time base is only meaningful relative to itself)."""
+    if os.path.isdir(source):
+        records = _from_trace_dir(source)
+    else:
+        records = _from_bundle(source)
+    records.sort(key=lambda r: (r["t0"], r["t1"]))
+    return records
+
+
+# ---------------------------------------------------------------- analysis
+
+
+def summarize(records: list[dict[str, Any]]) -> dict[int, dict[str, Any]]:
+    """Per-request view: phase durations (ms), e2e from the ``complete``
+    span (falling back to the phase envelope), and the raw span list."""
+    by_rid: dict[int, dict[str, Any]] = {}
+    for rec in records:
+        rid = rec["rid"]
+        if rid < 0:
+            continue
+        entry = by_rid.setdefault(
+            rid, {"spans": [], "phases": {}, "e2e_ms": None, "attempts": None}
+        )
+        entry["spans"].append(rec)
+        dur_ms = max(0.0, rec["t1"] - rec["t0"]) * 1e3
+        if rec["name"] in PHASES:
+            entry["phases"][rec["name"]] = dur_ms
+        elif rec["name"] == "complete":
+            entry["e2e_ms"] = dur_ms
+            entry["attempts"] = rec["attrs"].get("attempts")
+            entry["finish_reason"] = rec["attrs"].get("finish_reason")
+    for entry in by_rid.values():
+        if entry["e2e_ms"] is None and entry["phases"]:
+            entry["e2e_ms"] = sum(entry["phases"].values())
+    return by_rid
+
+
+def _pctl(xs: list[float], q: float) -> float:
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def attribution(by_rid: dict[int, dict[str, Any]]) -> list[dict[str, Any]]:
+    """Per-phase p50/p99 and share-of-total-e2e rows over every request
+    that recorded all four phases."""
+    complete = [
+        e for e in by_rid.values()
+        if e["e2e_ms"] and all(p in e["phases"] for p in PHASES)
+    ]
+    total_e2e = sum(e["e2e_ms"] for e in complete)
+    rows = []
+    for phase in PHASES:
+        xs = [e["phases"][phase] for e in complete]
+        if not xs:
+            continue
+        rows.append(
+            {
+                "phase": phase.removeprefix("phase_"),
+                "n": len(xs),
+                "p50_ms": round(_pctl(xs, 0.50), 3),
+                "p99_ms": round(_pctl(xs, 0.99), 3),
+                "share": round(sum(xs) / total_e2e, 4) if total_e2e else 0.0,
+            }
+        )
+    return rows
+
+
+def check_sums(
+    by_rid: dict[int, dict[str, Any]], tol: float
+) -> list[str]:
+    """The acceptance gate: for every request carrying all four phase
+    spans, |sum(phases) - e2e| must be within ``tol`` x e2e."""
+    problems = []
+    checked = 0
+    for rid, e in sorted(by_rid.items()):
+        if e["e2e_ms"] is None or not all(p in e["phases"] for p in PHASES):
+            continue
+        checked += 1
+        total = sum(e["phases"][p] for p in PHASES)
+        if abs(total - e["e2e_ms"]) > tol * max(e["e2e_ms"], 1e-9):
+            problems.append(
+                f"rid {rid}: phases sum to {total:.3f}ms but e2e is "
+                f"{e['e2e_ms']:.3f}ms (tolerance {tol:.0%})"
+            )
+    if checked == 0:
+        problems.append(
+            "no request carried all four phase spans — nothing to check "
+            "(was ATX_TRACE_REQUESTS=1 set for the traced run?)"
+        )
+    return problems
+
+
+# --------------------------------------------------------------- rendering
+
+
+def _render_waterfall(rid: int, entry: dict[str, Any], out: Any) -> None:
+    spans = entry["spans"]
+    t_lo = min(s["t0"] for s in spans)
+    t_hi = max(s["t1"] for s in spans)
+    window = max(t_hi - t_lo, 1e-9)
+    e2e = entry["e2e_ms"]
+    head = f"rid {rid}"
+    if e2e is not None:
+        head += f"  e2e={e2e:.2f}ms"
+    if entry.get("attempts") not in (None, 1):
+        head += f"  attempts={entry['attempts']}"
+    out.write(head + "\n")
+    for s in spans:
+        lo = int(_BAR_WIDTH * (s["t0"] - t_lo) / window)
+        hi = int(_BAR_WIDTH * (s["t1"] - t_lo) / window)
+        bar = " " * lo + ("#" * max(hi - lo, 1)).ljust(_BAR_WIDTH - lo)
+        dur_ms = (s["t1"] - s["t0"]) * 1e3
+        attrs = ""
+        if s["attrs"]:
+            attrs = " " + ",".join(f"{k}={v}" for k, v in s["attrs"].items())
+        out.write(f"  |{bar}| {s['name']:<14} {dur_ms:9.3f}ms{attrs}\n")
+
+
+def run(args: argparse.Namespace) -> int:
+    out = sys.stdout
+    try:
+        records = load_records(args.source)
+    except (OSError, ValueError) as e:
+        print(f"atx trace: cannot read {args.source!r}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"atx trace: no span records in {args.source!r}", file=sys.stderr)
+        return 2
+    by_rid = summarize(records)
+    if args.rid is not None:
+        by_rid = {args.rid: by_rid[args.rid]} if args.rid in by_rid else {}
+        if not by_rid:
+            print(f"atx trace: rid {args.rid} not in trace", file=sys.stderr)
+            return 2
+    rows = attribution(by_rid)
+    if args.as_json:
+        payload = {
+            "requests": {
+                str(rid): {
+                    "e2e_ms": e["e2e_ms"],
+                    "phases_ms": e["phases"],
+                    "attempts": e["attempts"],
+                    "spans": len(e["spans"]),
+                }
+                for rid, e in sorted(by_rid.items())
+            },
+            "attribution": rows,
+        }
+        out.write(json.dumps(payload, sort_keys=True) + "\n")
+    else:
+        for i, (rid, entry) in enumerate(sorted(by_rid.items())):
+            if i >= max(args.limit, 0):
+                out.write(
+                    f"... {len(by_rid) - i} more request(s) (--limit)\n"
+                )
+                break
+            _render_waterfall(rid, entry, out)
+        if rows:
+            out.write(
+                "\ntail-latency attribution "
+                f"({rows[0]['n']} requests with full phase spans):\n"
+            )
+            out.write(
+                f"  {'phase':<10}{'p50_ms':>12}{'p99_ms':>12}{'share':>9}\n"
+            )
+            for r in rows:
+                out.write(
+                    f"  {r['phase']:<10}{r['p50_ms']:>12.3f}"
+                    f"{r['p99_ms']:>12.3f}{r['share']:>8.1%}\n"
+                )
+    if args.check is not None:
+        problems = check_sums(by_rid, args.check)
+        for p in problems:
+            print(f"atx trace --check: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(
+            f"atx trace --check: phase attribution consistent within "
+            f"{args.check:.0%} for all checked requests",
+            file=sys.stderr,
+        )
+    return 0

@@ -33,11 +33,13 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, NamedTuple
 
 import jax
 import numpy as np
 
+from ..telemetry import flight as _flight
 from .host_offload import _adamw_slice
 
 __all__ = ["DiskMomentStore", "DiskOffloadedAdamW", "disk_offloaded_adamw"]
@@ -313,9 +315,20 @@ def disk_streamed_update(
 
     do_overlap = overlap_enabled() if overlap is None else bool(overlap)
     engine = get_transfer_engine()
+    # Transfer-overlap spans (docs/observability.md, BENCH_r05 follow-up):
+    # host clocks only, so the update math stays bit-identical either way.
+    trace = _flight.trace_requests_enabled()
+    t_update0 = time.perf_counter() if trace else 0.0
     # Step N-1's async flush must have COMPLETED (successfully) before this
     # update reads or mutates the memmaps; its errors re-raise here.
+    t_wb0 = time.perf_counter() if trace else 0.0
     tx.store.wait_writeback()
+    if trace:
+        # How long step N stalls on step N-1's memmap flush — the overlap
+        # mode exists to drive this span toward zero.
+        _flight.record_span(
+            "hostoffload_writeback_wait", t0=t_wb0, overlap=do_overlap
+        )
     # Dirty sentinel BEFORE the first memmap mutation: a crash anywhere in
     # the loop below leaves it set, and resume/retry refuse loudly instead
     # of re-applying the update to already-written leaves.
@@ -374,6 +387,22 @@ def disk_streamed_update(
     else:
         fetched = (fetch(job) for job in jobs)
 
+    d2h_wait = [0.0]
+    if trace:
+        # Host-visible D2H stall: time blocked pulling the next fetched
+        # slice (with prefetch armed, work already in flight hides here).
+        def _timed(it: Any) -> Any:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                d2h_wait[0] += time.perf_counter() - t0
+                yield item
+
+        fetched = _timed(iter(fetched))
+
     for (li, i), (g_h, p_h) in zip(jobs, fetched):
         mu, nu = opened[li]
         out = updates[li]
@@ -396,6 +425,7 @@ def disk_streamed_update(
             nu[...] = nu_n
             out[...] = u.astype(out.dtype)
 
+    t_flush0 = time.perf_counter() if trace else 0.0
     if do_overlap:
         # msync + count bump + sentinel clear overlap step N+1's compute;
         # the next update (or the next store over this dir) joins it.
@@ -403,4 +433,16 @@ def disk_streamed_update(
     else:
         tx.store.flush(count=count)
         tx.store.end_update()
+    if trace:
+        _flight.record_span(
+            "hostoffload_memmap_flush", t0=t_flush0, overlap=do_overlap
+        )
+        _flight.record_span(
+            "hostoffload_update",
+            t0=t_update0,
+            step=int(count),
+            slices=len(jobs),
+            overlap=do_overlap,
+            d2h_wait_ms=round(d2h_wait[0] * 1e3, 3),
+        )
     return jax.tree_util.tree_unflatten(treedef, updates)

@@ -60,9 +60,18 @@ def place_opt_state(opt_state: Any, shardings: Any, engine: Any | None = None) -
     `Accelerator.prepare_train_state` when restoring host-offloaded state —
     the Python-level sibling of the in-jit streamed update below (which XLA
     already overlaps with compute)."""
+    from ..telemetry import flight as _flight
     from .transfer import get_transfer_engine
 
     eng = engine if engine is not None else get_transfer_engine()
+    if _flight.trace_requests_enabled():
+        import time
+
+        n_leaves = len(jax.tree_util.tree_leaves(opt_state))
+        t0 = time.perf_counter()
+        out = eng.put_tree(opt_state, shardings).result()
+        _flight.record_span("hostoffload_h2d_place", t0=t0, leaves=n_leaves)
+        return out
     return eng.put_tree(opt_state, shardings).result()
 
 

@@ -51,6 +51,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..telemetry import flight as _flight
 from ..test_utils import faults
 from ..utils.environment import patch_environment
 from . import commit as _commit
@@ -463,6 +464,7 @@ def run_campaign(
                 "detail": {},
             }
         rec.update(episode=e, kind=kind, seed=ep_seed, ok=not rec["violations"])
+        _attach_postmortem(rec)
         records.append(rec)
     if subprocess_episodes:
         for kind, fn in (("replication-kill", _kill_episode),
@@ -473,6 +475,7 @@ def run_campaign(
                 episode=len(records), kind=kind, seed=ep_seed,
                 ok=not rec["violations"],
             )
+            _attach_postmortem(rec)
             records.append(rec)
     digest = hashlib.sha256(
         json.dumps([r["schedule"] for r in records], sort_keys=True).encode()
@@ -491,9 +494,29 @@ def run_campaign(
         "faulted_episodes": sum(
             1 for r in records if r["schedule"].get("assignments")
         ),
+        "postmortems": [r["postmortem"] for r in records if "postmortem" in r],
         "digest": digest,
         "report_path": report_path,
     }
+
+
+def _attach_postmortem(rec: dict) -> None:
+    """Dump a flight-recorder bundle for a violating episode and attach
+    its path to the record AND every violation string, so the triage
+    trail leads straight from the campaign summary to the black box
+    (`atx trace <bundle>`). No-op when the episode is clean or
+    ``ATX_POSTMORTEM_DIR`` is unset."""
+    if not rec["violations"]:
+        return
+    bundle = _flight.dump_postmortem(
+        f"chaos_episode{rec.get('episode', '')}_{rec.get('kind', '')}",
+        extra={"violations": rec["violations"], "schedule": rec["schedule"]},
+    )
+    if bundle:
+        rec["postmortem"] = bundle
+        rec["violations"] = [
+            f"{v} [postmortem: {bundle}]" for v in rec["violations"]
+        ]
 
 
 # ----------------------------------------------------------- worker roles

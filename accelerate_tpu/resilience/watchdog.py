@@ -179,6 +179,18 @@ class Watchdog:
             dump_all_stacks(out)
         except Exception:  # pragma: no cover - never block the abort
             pass
+        try:
+            # Black-box postmortem (no-op unless ATX_POSTMORTEM_DIR is
+            # set). Must run HERE: os._exit below bypasses atexit, so
+            # nothing later could write it. Lazy import + guard: a dying
+            # process must never die harder because the bundle hiccupped.
+            from ..telemetry import flight as _flight
+
+            _flight.dump_postmortem(
+                "watchdog_114", extra={"deadline_secs": deadline}
+            )
+        except Exception:  # pragma: no cover - never block the abort
+            pass
         if self._abort is not None:
             self._abort()
             self.fired.set()  # set AFTER the abort ran (test ordering seam)

@@ -51,6 +51,7 @@ import numpy as np
 
 from .. import resilience
 from .. import telemetry as _telemetry
+from ..telemetry import flight as _flight
 from ..generation import GenerationConfig, warp_logits
 from ..models.layers import cache_slot_copy, cache_slot_view, cache_slot_write
 from ..utils.environment import (
@@ -148,6 +149,7 @@ class _Slot:
     __slots__ = (
         "req", "chunks", "cursor", "n_new", "last_token", "out",
         "first_token_at", "decoding", "pending_copy",
+        "t_prefill0", "occ_sum", "occ_n",
     )
 
     def __init__(
@@ -167,6 +169,13 @@ class _Slot:
         self.out = np.full((req.max_new_tokens,), pad, np.int32)
         self.first_token_at = 0.0
         self.decoding = False
+        # Tracing residuals (ATX_TRACE_REQUESTS=1): first prefill-chunk
+        # dispatch time, plus decode-residency accumulators (sum of batch
+        # occupancy over resident iterations) — plain float/int adds in the
+        # decode loop, emitted as ONE span at completion.
+        self.t_prefill0 = 0.0
+        self.occ_sum = 0
+        self.occ_n = 0
 
 
 class Engine:
@@ -399,6 +408,11 @@ class Engine:
             "serve_generated_tokens", "tokens emitted", labels=_labels
         )
         self.actions: list[str] = []  # "prefill" / "decode", for tests/traces
+        # Request-scoped tracing (telemetry/flight.py), snapshotted ONCE so
+        # the decode inner loop pays zero cost while off. Spans time the
+        # HOST dispatch only — recording never adds a device sync, so
+        # greedy outputs are bit-identical with tracing on or off.
+        self._trace = _flight.trace_requests_enabled()
 
     # ------------------------------------------------------------- submit
     def submit(
@@ -586,7 +600,7 @@ class Engine:
                 # eviction cannot recycle its row in between, however many
                 # promotions other slots' completions trigger first.
                 node, matched = self.prefix_cache.match(
-                    req.prompt, limit=len(req.prompt) - 1
+                    req.prompt, limit=len(req.prompt) - 1, rid=req.rid
                 )
             try:
                 chunks = self._chunk_plan(req.prompt, start=matched)
@@ -616,6 +630,15 @@ class Engine:
             if matched:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefill_tokens_saved"] += matched
+            if self._trace:
+                _flight.record_span(
+                    "admit",
+                    rid=req.rid,
+                    slot=slot_id,
+                    prefix_hit=bool(matched),
+                    prefix_matched=int(matched),
+                    prompt_tokens=len(req.prompt),
+                )
 
     def step(self) -> list[Completion]:
         """One scheduler iteration: admit what fits, then run EITHER one
@@ -694,7 +717,9 @@ class Engine:
             # so in device order the chunk's attention over [0, cursor)
             # reads committed prefix KV, never the pool row's future state.
             node, matched = slot.pending_copy
+            t_copy0 = time.perf_counter() if self._trace else 0.0
             off = 0
+            n_copy = 0
             for ln in self.prefix_cache.chunks(matched):
                 self._kv = self._copy(
                     self._kv, self._pool,
@@ -703,9 +728,26 @@ class Engine:
                 self.copy_signatures.append(ln)
                 self.stats["prefix_copy_chunks"] += 1
                 off += ln
+                n_copy += 1
             self.prefix_cache.release(node)
             slot.pending_copy = None
+            if self._trace:
+                # Dispatch time only — the copies are async on device.
+                _flight.record_span(
+                    "prefix_copy",
+                    rid=slot.req.rid,
+                    t0=t_copy0,
+                    tokens=int(matched),
+                    chunks=n_copy,
+                )
         buf, real = slot.chunks.pop(0)
+        t_chunk0 = 0.0
+        compiles_before = 0
+        if self._trace:
+            if slot.t_prefill0 == 0.0:
+                slot.t_prefill0 = time.perf_counter()
+            t_chunk0 = time.perf_counter()
+            compiles_before = self._prefill._cache_size()
         tok, self._kv = self._prefill(
             self.params,
             buf,
@@ -718,6 +760,15 @@ class Engine:
         slot.cursor += real
         self.stats["prefill_chunks"] += 1
         self.prefill_signatures.append(buf.shape[1])
+        if self._trace:
+            _flight.record_span(
+                "prefill_chunk",
+                rid=slot.req.rid,
+                t0=t_chunk0,
+                bucket=int(buf.shape[1]),
+                tokens=int(real),
+                compile_miss=self._prefill._cache_size() > compiles_before,
+            )
         if slot.chunks:
             return []  # more prompt to go; tok was a throwaway
         self._prefill_order.popleft()
@@ -751,6 +802,14 @@ class Engine:
         ))
         if self._prefill_order:
             block = 1
+        if self._trace:
+            # Residency accounting: two attribute adds per resident slot —
+            # no per-iteration span, no allocation, nothing device-side.
+            occ = len(decoding)
+            for i in decoding:
+                s = self._slots[i]
+                s.occ_sum += occ * block
+                s.occ_n += block
         fetched = []
         # Commit the seed tokens to the cache's device so the chained calls
         # (whose token input is the previous step's committed OUTPUT) share
@@ -800,6 +859,7 @@ class Engine:
                     break
         if not eos_hit and not stop_hit and slot.n_new < req.max_new_tokens:
             return []
+        t_decode_end = time.perf_counter() if self._trace else 0.0
         completion = Completion(
             rid=req.rid,
             prompt=req.prompt,
@@ -813,6 +873,40 @@ class Engine:
             finished_at=time.perf_counter(),
             finish_reason="eos" if eos_hit else ("stop" if stop_hit else "length"),
         )
+        if self._trace:
+            # Contiguous phase spans — queue / prefill / decode / emit tile
+            # [submitted_at, finished_at] exactly, so the `atx trace`
+            # attribution table sums to the request's e2e by construction.
+            # A router stamps its admission time on the request so queue
+            # time spent BEFORE engine dispatch is attributed too (the
+            # `complete` span's e2e starts at router admission).
+            submitted = (
+                getattr(req, "router_submitted_at", 0.0)
+                or getattr(req, "submitted_at", 0.0)
+                or slot.t_prefill0
+            )
+            t_p0 = slot.t_prefill0 or submitted
+            t_first = slot.first_token_at or t_p0
+            _flight.record_span("phase_queue", rid=req.rid, t0=submitted, t1=t_p0)
+            _flight.record_span("phase_prefill", rid=req.rid, t0=t_p0, t1=t_first)
+            _flight.record_span(
+                "phase_decode",
+                rid=req.rid,
+                t0=t_first,
+                t1=t_decode_end,
+                iterations=slot.occ_n,
+                tokens=slot.n_new,
+                occupancy=round(
+                    slot.occ_sum / max(slot.occ_n * self.n_slots, 1), 4
+                ),
+            )
+            _flight.record_span(
+                "phase_emit",
+                rid=req.rid,
+                t0=t_decode_end,
+                t1=completion.finished_at,
+                finish_reason=completion.finish_reason,
+            )
         if self.prefix_cache is not None:
             self._promote(slot_id, slot)
         self._slots[slot_id] = None  # evict: the slot is immediately reusable

@@ -36,10 +36,13 @@ place (`engine._prefill_step` / `engine._promote`).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Sequence
 
 import numpy as np
+
+from ..telemetry import flight as _flight
 
 __all__ = ["PrefixCache", "CacheNode"]
 
@@ -106,6 +109,9 @@ class PrefixCache:
             ),
             label="cache",
         )
+        # Request-scoped tracing flag, snapshotted once like the engine's
+        # (docs/observability.md): the lookup path never re-reads the env.
+        self._trace = _flight.trace_requests_enabled()
         # Reachability DP over [0, max_len]: _chunkable[n] is the LARGEST
         # bucket completing a decomposition of n into bucket lengths (0 =
         # not decomposable). Handles bucket sets that aren't multiples of
@@ -157,7 +163,7 @@ class PrefixCache:
         return None
 
     def match(
-        self, tokens: np.ndarray, *, limit: int | None = None
+        self, tokens: np.ndarray, *, limit: int | None = None, rid: int = -1
     ) -> tuple[CacheNode | None, int]:
         """Longest usable cached prefix of ``tokens``.
 
@@ -168,8 +174,10 @@ class PrefixCache:
         ``len(prompt) - 1`` so at least one prompt token is always left to
         prefill — something has to produce the first sampling logits).
         The node is PINNED against eviction until `release`.
-        A miss returns ``(None, 0)``."""
+        A miss returns ``(None, 0)``. ``rid`` tags the request-scoped
+        trace span when ``ATX_TRACE_REQUESTS=1``."""
         self.stats["lookups"] += 1
+        t_match0 = time.perf_counter() if self._trace else 0.0
         tokens = np.asarray(tokens)
         node, depth = self._root, 0
         path: list[CacheNode] = []
@@ -190,6 +198,10 @@ class PrefixCache:
         limit = len(tokens) if limit is None else min(int(limit), len(tokens))
         matched = self.aligned(min(depth, limit))
         if matched <= 0:
+            if self._trace:
+                _flight.record_span(
+                    "prefix_match", rid=rid, t0=t_match0, hit=False, matched=0
+                )
             return None, 0
         # A source row must cover [0, matched) of a path agreeing with
         # ``tokens`` for >= matched tokens: fully-matched path nodes with
@@ -204,11 +216,19 @@ class PrefixCache:
         if src is None:
             src = self._any_row_below(frontier if frontier is not None else node)
         if src is None:
+            if self._trace:
+                _flight.record_span(
+                    "prefix_match", rid=rid, t0=t_match0, hit=False, matched=0
+                )
             return None, 0
         src.refs += 1
         self._touch(src)
         self.stats["hits"] += 1
         self.stats["tokens_matched"] += matched
+        if self._trace:
+            _flight.record_span(
+                "prefix_match", rid=rid, t0=t_match0, hit=True, matched=matched
+            )
         return src, matched
 
     def release(self, node: CacheNode) -> None:

@@ -114,6 +114,7 @@ import numpy as np
 # the package or the router_drain scenario would dodge the simulation.
 from .. import resilience
 from .. import telemetry as _telemetry
+from ..telemetry import flight as _flight
 from ..utils.environment import get_int_from_env
 from .engine import Completion, Engine, Request
 
@@ -603,6 +604,9 @@ class Router:
             "router_class_e2e_ms", "admission -> completion, per class",
             labels=("router", "class"),
         )
+        # Request-scoped tracing flag, snapshotted once (the engines do
+        # the same): admission/dispatch/stream spans cost zero when off.
+        self._trace = _flight.trace_requests_enabled()
         self.stats = _telemetry.StatsView(
             "router",
             (
@@ -666,6 +670,11 @@ class Router:
     def submit_request(self, req: Request) -> int:
         if self._draining:
             self.stats["drain_rejected"] += 1
+            if self._trace:
+                _flight.record_span(
+                    "admission", rid=req.rid, decision="drain_rejected",
+                    cause=str(self.drain_reason),
+                )
             raise RouterDraining(
                 f"router is draining ({self.drain_reason}): "
                 "not admitting new requests"
@@ -676,6 +685,11 @@ class Router:
             # class's newest ticket instead of being rejected.
             if not (self.scheduling == "edf" and self._shed_for(req)):
                 self.stats["rejects"] += 1
+                if self._trace:
+                    _flight.record_span(
+                        "admission", rid=req.rid, decision="rejected",
+                        cause="queue_full", pending=self._public_pending(),
+                    )
                 raise QueueFullError(
                     f"admission queue full ({self._public_pending()}/"
                     f"{self.queue_depth} pending; ATX_SERVE_QUEUE_DEPTH raises "
@@ -686,6 +700,11 @@ class Router:
         self._ref.validate_request(req)
         if self.scheduling == "edf" and self._deadline_infeasible(req):
             self._c_infeasible.inc(**self._tel_labels)
+            if self._trace:
+                _flight.record_span(
+                    "admission", rid=req.rid, decision="rejected",
+                    cause="deadline_infeasible",
+                )
             raise DeadlineInfeasibleError(
                 f"deadline {req.timeout:.3f}s is infeasible given observed "
                 "service time and the queue ahead — rejected at admission"
@@ -699,6 +718,18 @@ class Router:
         self._pending.append(t)
         self._outstanding += 1
         self._classes_seen.add(int(req.priority))
+        if self._trace:
+            # The EDF key the dispatcher will sort this ticket by — the
+            # scheduling decision, captured at the moment it was made.
+            _flight.record_span(
+                "admission", rid=req.rid, decision="accepted",
+                priority=int(req.priority),
+                deadline_ms=(
+                    round(req.timeout * 1e3, 3)
+                    if req.timeout is not None else None
+                ),
+                seq=t.seq,
+            )
         self.stats["submitted"] += 1
         self.stats["queue_peak"] = max(
             self.stats["queue_peak"], self._public_pending()
@@ -723,6 +754,11 @@ class Router:
         )
         self._pending.remove(victim)
         cls = int(victim.req.priority)
+        if self._trace:
+            _flight.record_span(
+                "admission", rid=victim.req.rid, decision="shed",
+                cause=f"displaced_by_class_{int(req.priority)}",
+            )
         self._c_shed.inc(**{**self._tel_labels, "class": str(cls)})
         self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
         c = self._local_cancel_completion(victim)
@@ -933,10 +969,22 @@ class Router:
         t.generation += 1
         t.cancel_sent = False
         t.req.stream = self._make_stream(t)
+        if self._trace and not t.internal:
+            # The engine's phase_queue span starts here, not at engine
+            # dispatch, so router queue wait lands in the attribution.
+            t.req.router_submitted_at = t.submitted_at  # type: ignore[attr-defined]
         r.inflight.add(t.req.rid)
         r.dispatched += 1
         if not t.internal:
             self.stats["dispatched"] += 1
+            if self._trace:
+                # attempts > 1 marks a failover re-dispatch: a retried
+                # request's trace shows BOTH the failed and replayed
+                # dispatch (exactly-once tests key on this).
+                _flight.record_span(
+                    "dispatch", rid=t.req.rid, replica=r.id,
+                    attempt=t.attempts, retry=t.attempts > 1,
+                )
             self._h_queue_wait.observe(
                 (time.perf_counter() - t.submitted_at) * 1e3, **self._tel_labels
             )
@@ -957,6 +1005,7 @@ class Router:
         (generation mismatch) entirely."""
         gen = t.generation
         count = 0
+        trace = self._trace and not t.internal
 
         def stream(rid: int, tok: int, text: str | None) -> None:
             nonlocal count
@@ -965,6 +1014,11 @@ class Router:
                 return  # superseded attempt still unwinding
             if count > t.streamed:
                 t.streamed = count
+                if trace:
+                    # Recorded only on actual delivery — a replayed
+                    # attempt's deduplicated tokens leave no span, so a
+                    # trace counts each streamed token exactly once.
+                    _flight.record_span("stream", rid=rid, index=count)
                 if t.user_stream is not None:
                     t.user_stream(rid, tok, text)
 
@@ -1058,6 +1112,12 @@ class Router:
                     t.req.prompt.copy(), int(t.req.seed),
                     c.tokens[:k].copy(), k,
                 )
+        if self._trace:
+            _flight.record_span(
+                "complete", rid=c.rid, t0=t.submitted_at, t1=c.finished_at,
+                finish_reason=c.finish_reason, n_new=int(c.n_new),
+                attempts=t.attempts,
+            )
         self.stats["completed"] += 1
         self._outstanding -= 1
         self._completions.append(c)
@@ -1069,6 +1129,18 @@ class Router:
         r.dead = True
         r.error = reason
         self.stats["replicas_lost"] += 1
+        if self._trace:
+            _flight.record_span(
+                "quarantine", rid=-1, replica=replica_id, cause=reason,
+                inflight=len(r.inflight),
+            )
+        # Black-box dump: the flight recorder's last-N spans at the moment
+        # a replica died (no-op unless ATX_POSTMORTEM_DIR is set).
+        _flight.dump_postmortem(
+            f"quarantine_replica{replica_id}",
+            extra={"replica": replica_id, "reason": reason,
+                   "inflight": sorted(r.inflight)},
+        )
         # Prefix-cache migration: re-seed the dead replica's hottest
         # committed radix paths into a survivor (host token ids only — the
         # warm-up PREFILLS there; KV bytes never cross devices) and

@@ -18,16 +18,33 @@ Dependency-free, hot-path-safe metrics + tracing for training and serving:
   serving engine/router/prefix-cache ``stats`` so the old snapshot shapes
   and the endpoint read one source of truth.
 
+- `telemetry.flight` — the request-scoped tracing layer: a bounded
+  per-process ring of span records (the black-box *flight recorder*) that
+  the serving path tags with request ids behind ``ATX_TRACE_REQUESTS=1``,
+  plus `dump_postmortem`, which abnormal-exit hooks (watchdog 114, exit-75,
+  quarantine, chaos violations, the non-finite guard) use to drop a
+  last-N-spans + metrics + thread-stacks bundle into ``ATX_POSTMORTEM_DIR``
+  (rendered by ``atx trace``).
+
 Knobs: ``ATX_METRICS`` (default 1), ``ATX_METRICS_SAMPLE_EVERY`` (default 0),
 ``ATX_METRICS_LOG_EVERY`` (default 0), ``ATX_METRICS_DIR`` (shared snapshot
-dir), ``ATX_METRICS_EMA`` (default 0.2), ``ATX_TRACE_DIR`` (span JSONL).
+dir), ``ATX_METRICS_EMA`` (default 0.2), ``ATX_TRACE_DIR`` (span JSONL),
+``ATX_TRACE_REQUESTS`` (default 0), ``ATX_FLIGHT_RECORDER_SPANS`` (default
+4096), ``ATX_POSTMORTEM_DIR`` (unset = no bundles).
 """
 
 from __future__ import annotations
 
 from ..utils.environment import parse_flag_from_env
-from . import export, registry, spans, stepstats, views
+from . import export, flight, registry, spans, stepstats, views
 from .export import MetricsServer
+from .flight import (
+    FlightRecorder,
+    dump_postmortem,
+    read_bundle,
+    record_span,
+    trace_requests_enabled,
+)
 from .registry import (
     DEFAULT_BYTES_BUCKETS,
     DEFAULT_MS_BUCKETS,
@@ -64,15 +81,20 @@ __all__ = [
     "StepStats",
     "DEFAULT_BYTES_BUCKETS",
     "DEFAULT_MS_BUCKETS",
+    "FlightRecorder",
     "aggregate_snapshots",
     "chrome_trace",
     "counter",
+    "dump_postmortem",
     "gauge",
     "histogram",
     "merge_snapshots",
     "metrics_enabled",
     "peak_device_flops",
+    "read_bundle",
     "read_snapshots",
+    "record_span",
+    "trace_requests_enabled",
     "render_prometheus",
     "render_snapshot_prometheus",
     "snapshot",
@@ -84,6 +106,7 @@ __all__ = [
     "tokens_in_batch",
     "write_snapshot",
     "export",
+    "flight",
     "registry",
     "spans",
     "stepstats",

@@ -1,0 +1,296 @@
+"""Black-box flight recorder: request-scoped spans + postmortem bundles.
+
+PR 13's metrics registry answers "how is the fleet doing on average"; this
+module answers the two questions aggregates cannot: *where did THIS request
+spend its time* (Dapper-style request-scoped tracing — every `Request`'s
+`rid` tags spans that flow router -> engine -> prefix-cache -> decode) and
+*what was the process doing just before it died* (the flight recorder, an
+aircraft-style black box: a bounded per-process ring buffer of the last N
+span records that an abnormal-exit hook dumps as a postmortem bundle).
+
+Hot-path contract (the serving engine's decode loop is the hardest case):
+
+- recording is OFF unless ``ATX_TRACE_REQUESTS=1`` — the engine/router
+  cache the flag at construction, so the disabled cost in the decode inner
+  loop is zero;
+- a record is one small dict appended into a preallocated ring under a
+  lock — no device access, no syncs, no allocation beyond the span record
+  itself (the same budget `telemetry/registry.py` promises);
+- decode iterations are never recorded individually: residency is
+  accumulated per slot (two float adds per resident slot per block) and
+  emitted as ONE span at completion.
+
+Postmortem bundles (``ATX_POSTMORTEM_DIR``): on watchdog 114, exit-75
+preemption/drain, replica quarantine, a chaos violation, or the non-finite
+guard tripping, `dump_postmortem` writes one JSON file with the last-N
+spans, a metrics-registry snapshot, every Python thread's stack, the tail
+of the multihost collective log (when a host-trace replay is active), and
+the currently-armed fault points. Every collector is individually guarded:
+a dying process must never die harder because its black box hiccupped.
+`atx trace` (commands/trace.py) renders bundles and live trace dirs as
+per-request waterfalls. See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+from typing import Any
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "FlightRecorder",
+    "dump_postmortem",
+    "postmortem_dir",
+    "read_bundle",
+    "record_span",
+    "recorder",
+    "reset_recorder",
+    "trace_requests_enabled",
+]
+
+BUNDLE_VERSION = 1
+DEFAULT_CAPACITY = 4096
+# Collective-log tail length kept in a bundle (full logs can be huge).
+_COLLECTIVE_TAIL = 50
+
+
+def _process_index() -> int:
+    from .spans import _process_index as spans_process_index
+
+    return spans_process_index()
+
+
+def trace_requests_enabled() -> bool:
+    """Is request-scoped tracing on? Read from the environment every call
+    (cheap: one dict lookup); the engine/router snapshot it at construction
+    so the decode inner loop never even pays the lookup."""
+    return os.environ.get("ATX_TRACE_REQUESTS", "").lower() in ("1", "true", "yes")
+
+
+class FlightRecorder:
+    """Bounded ring of span records. ``capacity`` defaults to
+    ``ATX_FLIGHT_RECORDER_SPANS`` (4096). The buffer is preallocated; a
+    `record` is one slot assignment + counter bump under the lock, so
+    steady-state recording allocates nothing beyond the caller's record."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            try:
+                capacity = int(
+                    os.environ.get("ATX_FLIGHT_RECORDER_SPANS", DEFAULT_CAPACITY)
+                )
+            except ValueError:
+                capacity = DEFAULT_CAPACITY
+        self.capacity = max(1, int(capacity))
+        self._buf: list[Any] = [None] * self.capacity
+        self._n = 0  # total records ever (wraparound keeps counting)
+        self._lock = threading.Lock()
+        # Anchors mapping perf_counter span times back to wall clock for
+        # renderers (span records carry monotonic times only).
+        self.t0_perf = time.perf_counter()
+        self.t0_wall = time.time()
+
+    @property
+    def total(self) -> int:
+        return self._n
+
+    def record(self, entry: dict[str, Any]) -> None:
+        with self._lock:
+            self._buf[self._n % self.capacity] = entry
+            self._n += 1
+
+    def last(self, n: int | None = None) -> list[dict[str, Any]]:
+        """The most recent ``n`` records (all retained when None), oldest
+        first — the dump order of a postmortem bundle."""
+        with self._lock:
+            count = min(self._n, self.capacity)
+            if n is not None:
+                count = min(count, max(0, int(n)))
+            start = self._n - count
+            return [self._buf[i % self.capacity] for i in range(start, self._n)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._n = 0
+
+
+_RECORDER: FlightRecorder | None = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The per-process flight recorder (created on first use so the env
+    capacity knob is read at arming time, not import time)."""
+    global _RECORDER
+    rec = _RECORDER
+    if rec is None:
+        with _RECORDER_LOCK:
+            rec = _RECORDER
+            if rec is None:
+                rec = _RECORDER = FlightRecorder()
+    return rec
+
+
+def reset_recorder(capacity: int | None = None) -> FlightRecorder:
+    """Replace the process recorder (test isolation; never called at
+    runtime)."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        _RECORDER = FlightRecorder(capacity)
+    return _RECORDER
+
+
+def record_span(
+    name: str,
+    *,
+    rid: int = -1,
+    t0: float | None = None,
+    t1: float | None = None,
+    **attrs: Any,
+) -> None:
+    """Record one span into the flight recorder (and mirror it into the
+    Chrome-trace JSONL writer when `start_trace_log` armed one, so a live
+    ``ATX_TRACE_DIR`` carries the request spans too).
+
+    ``t0``/``t1`` are ``time.perf_counter()`` values; both default to "now"
+    (an instant marker). ``attrs`` must be JSON-friendly scalars — cast
+    numpy ints at the call site."""
+    rec = recorder()
+    now = time.perf_counter()
+    if t1 is None:
+        t1 = now
+    if t0 is None:
+        t0 = t1
+    entry: dict[str, Any] = {"name": name, "rid": int(rid), "t0": t0, "t1": t1}
+    if attrs:
+        entry["attrs"] = attrs
+    rec.record(entry)
+    from . import spans as _spans
+
+    _spans.mirror_flight_event(entry, rec.t0_perf, rec.t0_wall)
+
+
+# ------------------------------------------------------- postmortem bundles
+
+
+def postmortem_dir() -> str:
+    return os.environ.get("ATX_POSTMORTEM_DIR", "")
+
+
+def _thread_stacks() -> str:
+    """Every Python thread's stack, formatted. Local (sys._current_frames)
+    rather than borrowing resilience.watchdog.dump_all_stacks: the bundle
+    writer must work even when the resilience package cannot import in a
+    dying process."""
+    buf = io.StringIO()
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        buf.write(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
+        buf.write("".join(traceback.format_stack(frame)))
+    return buf.getvalue()
+
+
+_DUMP_LOCK = threading.Lock()
+_DUMP_SEQ = 0
+
+
+def dump_postmortem(
+    reason: str,
+    directory: str | None = None,
+    *,
+    extra: Any = None,
+) -> str | None:
+    """Write a postmortem bundle and return its path (None when no
+    directory is configured or the write failed — the caller is mid-crash
+    and must not care). Each collector is independently fenced so one
+    broken subsystem cannot cost the rest of the bundle."""
+    directory = directory if directory is not None else postmortem_dir()
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError:
+        return None
+    bundle: dict[str, Any] = {
+        "version": BUNDLE_VERSION,
+        "reason": str(reason),
+        "time_unix": time.time(),
+        "pid": os.getpid(),
+        "process_index": _process_index(),
+    }
+    rec = _RECORDER
+    if rec is not None:
+        bundle["spans"] = rec.last()
+        bundle["spans_total"] = rec.total
+        bundle["t0_perf"] = rec.t0_perf
+        bundle["t0_wall"] = rec.t0_wall
+    else:
+        bundle["spans"] = []
+        bundle["spans_total"] = 0
+    try:
+        from . import registry as _registry
+
+        bundle["metrics"] = _registry.snapshot()
+    except Exception as e:
+        bundle["metrics_error"] = repr(e)
+    try:
+        bundle["thread_stacks"] = _thread_stacks()
+    except Exception as e:
+        bundle["thread_stacks_error"] = repr(e)
+    try:
+        from ..analysis import host_trace
+
+        hrec = host_trace._ACTIVE_RECORDER
+        if hrec is not None:
+            bundle["collective_log"] = [
+                e.describe() for e in hrec.collective_events[-_COLLECTIVE_TAIL:]
+            ]
+    except Exception as e:
+        bundle["collective_log_error"] = repr(e)
+    try:
+        from ..test_utils import faults
+
+        bundle["fault_points"] = {
+            "seen": sorted(str(p) for p in faults.active_points()),
+            "env": {
+                k: v for k, v in os.environ.items() if k.startswith("ATX_FAULT_")
+            },
+        }
+    except Exception as e:
+        bundle["fault_points_error"] = repr(e)
+    if extra is not None:
+        bundle["extra"] = extra
+    global _DUMP_SEQ
+    with _DUMP_LOCK:
+        _DUMP_SEQ += 1
+        seq = _DUMP_SEQ
+    slug = re.sub(r"[^A-Za-z0-9_.-]+", "_", str(reason))[:64] or "bundle"
+    path = os.path.join(directory, f"postmortem_{slug}_{os.getpid()}_{seq}.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def read_bundle(path: str) -> dict[str, Any]:
+    """Load + schema-check a postmortem bundle (the `atx trace` reader)."""
+    with open(path) as f:
+        bundle = json.load(f)
+    if not isinstance(bundle, dict) or "spans" not in bundle:
+        raise ValueError(f"{path} is not a postmortem bundle (no 'spans')")
+    return bundle

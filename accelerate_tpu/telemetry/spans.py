@@ -20,6 +20,7 @@ Hot-path safety: with no span log open and no profiler trace running,
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import contextvars
 import json
@@ -57,12 +58,23 @@ class _JsonlWriter:
         self._lock = threading.Lock()
 
     def write(self, event: dict[str, Any]) -> None:
-        line = json.dumps(event, separators=(",", ":"))
+        line = json.dumps(event, separators=(",", ":"), default=str)
         with self._lock:
-            self._f.write(line + "\n")
+            if not self._f.closed:
+                self._f.write(line + "\n")
 
     def close(self) -> None:
+        # Flush + fsync before closing: the atexit/SystemExit path (exit-75
+        # preemption) must leave every event durably on disk, not in a
+        # page-cache line a subsequent kill can truncate.
         with self._lock:
+            if self._f.closed:
+                return
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
             self._f.close()
 
 
@@ -75,10 +87,24 @@ def _process_index() -> int:
         return 0
 
 
+_atexit_registered = False
+
+
+def _close_writer_at_exit() -> None:
+    # Runs on interpreter shutdown, including ``SystemExit`` paths (exit-75
+    # preemption, a drain's sys.exit) and uncaught exceptions — the cases
+    # that used to truncate the last events. ``os._exit`` paths (kill-137,
+    # the watchdog's default abort) bypass atexit by design; the watchdog
+    # dumps its postmortem bundle explicitly before aborting instead.
+    writer = _writer
+    if writer is not None:
+        writer.close()
+
+
 def start_trace_log(path: str | None = None) -> str:
     """Open the span JSONL log. Default path:
     ``$ATX_TRACE_DIR/spans_<proc>.jsonl``."""
-    global _writer, _env_checked
+    global _writer, _env_checked, _atexit_registered
     with _writer_lock:
         if _writer is not None:
             return _writer.path
@@ -87,6 +113,9 @@ def start_trace_log(path: str | None = None) -> str:
             path = os.path.join(base, f"spans_{_process_index()}.jsonl")
         _writer = _JsonlWriter(path)
         _env_checked = True
+        if not _atexit_registered:
+            atexit.register(_close_writer_at_exit)
+            _atexit_registered = True
         return path
 
 
@@ -170,6 +199,32 @@ def step_span(step: int, name: str = "train") -> Iterator[None]:
 def current_span() -> str | None:
     stack = _SPAN_STACK.get()
     return stack[-1] if stack else None
+
+
+def mirror_flight_event(
+    entry: dict[str, Any], t0_perf: float, t0_wall: float
+) -> None:
+    """Write a flight-recorder span record (`telemetry/flight.py`) into the
+    Chrome-trace JSONL log when one is open, mapping its monotonic
+    perf_counter times onto the wall clock via the recorder's anchors, so a
+    live ``ATX_TRACE_DIR`` carries the request-scoped spans alongside the
+    block spans and `atx trace` can read either surface."""
+    writer = _writer if _env_checked else _maybe_open_from_env()
+    if writer is None:
+        return
+    args: dict[str, Any] = {"rid": entry.get("rid", -1)}
+    args.update(entry.get("attrs", ()))
+    writer.write(
+        {
+            "name": entry["name"],
+            "ph": "X",
+            "ts": (t0_wall + (entry["t0"] - t0_perf)) * 1e6,
+            "dur": max(0.0, entry["t1"] - entry["t0"]) * 1e6,
+            "pid": _process_index(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+            "args": args,
+        }
+    )
 
 
 def chrome_trace(jsonl_path: str) -> dict[str, Any]:

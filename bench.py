@@ -1684,5 +1684,128 @@ def _bench_bert(on_tpu: bool, fetch_latency: float) -> dict:
     return stats
 
 
+# ------------------------------------------------ regression compare gate
+# `python bench.py --compare OLD.json NEW.json [--threshold 0.05]
+#  [--series name,name,...]` diffs two bench result lines (the BENCH_r0x
+# lineage) and exits non-zero on a regression beyond the threshold — the
+# trajectory gate future perf PRs run in CI (`make smoke-trace`).
+
+# Metric direction by suffix. Checked in order: a name matching a
+# higher-better suffix is higher-better even when a lower-better suffix
+# also matches (e.g. *_mib_s ends with both "_mib_s" and "_s").
+_HIGHER_BETTER = (
+    "_mfu", "_tokens_per_sec", "_samples_per_sec", "_per_sec", "_tflops",
+    "_mib_s", "_gib_s", "_speedup", "_hit_rate", "_flops",
+)
+_LOWER_BETTER = ("_ms", "_s", "_secs", "_compiles", "_gib_per_token")
+
+
+def _direction(name: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 not a perf series."""
+    for suf in _HIGHER_BETTER:
+        if name.endswith(suf):
+            return 1
+    for suf in _LOWER_BETTER:
+        if name.endswith(suf):
+            return -1
+    return 0
+
+
+def compare_results(
+    old_path: str,
+    new_path: str,
+    *,
+    threshold: float = 0.05,
+    series: list[str] | None = None,
+) -> tuple[list[str], int]:
+    """Diff two bench JSON result files. Returns (regression messages,
+    number of series compared). A series regresses when it moves against
+    its direction by more than ``threshold`` (relative). ``series``
+    restricts the comparison to named keys (and makes a named key MISSING
+    from the new result a regression too — a silently dropped series must
+    not pass the gate)."""
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    regressions: list[str] = []
+    compared = 0
+    names = series if series is not None else sorted(set(old) & set(new))
+    for name in names:
+        if series is not None and (name not in old or name not in new):
+            missing = "new" if name not in new else "old"
+            regressions.append(f"{name}: named series missing from {missing} result")
+            continue
+        ov, nv = old.get(name), new.get(name)
+        if (
+            isinstance(ov, bool) or isinstance(nv, bool)
+            or not isinstance(ov, (int, float))
+            or not isinstance(nv, (int, float))
+        ):
+            continue
+        sign = _direction(name)
+        if sign == 0 and series is None:
+            continue  # unnamed non-perf keys (counts, params) are ignored
+        compared += 1
+        if not ov:
+            continue  # no baseline magnitude to compare against
+        rel = (nv - ov) / abs(ov)
+        if sign >= 0 and rel < -threshold:
+            regressions.append(
+                f"{name}: {ov} -> {nv} ({rel:+.1%}, higher is better, "
+                f"threshold {threshold:.0%})"
+            )
+        elif sign < 0 and rel > threshold:
+            regressions.append(
+                f"{name}: {ov} -> {nv} ({rel:+.1%}, lower is better, "
+                f"threshold {threshold:.0%})"
+            )
+    return regressions, compared
+
+
+def _compare_main(argv: list[str]) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="bench.py --compare",
+        description="Regression-gate two bench result JSON files",
+    )
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument(
+        "--threshold", type=float, default=0.05,
+        help="relative regression tolerance (default 0.05 = 5%%)",
+    )
+    p.add_argument(
+        "--series", default=None,
+        help="comma-separated series names to gate on (default: every "
+        "shared key with a recognized perf suffix); a named series "
+        "missing from either side is itself a regression",
+    )
+    args = p.parse_args(argv)
+    series = (
+        [s.strip() for s in args.series.split(",") if s.strip()]
+        if args.series else None
+    )
+    regressions, compared = compare_results(
+        args.old, args.new, threshold=args.threshold, series=series
+    )
+    for msg in regressions:
+        print(f"REGRESSION {msg}")
+    print(
+        json.dumps(
+            {
+                "compared": compared,
+                "regressions": len(regressions),
+                "threshold": args.threshold,
+                "ok": not regressions,
+            }
+        )
+    )
+    return 1 if regressions else 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        sys.exit(_compare_main(sys.argv[2:]))
     main()
