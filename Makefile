@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace lint-graph lint-multihost
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace lint-graph lint-multihost lint-perf
 
 test:
 	python -m pytest tests/ -q
@@ -50,6 +50,18 @@ smoke-router:
 lint-graph:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m accelerate_tpu.commands.cli lint examples --severity error
+
+# Static performance lint + budget ratchet (ATX6xx, docs/performance.md
+# "perf campaign"): the example train steps plus the bench-scale llama2b
+# config are compiled abstractly, the roofline rules run at error
+# severity, and the ATX601 series (static MFU bound, exposed-comms bytes,
+# padding-waste fraction) are checked against the committed
+# perf/budgets.json — any regression past tolerance fails the lane.
+# Rated at v5e so the series are TPU-shaped even on the CPU container.
+lint-perf:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint perf --severity error \
+		--chip v5e --budgets perf/budgets.json
 
 # Multi-host SPMD-consistency lint (ATX5xx, docs/static_analysis.md): the
 # example train steps are re-traced under 2 simulated processes (divergent
@@ -168,5 +180,5 @@ smoke-trace:
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace
+test-all: lint-graph lint-multihost lint-perf smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace
 	python -m pytest tests/ -q --heavy

@@ -17,6 +17,12 @@ emits structured `Finding` records across four rule families:
 - **ATX4xx host sync & collectives** — callbacks/`debug.print` in the hot
   jaxpr, and collective byte accounting mined from the compiled HLO with a
   threshold catching accidental full-param gathers;
+- **ATX6xx performance** — a static roofline over the compiled HLO
+  (`analysis/roofline.py`): per-chip-generation peaks bucket every op into
+  MXU / vector / HBM / collective time, yielding a step-time lower bound
+  and an MFU ceiling before anything runs, plus rules for exposed
+  collectives, tile-padding waste, precision-fallback dots, and fusion
+  breaks — the series `perf/budgets.json` ratchets (`make lint-perf`);
 - **ATX5xx multi-host consistency** — a simulated-process replay harness
   (`host_trace.replay_host_loop`) runs a host loop once per patched
   `process_index`, records every owned collective's (op, signature, stack)
@@ -48,18 +54,35 @@ from .engine import (
 )
 from .hbm import HbmBreakdown, human_bytes, state_hbm_per_device, tree_device_bytes
 from .host_trace import HostEvent, HostTraceResult, replay_host_loop
+from .roofline import (
+    CHIP_SPECS,
+    ChipSpec,
+    RooflineResult,
+    analyze_hlo,
+    chip_spec_for,
+    find_exposed_collectives,
+    find_fusion_breaks,
+)
 
 # Importing the rule modules registers their rules.
 from . import rules_collectives  # noqa: F401  (ATX4xx)
 from . import rules_donation  # noqa: F401  (ATX2xx)
 from . import rules_multihost  # noqa: F401  (ATX5xx)
+from . import rules_perf  # noqa: F401  (ATX6xx)
 from . import rules_recompile  # noqa: F401  (ATX3xx)
 from . import rules_sharding  # noqa: F401  (ATX1xx)
 
 __all__ = [
     "AnalysisWarning",
+    "CHIP_SPECS",
+    "ChipSpec",
     "DEFAULT_OPTIONS",
     "Finding",
+    "RooflineResult",
+    "analyze_hlo",
+    "chip_spec_for",
+    "find_exposed_collectives",
+    "find_fusion_breaks",
     "HbmBreakdown",
     "HostEvent",
     "HostTraceResult",

@@ -54,6 +54,29 @@ DEFAULT_OPTIONS: dict[str, Any] = {
     # "accidental full-param gather" signature.
     "gather_param_fraction": 0.5,
     "gather_min_bytes": 8 << 20,
+    # ATX6xx performance family (analysis/roofline.py). `roofline_chip`
+    # picks the ChipSpec to rate against: a name from
+    # `roofline.CHIP_SPECS` ("v5p"), or None to auto-detect the local
+    # device (`cpu` on the container).
+    "roofline_chip": None,
+    # ATX601: how many highest-FLOP ops get an arithmetic-intensity row.
+    "roofline_top_k": 8,
+    # ATX602: an async collective is exposed when the compute scheduled
+    # between its -start and -done covers less than this fraction of the
+    # wire time; pairs below the byte floor never flag.
+    "exposed_overlap_fraction": 0.5,
+    "exposed_min_bytes": 1 << 20,
+    # ATX603: a dot flags when tile padding wastes at least this fraction
+    # of its MXU FLOPs AND at least this many absolute FLOPs (keeps tiny
+    # CPU-scale models quiet).
+    "tiling_waste_fraction": 0.1,
+    "tiling_min_waste_flops": 1e9,
+    # ATX604: only dots carrying at least this fraction of the step's
+    # total dot FLOPs are "hot" enough to flag a precision fallback.
+    "precision_hot_fraction": 0.05,
+    # ATX605: a fusion break flags when the materialized intermediate is
+    # at least this large (one extra HBM write + read per step).
+    "fusion_break_bytes": 32 << 20,
 }
 
 
@@ -344,6 +367,7 @@ def _run(ctx: LintContext, only: Sequence[str] | None, strict: bool, target: str
     from . import rules_collectives  # noqa: F401
     from . import rules_donation  # noqa: F401
     from . import rules_multihost  # noqa: F401
+    from . import rules_perf  # noqa: F401
     from . import rules_recompile  # noqa: F401
     from . import rules_sharding  # noqa: F401
 

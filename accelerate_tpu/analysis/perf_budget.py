@@ -1,0 +1,113 @@
+"""Ratcheting perf budgets over the ATX601 static-roofline series.
+
+`perf/budgets.json` commits three statically-derived numbers per lint
+scenario — the MFU ceiling, the exposed-collective bytes, and the
+tile-padding waste fraction — and `atx lint perf --budgets perf/budgets.json`
+(the `make lint-perf` lane) fails when any of them regresses past
+tolerance: the static twin of `bench.py --compare`. A PR that improves a
+series re-baselines it with `--write-budgets`, so the budget only moves in
+the good direction deliberately — a ratchet.
+
+Tolerances are small-but-nonzero because the series, while deterministic
+for a given jax/XLA version, shift when the compiler changes fusion or
+partitioning decisions; the ratchet should catch model/config mistakes,
+not XLA point releases.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+#: The budgeted series, as emitted in every ATX601 `Finding.data`.
+SERIES = ("static_mfu_bound", "exposed_comms_bytes", "padding_waste_fraction")
+
+# static_mfu_bound may drop (worsen) by at most this relative fraction.
+MFU_REL_TOL = 0.02
+# exposed_comms_bytes may grow by at most this relative fraction + floor
+# (the floor keeps a 0 -> 4-byte wobble from failing the lane).
+BYTES_REL_TOL = 0.02
+BYTES_ABS_TOL = 1024
+# padding_waste_fraction may grow by at most this absolute amount.
+FRAC_ABS_TOL = 0.01
+
+
+def extract_series(report: Any) -> dict[str, float] | None:
+    """The budget series from a Report's ATX601 finding, or None when the
+    scenario produced no roofline (build failed, or no compiled step)."""
+    for f in getattr(report, "findings", []):
+        if f.rule_id == "ATX601" and f.data:
+            return {k: float(f.data[k]) for k in SERIES if k in f.data}
+    return None
+
+
+def load_budgets(path: str) -> dict[str, dict[str, float]]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    return doc.get("scenarios", doc)
+
+
+def write_budgets(path: str, scenarios: dict[str, dict[str, float]]) -> None:
+    doc = {
+        "_comment": (
+            "Static perf budgets ratcheted by `make lint-perf` "
+            "(atx lint perf --budgets perf/budgets.json). Regenerate with "
+            "--write-budgets only when a regression is understood and "
+            "accepted, or to bank an improvement. docs/performance.md."
+        ),
+        "scenarios": {
+            name: {k: scenarios[name][k] for k in SERIES if k in scenarios[name]}
+            for name in sorted(scenarios)
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def check_budgets(
+    budgets: dict[str, dict[str, float]],
+    measured: dict[str, dict[str, float] | None],
+) -> list[str]:
+    """Violation messages (empty = ratchet holds). A budgeted scenario
+    that RAN but produced no roofline is a violation (its step stopped
+    compiling); one that wasn't part of this run is skipped, and
+    unbudgeted scenarios pass (they get banked by the next
+    --write-budgets)."""
+    problems: list[str] = []
+    for name, budget in sorted(budgets.items()):
+        if name not in measured:
+            continue
+        series = measured[name]
+        if series is None:
+            problems.append(
+                f"{name}: budgeted scenario produced no ATX601 roofline "
+                "(step failed to compile, or the perf rules were filtered)"
+            )
+            continue
+        old = budget.get("static_mfu_bound")
+        new = series.get("static_mfu_bound")
+        if old is not None and new is not None and new < old * (1 - MFU_REL_TOL):
+            problems.append(
+                f"{name}: static_mfu_bound regressed {old:.4f} -> {new:.4f} "
+                f"(tolerance -{100 * MFU_REL_TOL:.0f}%)"
+            )
+        old = budget.get("exposed_comms_bytes")
+        new = series.get("exposed_comms_bytes")
+        if old is not None and new is not None and new > old * (1 + BYTES_REL_TOL) + BYTES_ABS_TOL:
+            problems.append(
+                f"{name}: exposed_comms_bytes regressed {int(old)} -> "
+                f"{int(new)} (tolerance +{100 * BYTES_REL_TOL:.0f}% + "
+                f"{BYTES_ABS_TOL} B)"
+            )
+        old = budget.get("padding_waste_fraction")
+        new = series.get("padding_waste_fraction")
+        if old is not None and new is not None and new > old + FRAC_ABS_TOL:
+            problems.append(
+                f"{name}: padding_waste_fraction regressed {old:.4f} -> "
+                f"{new:.4f} (tolerance +{FRAC_ABS_TOL})"
+            )
+    return problems

@@ -15,6 +15,7 @@ Two failure classes the jaxpr and the compiled HLO expose statically:
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from collections import defaultdict
 from typing import Any, Iterator
@@ -39,11 +40,13 @@ _HLO_DTYPE_BYTES = {
 
 # `%name = f32[16,512]{1,0} all-reduce(...)` — or a tuple result
 # `(f32[8,4]{1,0}, f32[8,4]{1,0}) all-reduce(...)`; async variants lower
-# to `-start`/`-done` pairs (count the start, skip the done).
+# to `-start`/`-done` pairs (byte totals count the start, skip the done;
+# ATX602 matches the pairs up by position to judge overlap).
 _COLLECTIVE_RE = re.compile(
+    r"%(?P<name>[\w.\-]+)\s*"
     r"=\s+(?P<shape>\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
     r"(?P<op>all-gather|all-reduce|reduce-scatter|all-to-all|collective-permute)"
-    r"(?:-start)?\("
+    r"(?P<variant>-start|-done)?\("
 )
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
@@ -63,13 +66,47 @@ def _shape_bytes(text: str) -> int:
     return total
 
 
+@dataclasses.dataclass(frozen=True)
+class CollectiveSite:
+    """One collective instruction located in the HLO text. ``variant`` is
+    "sync", "start", or "done"; ``line`` is the 0-based text line, so the
+    ATX602 overlap rule can measure what sits between a start/done pair."""
+
+    op: str
+    variant: str
+    name: str
+    bytes: int
+    line: int
+
+
+def parse_collectives_detailed(hlo_text: str) -> list[CollectiveSite]:
+    """Every collective site in optimized HLO text, in program order, with
+    async `-start`/`-done` variants distinguished and positioned."""
+    sites = []
+    for line_no, line in enumerate(hlo_text.splitlines()):
+        for m in _COLLECTIVE_RE.finditer(line):
+            variant = (m.group("variant") or "-sync").lstrip("-")
+            sites.append(
+                CollectiveSite(
+                    op=m.group("op"),
+                    variant=variant,
+                    name=m.group("name"),
+                    bytes=_shape_bytes(m.group("shape")),
+                    line=line_no,
+                )
+            )
+    return sites
+
+
 def parse_collectives(hlo_text: str) -> list[tuple[str, int]]:
     """(op, result_bytes) per collective in optimized HLO text. Result
     shapes are per-device (post-partitioning), i.e. what each chip
-    materializes for the op."""
+    materializes for the op. `-done` halves of async pairs are skipped —
+    the `-start` already carried the bytes."""
     return [
-        (m.group("op"), _shape_bytes(m.group("shape")))
-        for m in _COLLECTIVE_RE.finditer(hlo_text)
+        (s.op, s.bytes)
+        for s in parse_collectives_detailed(hlo_text)
+        if s.variant != "done"
     ]
 
 

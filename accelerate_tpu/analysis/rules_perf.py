@@ -1,0 +1,310 @@
+"""ATX6xx — static performance rules over the compiled HLO roofline.
+
+ATX1xx–5xx lint correctness; this family bounds *speed* before anything
+runs. Everything derives from one `analysis/roofline.py` pass over
+`LintContext.compiled_text()` against a chip-generation spec table:
+
+- **ATX601** (info, always) — the roofline table: per-category busy time
+  (MXU / vector / HBM / collective), the static step-time lower bound, the
+  static MFU upper bound, and arithmetic intensity for the top-k ops. The
+  full table — plus the three budget series `perf/budgets.json` ratchets
+  (`static_mfu_bound`, `exposed_comms_bytes`, `padding_waste_fraction`) —
+  rides in `Finding.data` for `--json` consumers.
+- **ATX602** (warning) — exposed collective: an async `-start`/`-done`
+  pair with too little compute scheduled between to hide the wire time.
+- **ATX603** (warning) — tiling waste: a hot dot whose M/N/K dims overrun
+  the native (sublane x 128) tile by a non-multiple, burning MXU FLOPs on
+  padding.
+- **ATX604** (warning) — precision fallback: a hot dot fed through an
+  upcast convert (bf16→f32, or a quantized s8/f8 contraction lowered to a
+  wide dot), running at a fraction of the narrow peak.
+- **ATX605** (warning) — fusion break: an elementwise chain materialized
+  to HBM between two kLoop fusions, adding a full write+read round trip
+  per step.
+
+Thresholds: the `roofline_*` / `exposed_*` / `tiling_*` /
+`precision_hot_fraction` / `fusion_break_bytes` entries in
+`engine.DEFAULT_OPTIONS`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from .engine import LintContext, rule
+from .findings import Finding, Severity
+from .hbm import human_bytes
+from .roofline import (
+    RooflineResult,
+    analyze_hlo,
+    chip_spec_for,
+    find_exposed_collectives,
+    find_fusion_breaks,
+    padded_dot_flops,
+)
+
+# Per-rule cap on emitted findings — the worst offenders tell the story;
+# a 96-layer model doesn't need 96 copies of the same diagnosis.
+_MAX_FINDINGS = 8
+
+
+def _roofline(ctx: LintContext) -> RooflineResult | None:
+    """One shared roofline pass per LintContext (cached on the ctx)."""
+    cached = getattr(ctx, "_roofline_result", None)
+    if cached is not None:
+        return cached
+    hlo = ctx.compiled_text()
+    if hlo is None:
+        return None
+    spec = chip_spec_for(ctx.opt("roofline_chip"))
+    result = analyze_hlo(hlo, spec)
+    ctx._roofline_result = result
+    return result
+
+
+def _exposed(ctx: LintContext):
+    hlo = ctx.compiled_text()
+    if hlo is None:
+        return []
+    return find_exposed_collectives(
+        hlo,
+        chip_spec_for(ctx.opt("roofline_chip")),
+        min_bytes=ctx.opt("exposed_min_bytes"),
+        overlap_fraction=ctx.opt("exposed_overlap_fraction"),
+    )
+
+
+@rule(
+    "ATX601",
+    Severity.INFO,
+    "performance",
+    "static roofline: per-category step-time bound and MFU ceiling",
+    "",
+    needs={"fn"},
+)
+def atx601_roofline(ctx: LintContext) -> Iterator[Finding]:
+    result = _roofline(ctx)
+    if result is None or (result.mxu_flops == 0 and result.hbm_bytes == 0):
+        return
+    chip = result.chip
+    exposed = _exposed(ctx)
+    bound_ms = result.step_time_lower_bound_s * 1e3
+    cats = {row["category"]: row for row in result.category_table()}
+    top_k = int(ctx.opt("roofline_top_k"))
+    yield Finding(
+        "ATX601",
+        Severity.INFO,
+        chip.name,
+        f"static roofline ({chip.name}): step >= {bound_ms:.3f} ms, "
+        f"{result.bound_category}-bound, MFU <= {result.static_mfu_bound:.3f} "
+        f"— mxu {cats['mxu']['time_ms']:.3f} ms "
+        f"({result.mxu_flops / 1e9:.2f} GFLOP), "
+        f"hbm {cats['hbm']['time_ms']:.3f} ms "
+        f"({human_bytes(int(result.hbm_bytes))}), "
+        f"vector {cats['vector']['time_ms']:.3f} ms, "
+        f"collective {cats['collective']['time_ms']:.3f} ms "
+        f"({human_bytes(int(result.ici_bytes))})",
+        "",
+        data={
+            "chip": chip.name,
+            "step_time_lower_bound_ms": bound_ms,
+            "static_mfu_bound": result.static_mfu_bound,
+            "bound_category": result.bound_category,
+            "categories": result.category_table(),
+            "mxu_flops": result.mxu_flops,
+            "hbm_bytes": int(result.hbm_bytes),
+            "ici_bytes": int(result.ici_bytes),
+            "padding_waste_fraction": result.padding_waste_fraction,
+            "exposed_comms_bytes": int(sum(e.bytes for e in exposed)),
+            "top_ops": [
+                {
+                    "name": d.name,
+                    "op_name": d.op_name,
+                    "dtype": d.dtype,
+                    "flops": d.flops,
+                    "bytes": d.bytes,
+                    "intensity_flops_per_byte": d.intensity,
+                    "dims": {"batch": d.batch, "m": d.m, "n": d.n, "k": d.k},
+                    "trip_multiplier": d.mult,
+                }
+                for d in result.top_dots(top_k)
+            ],
+        },
+    )
+
+
+@rule(
+    "ATX602",
+    Severity.WARNING,
+    "performance",
+    "exposed collective: async start/done pair with no compute between",
+    "overlap the collective with independent compute (reorder so the "
+    "consumer comes later, or enable the latency-hiding scheduler); until "
+    "then the wire time lands on the critical path",
+    needs={"fn"},
+)
+def atx602_exposed_collective(ctx: LintContext) -> Iterator[Finding]:
+    exposed = _exposed(ctx)
+    for e in sorted(exposed, key=lambda e: -e.exposed_s)[:_MAX_FINDINGS]:
+        yield Finding(
+            "ATX602",
+            Severity.WARNING,
+            e.start_name,
+            f"{e.op} moves {human_bytes(e.bytes)} "
+            f"(~{e.collective_time_s * 1e3:.3f} ms on the wire) but only "
+            f"~{e.overlap_compute_s * 1e3:.3f} ms of compute is scheduled "
+            f"between its -start and -done — "
+            f"~{e.exposed_s * 1e3:.3f} ms of comms sits on the critical "
+            f"path every step",
+            "",
+            data={
+                "op": e.op,
+                "bytes": e.bytes,
+                "collective_ms": e.collective_time_s * 1e3,
+                "overlap_compute_ms": e.overlap_compute_s * 1e3,
+                "exposed_ms": e.exposed_s * 1e3,
+                "computation": e.comp,
+            },
+        )
+
+
+@rule(
+    "ATX603",
+    Severity.WARNING,
+    "performance",
+    "tiling waste: hot dot dims overrun the native tile by a non-multiple",
+    "pad or pick the dim to a multiple of the native tile (lane 128; "
+    "sublane 8/16/32 for f32/bf16/int8) — e.g. round d_ff or head_dim up; "
+    "the MXU pads silently and burns the difference",
+    needs={"fn"},
+)
+def atx603_tiling_waste(ctx: LintContext) -> Iterator[Finding]:
+    result = _roofline(ctx)
+    if result is None:
+        return
+    chip = result.chip
+    min_frac = ctx.opt("tiling_waste_fraction")
+    min_flops = ctx.opt("tiling_min_waste_flops")
+    hits = []
+    for d in result.dots:
+        padded = padded_dot_flops(d, chip)
+        wasted = padded - d.flops
+        if padded <= 0 or wasted < min_flops:
+            continue
+        frac = wasted / padded
+        if frac < min_frac:
+            continue
+        hits.append((wasted, frac, padded, d))
+    for wasted, frac, padded, d in sorted(hits, key=lambda t: -t[0])[:_MAX_FINDINGS]:
+        sub = chip.native_sublane(d.dtype)
+        offending = [
+            f"{label}={dim} (tile {tile})"
+            for label, dim, tile in (("m", d.m, sub), ("n", d.n, chip.lane),
+                                     ("k", d.k, chip.lane))
+            if dim > tile and dim % tile
+        ]
+        yield Finding(
+            "ATX603",
+            Severity.WARNING,
+            d.op_name or d.name,
+            f"dot [{d.m}x{d.k}]·[{d.k}x{d.n}] ({d.dtype}"
+            f"{', x' + str(d.mult) if d.mult > 1 else ''}) pads "
+            f"{', '.join(offending)} — {100 * frac:.1f}% of its MXU FLOPs "
+            f"({wasted / 1e9:.2f} GFLOP/step) are tile padding",
+            "",
+            data={
+                "name": d.name,
+                "op_name": d.op_name,
+                "dtype": d.dtype,
+                "dims": {"batch": d.batch, "m": d.m, "n": d.n, "k": d.k},
+                "tiles": {"sublane": sub, "lane": chip.lane},
+                "flops": d.flops,
+                "padded_flops": padded,
+                "waste_fraction": frac,
+                "wasted_flops": wasted,
+            },
+        )
+
+
+@rule(
+    "ATX604",
+    Severity.WARNING,
+    "performance",
+    "precision fallback: hot dot upcast to a wider dtype before the MXU",
+    "keep the contraction in the narrow dtype (preferred_element_type for "
+    "the accumulator instead of converting inputs; for int8/fp8, check "
+    "the quantized kernel actually dispatched) — the upcast runs the dot "
+    "at a fraction of the narrow peak and doubles its HBM traffic",
+    needs={"fn"},
+)
+def atx604_precision_fallback(ctx: LintContext) -> Iterator[Finding]:
+    result = _roofline(ctx)
+    if result is None or result.mxu_flops <= 0:
+        return
+    hot = ctx.opt("precision_hot_fraction") * result.mxu_flops
+    hits = [
+        d for d in result.dots if d.upcast_from and d.flops >= max(hot, 1.0)
+    ]
+    for d in sorted(hits, key=lambda d: -d.flops)[:_MAX_FINDINGS]:
+        quantized = d.upcast_from in ("s8", "u8", "s4", "u4") or d.upcast_from.startswith("f8")
+        kind = (
+            "a quantized contraction lowered to a high-precision dot"
+            if quantized
+            else f"an {d.upcast_from}->{d.result_dtype} upcast before the dot"
+        )
+        yield Finding(
+            "ATX604",
+            Severity.WARNING,
+            d.op_name or d.name,
+            f"hot dot ({d.flops / 1e9:.2f} GFLOP/step, "
+            f"{100 * d.flops / result.mxu_flops:.0f}% of MXU work) shows "
+            f"{kind} — it runs at the {d.result_dtype} rate instead of "
+            f"the {d.upcast_from} peak",
+            "",
+            data={
+                "name": d.name,
+                "op_name": d.op_name,
+                "upcast_from": d.upcast_from,
+                "result_dtype": d.result_dtype,
+                "flops": d.flops,
+                "share_of_mxu_flops": d.flops / result.mxu_flops,
+                "quantized_fallback": quantized,
+            },
+        )
+
+
+@rule(
+    "ATX605",
+    Severity.WARNING,
+    "performance",
+    "fusion break: elementwise chain materialized to HBM between fusions",
+    "a single-consumer kLoop->kLoop handoff this size usually means an "
+    "op in the middle blocked fusion (a reshape/transpose, a custom call, "
+    "or an xla_fusion size limit) — restructure so the chain fuses, or "
+    "checkpoint/remat past the barrier",
+    needs={"fn"},
+)
+def atx605_fusion_break(ctx: LintContext) -> Iterator[Finding]:
+    hlo = ctx.compiled_text()
+    if hlo is None:
+        return
+    breaks = find_fusion_breaks(hlo, min_bytes=ctx.opt("fusion_break_bytes"))
+    for b in sorted(breaks, key=lambda b: -b.buffer_bytes)[:_MAX_FINDINGS]:
+        yield Finding(
+            "ATX605",
+            Severity.WARNING,
+            b.producer,
+            f"kLoop fusion {b.producer} materializes "
+            f"{human_bytes(b.buffer_bytes)} to HBM whose only consumer is "
+            f"kLoop fusion {b.consumer} — "
+            f"{human_bytes(b.extra_hbm_bytes)} of avoidable HBM round-trip "
+            f"per step",
+            "",
+            data={
+                "producer": b.producer,
+                "consumer": b.consumer,
+                "buffer_bytes": b.buffer_bytes,
+                "extra_hbm_bytes": b.extra_hbm_bytes,
+                "computation": b.comp,
+            },
+        )

@@ -62,6 +62,32 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "replicated_save, elastic_restore, telemetry, tracing) to the "
         "default set",
     )
+    p.add_argument(
+        "--chip",
+        default=None,
+        metavar="GEN",
+        help="chip generation the ATX6xx roofline rates against (v4, v5e, "
+        "v5p, v6e, cpu; default: auto-detect the local device). The "
+        "lint-perf lane pins v5e so the budget series is TPU-shaped even "
+        "on the CPU container",
+    )
+    p.add_argument(
+        "--budgets",
+        metavar="FILE",
+        default=None,
+        help="ratchet the ATX601 roofline series (static_mfu_bound, "
+        "exposed_comms_bytes, padding_waste_fraction) against this "
+        "committed budgets JSON; any regression past tolerance fails the "
+        "run (the `make lint-perf` gate, docs/performance.md)",
+    )
+    p.add_argument(
+        "--write-budgets",
+        dest="write_budgets",
+        metavar="FILE",
+        default=None,
+        help="write/re-baseline the budgets JSON from this run's ATX601 "
+        "series (one entry per scenario that produced a roofline)",
+    )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
         "--rules", action="store_true", help="list the registered rule catalogue"
@@ -150,6 +176,49 @@ def _scenario_lm_example(**options: Any):
         **options,
     )
     return f"GPT causal LM, {acc!r}", report
+
+
+def _scenario_llama2b(**options: Any):
+    """llama 1.64B train step (the bench.py llama2b phase), linted fully
+    abstractly: the real 24-layer seq-4096 config with remat +
+    adafactor is traced/lowered/compiled with zero parameters
+    materialized — the scenario the ATX601 roofline bounds for real
+    (attention_impl="dot": the pallas flash kernel has no abstract CPU
+    lowering; same dot/collective structure either way)."""
+    import numpy as np
+    import optax
+
+    from .. import analysis
+    from ..models import llama
+
+    acc = _fresh_accelerator(mixed_precision="bf16", max_grad_norm=1.0)
+    config = llama.LlamaConfig(
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=24,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        d_ff=8192,
+        max_seq_len=4096,
+        remat=True,
+        remat_policy="attn_and_outputs",
+        attention_impl="dot",
+        loss_chunk_size=512,
+    )
+    # bench trains batch 2 on one chip; abstractly the batch axis must
+    # divide the 8 simulated devices the lint lanes force.
+    batch = {"input_ids": np.zeros((8, 4096), np.int32)}
+    report = analysis.lint_training(
+        acc,
+        lambda r: llama.init(r, config),
+        optax.adafactor(3e-4),
+        lambda params, b, rng: llama.loss_fn(params, b, config, rng),
+        batch,
+        target="llama2b",
+        **options,
+    )
+    return f"llama 1.64B seq-4096 train step, {acc!r}", report
 
 
 def _scenario_cv_example(**options: Any):
@@ -312,9 +381,14 @@ SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
     "nlp_example": _scenario_nlp_example,
     "lm_example": _scenario_lm_example,
     "cv_example": _scenario_cv_example,
+    "llama2b": _scenario_llama2b,
     "serving": _scenario_serving,
     "kernels": _scenario_kernels,
 }
+
+# `atx lint perf`: the scenario set the ATX6xx budget ratchet covers
+# (`make lint-perf`) — the example train steps plus the bench-scale llama.
+PERF_SCENARIOS = ("nlp_example", "lm_example", "cv_example", "llama2b")
 
 
 # ----------------------------------------------- multi-host (ATX5xx) scenarios
@@ -1069,7 +1143,9 @@ def resolve_targets(
     unmatched: list[str] = []
     for t in targets:
         stem = os.path.splitext(os.path.basename(t.rstrip("/")))[0]
-        if t in known:
+        if t == "perf":
+            names.extend(PERF_SCENARIOS)
+        elif t in known:
             names.append(t)
         elif os.path.isdir(t):
             found = [
@@ -1130,13 +1206,21 @@ def run(args: argparse.Namespace) -> int:
     show = Severity.parse(args.show)
     failed = False
     json_reports = []
+    measured_series: dict[str, Any] = {}
+    scenario_kw: dict[str, Any] = {}
+    if getattr(args, "chip", None):
+        scenario_kw["roofline_chip"] = args.chip
     for name in names:
         if name in MULTIHOST_SCENARIOS:
             desc, report = MULTIHOST_SCENARIOS[name](processes=max(procs, 2))
         elif procs >= 2:
-            desc, report = SCENARIOS[name](processes=procs)
+            desc, report = SCENARIOS[name](processes=procs, **scenario_kw)
         else:
-            desc, report = SCENARIOS[name]()
+            desc, report = SCENARIOS[name](**scenario_kw)
+        if args.budgets or args.write_budgets:
+            from ..analysis import perf_budget
+
+            measured_series[name] = perf_budget.extract_series(report)
         if report.filter(gate):
             failed = True
         if args.json_lines:
@@ -1153,6 +1237,31 @@ def run(args: argparse.Namespace) -> int:
         else:
             print(f"== {report.target or name} — {desc}")
             print(f"   {report.format(show)}".replace("\n", "\n   "))
+    budget_failed = False
+    if args.budgets:
+        from ..analysis import perf_budget
+
+        problems = perf_budget.check_budgets(
+            perf_budget.load_budgets(args.budgets), measured_series
+        )
+        for problem in problems:
+            print(f"lint-perf budget: {problem}", file=sys.stderr)
+        if problems:
+            budget_failed = True
+        else:
+            print(
+                f"lint-perf budget: ratchet holds for "
+                f"{len(perf_budget.load_budgets(args.budgets))} scenario(s)"
+            )
+    if args.write_budgets:
+        from ..analysis import perf_budget
+
+        series = {k: v for k, v in measured_series.items() if v}
+        perf_budget.write_budgets(args.write_budgets, series)
+        print(
+            f"lint-perf budget: wrote {args.write_budgets} "
+            f"({len(series)} scenario(s))"
+        )
     if args.json_lines:
         pass  # JSON-lines streams findings only; exit code carries the gate
     elif args.fmt == "json":
@@ -1161,4 +1270,4 @@ def run(args: argparse.Namespace) -> int:
         print(f"\nlint: findings at/above severity '{gate}' — failing")
     else:
         print(f"\nlint: no findings at/above severity '{gate}'")
-    return 1 if failed else 0
+    return 1 if failed or budget_failed else 0

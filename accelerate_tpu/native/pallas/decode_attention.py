@@ -38,7 +38,13 @@ if pallas_available():
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    from ...ops.flash_attention import _NEG_INF, pick_block, tuned_call_kwargs
+    from ...ops.autotune import cached_pick_block, tuned_call_kwargs
+    from ...ops.flash_attention import _NEG_INF
+
+    def pick_block(dim, candidates=(512, 256, 128, 64, 32, 16, 8)):
+        # Persisted autotune table first (ATX_BLOCK_DECODE_ATTENTION /
+        # $ATX_AUTOTUNE_DIR), divide-exactly heuristic otherwise.
+        return cached_pick_block("decode_attention", dim, candidates)
 else:  # pragma: no cover - environment dependent
     pl = pltpu = None
     _NEG_INF = -1e30

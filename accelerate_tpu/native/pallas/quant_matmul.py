@@ -46,7 +46,12 @@ register_kernel(
 if pallas_available():
     from jax.experimental import pallas as pl
 
-    from ...ops.flash_attention import pick_block, tuned_call_kwargs
+    from ...ops.autotune import cached_pick_block, tuned_call_kwargs
+
+    def pick_block(dim, candidates=(512, 256, 128, 64, 32, 16, 8)):
+        # Persisted autotune table first (ATX_BLOCK_QUANT_MATMUL /
+        # $ATX_AUTOTUNE_DIR), divide-exactly heuristic otherwise.
+        return cached_pick_block("quant_matmul", dim, candidates)
 else:  # pragma: no cover - environment dependent
     pl = None
 

@@ -898,6 +898,14 @@ def flash_attention(
         return dot_product_attention(q, k, v, mask=segment_mask, causal=causal, scale=scale)
     interpret = _interpret_default() if interpret is None else interpret
     if block_size is None:
+        # The persisted autotune table (ops/autotune.py) wins when it has
+        # an entry for this (chip, seq, head_dim, dtype) — or when the
+        # ATX_BLOCK_FLASH_ATTENTION override is set.
+        from .autotune import default_cache
+
+        cached = default_cache().get("flash_attention", (S, h), q.dtype)
+        if cached is not None and cached > 0:
+            block_size = int(cached)
         # Bigger blocks amortize the online-softmax bookkeeping across more
         # MXU work: 1024 measured 1.5x over 512 from S=4096 up on v5e
         # (75.6 vs 50.6 TF/s at 32k; 31 vs 46 ms at 4k); 2048 exceeds VMEM.
@@ -905,10 +913,14 @@ def flash_attention(
         # 1024 here never reaches the resident kernels (which cannot
         # compile it). Guard: only when 1024 pads no more than 512 would
         # (S=4608 runs exact at 512; 1024 would add 11% dead work).
-        if S >= 4096 and _round_up(S, 1024) == _round_up(S, 512):
+        elif S >= 4096 and _round_up(S, 1024) == _round_up(S, 512):
             block_size = 1024
         else:
             block_size = DEFAULT_BLOCK
+        if cached is None:
+            # Bank the heuristic so the table documents what actually ran
+            # (and ATX603 can lint against it).
+            default_cache().put("flash_attention", (S, h), q.dtype, block_size)
     block = min(block_size, _round_up(S, 128) if S < block_size else block_size)
     # Pad S up to a block multiple (e.g. the ubiquitous S-1 from next-token
     # shifting). Padded KV columns sit at positions >= S: under causal they

@@ -165,6 +165,14 @@ def main() -> None:
         _RESULT["train_dispatch_gap_ms"] = round(latest["train_dispatch_gap_ms"], 2)
         _RESULT["train_mfu"] = round(latest["train_mfu"], 4)
         _RESULT["train_compiles"] = int(latest["train_compiles"])
+    try:
+        # Static twin of the measured series (docs/performance.md, "perf
+        # campaign"): the ATX601 roofline over the SAME compiled step, so
+        # `--compare` can tell "the program got worse" (bound moved) from
+        # "the run got slower" (bound unchanged, measured MFU dropped).
+        _RESULT.update(_static_perf_series(step, state, batch))
+    except Exception as e:
+        _RESULT["static_perf_error"] = f"{type(e).__name__}: {e}"[:200]
     _phase_snapshot("train")
     state, batch, metrics = acc.free_memory(state, batch, metrics)
     try:
@@ -205,6 +213,24 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, signal.SIG_DFL)  # past the point of partials
     print(json.dumps(_RESULT))
+
+
+def _static_perf_series(step, state, batch) -> dict:
+    """ATX601's statically-derived series next to the measured ones: lower
+    + compile the already-built train step (no extra steps run) and bound
+    it against the local chip's roofline spec. Emitted per run so
+    `bench.py --compare` ratchets them alongside the measured MFU."""
+    from accelerate_tpu.analysis import roofline
+
+    text = step.lower(state, batch).compile().as_text()
+    spec = roofline.chip_spec_for()
+    res = roofline.analyze_hlo(text, spec)
+    exposed = roofline.find_exposed_collectives(text, spec)
+    return {
+        "train_static_mfu_bound": round(res.static_mfu_bound, 4),
+        "train_exposed_comms_mib": round(sum(e.bytes for e in exposed) / 2**20, 3),
+        "train_padding_waste_frac": round(res.padding_waste_fraction, 4),
+    }
 
 
 def _timed_steps(step, state, batch, steps: int, warmup: int, fetch_latency: float | None = None):
@@ -1695,9 +1721,12 @@ def _bench_bert(on_tpu: bool, fetch_latency: float) -> dict:
 # also matches (e.g. *_mib_s ends with both "_mib_s" and "_s").
 _HIGHER_BETTER = (
     "_mfu", "_tokens_per_sec", "_samples_per_sec", "_per_sec", "_tflops",
-    "_mib_s", "_gib_s", "_speedup", "_hit_rate", "_flops",
+    "_mib_s", "_gib_s", "_speedup", "_hit_rate", "_flops", "_mfu_bound",
 )
-_LOWER_BETTER = ("_ms", "_s", "_secs", "_compiles", "_gib_per_token")
+_LOWER_BETTER = (
+    "_ms", "_s", "_secs", "_compiles", "_gib_per_token", "_comms_mib",
+    "_waste_frac",
+)
 
 
 def _direction(name: str) -> int:
