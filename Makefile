@@ -8,7 +8,7 @@
 # forward parity, HF interop, HLO verification, examples, CLI/multiprocess
 # launches, checkpointing); `pytest tests/ --heavy` is the raw invocation.
 
-.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace lint-graph lint-multihost lint-perf
+.PHONY: test test-heavy test-all smoke-transfer smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace lint-graph lint-multihost lint-perf lint-memory
 
 test:
 	python -m pytest tests/ -q
@@ -61,6 +61,17 @@ lint-graph:
 lint-perf:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m accelerate_tpu.commands.cli lint perf --severity error \
+		--chip v5e --budgets perf/budgets.json
+
+# Static memory lint + budget ratchet (ATX7xx, docs/static_analysis.md):
+# the perf scenarios plus the serving engine get the compiled-HLO HBM
+# timeline (peak live bytes vs the chip's HBM — ATX702 fires on a static
+# OOM) and the serving capacity planner (ATX706), with the peak_hbm_mib /
+# serve_static_max_slots series ratcheted against perf/budgets.json.
+# Rated at v5e so the series are TPU-shaped even on the CPU container.
+lint-memory:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m accelerate_tpu.commands.cli lint memory --severity error \
 		--chip v5e --budgets perf/budgets.json
 
 # Multi-host SPMD-consistency lint (ATX5xx, docs/static_analysis.md): the
@@ -180,5 +191,5 @@ smoke-trace:
 test-heavy:
 	python -m pytest tests/ -q -m heavy
 
-test-all: lint-graph lint-multihost lint-perf smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace
+test-all: lint-graph lint-multihost lint-perf lint-memory smoke-serve smoke-router smoke-resilience smoke-replication smoke-elastic smoke-shrink smoke-kernels smoke-telemetry smoke-chaos smoke-trace
 	python -m pytest tests/ -q --heavy

@@ -23,6 +23,14 @@ emits structured `Finding` records across four rule families:
   and an MFU ceiling before anything runs, plus rules for exposed
   collectives, tile-padding waste, precision-fallback dots, and fusion
   breaks — the series `perf/budgets.json` ratchets (`make lint-perf`);
+- **ATX7xx memory** — a static HBM *timeline* over the same compiled HLO
+  (`analysis/memory.py`): scheduled-liveness sweep with donation credit,
+  while-body residency, and per-category attribution, yielding the peak
+  live bytes and an OOM-ahead-of-time gate vs the chip's HBM, plus rules
+  for live-range waste, at-peak donation misses, and temp blowups; the
+  serving capacity planner (`analysis/capacity.py`) solves max KV
+  slots/paged blocks from the same arithmetic (`make lint-memory`,
+  `atx estimate --serve`);
 - **ATX5xx multi-host consistency** — a simulated-process replay harness
   (`host_trace.replay_host_loop`) runs a host loop once per patched
   `process_index`, records every owned collective's (op, signature, stack)
@@ -52,8 +60,17 @@ from .engine import (
     registered_rules,
     rule,
 )
+from .capacity import (
+    CapacityError,
+    CapacityPlan,
+    capacity_findings,
+    check_engine_capacity,
+    plan_capacity,
+    plan_for_engine,
+)
 from .hbm import HbmBreakdown, human_bytes, state_hbm_per_device, tree_device_bytes
 from .host_trace import HostEvent, HostTraceResult, replay_host_loop
+from .memory import MemoryTimeline, build_timeline
 from .roofline import (
     CHIP_SPECS,
     ChipSpec,
@@ -67,6 +84,7 @@ from .roofline import (
 # Importing the rule modules registers their rules.
 from . import rules_collectives  # noqa: F401  (ATX4xx)
 from . import rules_donation  # noqa: F401  (ATX2xx)
+from . import rules_memory  # noqa: F401  (ATX7xx)
 from . import rules_multihost  # noqa: F401  (ATX5xx)
 from . import rules_perf  # noqa: F401  (ATX6xx)
 from . import rules_recompile  # noqa: F401  (ATX3xx)
@@ -74,15 +92,23 @@ from . import rules_sharding  # noqa: F401  (ATX1xx)
 
 __all__ = [
     "AnalysisWarning",
+    "CapacityError",
+    "CapacityPlan",
     "CHIP_SPECS",
     "ChipSpec",
     "DEFAULT_OPTIONS",
     "Finding",
+    "MemoryTimeline",
     "RooflineResult",
     "analyze_hlo",
+    "build_timeline",
+    "capacity_findings",
+    "check_engine_capacity",
     "chip_spec_for",
     "find_exposed_collectives",
     "find_fusion_breaks",
+    "plan_capacity",
+    "plan_for_engine",
     "HbmBreakdown",
     "HostEvent",
     "HostTraceResult",

@@ -1,0 +1,277 @@
+"""Serving capacity planner: how many KV slots (or paged KV blocks)
+statically fit beside the weights on one chip.
+
+The serving engine's HBM footprint is fully determined before anything
+runs: weights + the slot KV pool (`init_cache_fn(slots, max_len)`) + the
+prefix-cache pool + peak decode activations. Given a `ChipSpec` (or an
+explicit HBM budget) this module solves the only free variable — the slot
+count — ahead of time, so "what occupancy can this chip sustain" and
+"will engine init OOM" are planner arithmetic instead of run-and-see:
+
+- `plan_capacity(...)` — pure arithmetic over byte counts; also answers
+  the paged-KV form (`max_blocks(block_size)`): with rows allocated in
+  ``block_size``-token pages, occupancy is bounded by *tokens*, not
+  slots — the ROADMAP's vLLM-PagedAttention direction.
+- `plan_for_engine(engine)` — reads the byte counts off a constructed
+  `serving.Engine` (weights from ``engine.params``, per-slot bytes from
+  the committed pool, prefix pool as overhead).
+- `capacity_findings(...)` — the planner as ATX706 findings for the
+  `atx lint serving` scenario (ERROR when the configured engine cannot
+  fit, INFO otherwise; `serve_static_max_slots` rides in `Finding.data`
+  for the `perf/budgets.json` ratchet). ATX706 is emitted by the serving
+  scenario in `commands/lint.py` — not rule-registered, because it needs
+  a constructed engine, not a step function.
+- `check_engine_capacity(engine)` — the `Engine.__init__` guard behind
+  ``ATX_SERVE_CAPACITY_CHECK`` (default "warn"; "error" raises the
+  structured `CapacityError` with the max-slots suggestion; "0"/"off"
+  skips). ``ATX_SERVE_CAPACITY_HBM_MIB`` overrides the HBM budget so
+  tests seed an over-capacity config without allocating anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+from .findings import Finding, Severity
+from .hbm import human_bytes
+from .roofline import ChipSpec, chip_spec_for
+
+__all__ = [
+    "CapacityError",
+    "CapacityPlan",
+    "capacity_findings",
+    "check_engine_capacity",
+    "plan_capacity",
+    "plan_for_engine",
+    "tree_bytes",
+]
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total bytes of a pytree of arrays / ShapeDtypeStructs."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 0
+        total += int(math.prod(shape)) * itemsize
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """Static HBM plan for one serving engine on one chip."""
+
+    chip: str
+    hbm_bytes: int            # budget being planned against
+    weights_bytes: int
+    kv_bytes_per_slot: int    # one slot row across all layers, max_len tokens
+    kv_bytes_per_token: int   # one KV position across all layers
+    act_peak_bytes: int       # peak decode activations (0 when unknown)
+    overhead_bytes: int       # prefix-cache pool + other fixed allocations
+    n_slots: int              # the configured slot count being judged
+    max_len: int
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        return self.kv_bytes_per_slot * self.n_slots
+
+    @property
+    def static_total_bytes(self) -> int:
+        """Footprint of the configured engine: weights + slot pool +
+        overhead + peak decode activations."""
+        return (
+            self.weights_bytes + self.kv_pool_bytes + self.overhead_bytes
+            + self.act_peak_bytes
+        )
+
+    @property
+    def free_bytes(self) -> int:
+        """HBM left for KV after everything that is not the slot pool."""
+        return self.hbm_bytes - self.weights_bytes - self.overhead_bytes - self.act_peak_bytes
+
+    @property
+    def max_slots(self) -> int:
+        """Largest slot count that statically fits this chip."""
+        if self.kv_bytes_per_slot <= 0:
+            return 0
+        return max(self.free_bytes // self.kv_bytes_per_slot, 0)
+
+    @property
+    def fits(self) -> bool:
+        return self.static_total_bytes <= self.hbm_bytes
+
+    def max_blocks(self, block_size: int) -> int:
+        """Paged-KV form: max ``block_size``-token pages that fit in the
+        same free bytes — occupancy bounded by tokens, not slots."""
+        block_bytes = self.kv_bytes_per_token * max(block_size, 1)
+        if block_bytes <= 0:
+            return 0
+        return max(self.free_bytes // block_bytes, 0)
+
+    def format(self) -> str:
+        verdict = (
+            f"fits ({human_bytes(self.hbm_bytes - self.static_total_bytes)} headroom)"
+            if self.fits
+            else f"DOES NOT FIT (over by {human_bytes(self.static_total_bytes - self.hbm_bytes)})"
+        )
+        return (
+            f"capacity[{self.chip}]: weights {human_bytes(self.weights_bytes)}"
+            f" + kv {self.n_slots}x{human_bytes(self.kv_bytes_per_slot)}/slot"
+            f" (max_len {self.max_len})"
+            f" + overhead {human_bytes(self.overhead_bytes)}"
+            f" + activations {human_bytes(self.act_peak_bytes)}"
+            f" = {human_bytes(self.static_total_bytes)}"
+            f" of {human_bytes(self.hbm_bytes)} — {verdict};"
+            f" static max slots {self.max_slots}"
+        )
+
+
+class CapacityError(RuntimeError):
+    """Engine config statically cannot fit its chip. Carries the plan
+    (``err.plan``) so callers can read the max-slots suggestion."""
+
+    def __init__(self, plan: CapacityPlan):
+        self.plan = plan
+        super().__init__(
+            f"{plan.format()} — lower slots to <= {plan.max_slots}, shrink "
+            f"max_len, or quantize the KV cache (ATX_SERVE_CAPACITY_CHECK=0 "
+            f"to bypass)"
+        )
+
+
+def plan_capacity(
+    *,
+    chip: "str | ChipSpec | None" = None,
+    hbm_bytes: int | None = None,
+    weights_bytes: int,
+    kv_bytes_per_slot: int,
+    n_slots: int,
+    max_len: int,
+    act_peak_bytes: int = 0,
+    overhead_bytes: int = 0,
+) -> CapacityPlan:
+    """Pure-arithmetic capacity plan. ``hbm_bytes`` overrides the chip's
+    HBM (tests; explicit budgets); ``kv_bytes_per_token`` is derived as
+    per-slot bytes / max_len."""
+    spec = chip if isinstance(chip, ChipSpec) else chip_spec_for(chip)
+    return CapacityPlan(
+        chip=spec.name,
+        hbm_bytes=int(hbm_bytes if hbm_bytes is not None else spec.hbm_bytes),
+        weights_bytes=int(weights_bytes),
+        kv_bytes_per_slot=int(kv_bytes_per_slot),
+        kv_bytes_per_token=int(kv_bytes_per_slot) // max(int(max_len), 1),
+        act_peak_bytes=int(act_peak_bytes),
+        overhead_bytes=int(overhead_bytes),
+        n_slots=int(n_slots),
+        max_len=int(max_len),
+    )
+
+
+def plan_for_engine(
+    engine: Any,
+    *,
+    chip: "str | ChipSpec | None" = None,
+    hbm_bytes: int | None = None,
+    act_peak_bytes: int = 0,
+) -> CapacityPlan:
+    """Plan for a constructed `serving.Engine`: weights from its params,
+    per-slot KV from the committed slot pool, the prefix-cache pool as
+    fixed overhead."""
+    kv_pool = tree_bytes(engine._kv)
+    return plan_capacity(
+        chip=chip,
+        hbm_bytes=hbm_bytes,
+        weights_bytes=tree_bytes(engine.params),
+        kv_bytes_per_slot=kv_pool // max(engine.n_slots, 1),
+        n_slots=engine.n_slots,
+        max_len=engine.max_len,
+        act_peak_bytes=act_peak_bytes,
+        overhead_bytes=tree_bytes(engine._pool) if engine._pool is not None else 0,
+    )
+
+
+def capacity_findings(
+    engine: Any,
+    *,
+    chip: "str | ChipSpec | None" = None,
+    hbm_bytes: int | None = None,
+    act_peak_bytes: int = 0,
+    block_size: int = 16,
+) -> list[Finding]:
+    """The planner as ATX706 findings (the `atx lint serving` surface)."""
+    plan = plan_for_engine(
+        engine, chip=chip, hbm_bytes=hbm_bytes, act_peak_bytes=act_peak_bytes
+    )
+    severity = Severity.INFO if plan.fits else Severity.ERROR
+    message = plan.format()
+    if not plan.fits:
+        message += (
+            f" — engine init would OOM on {plan.chip}; lower slots to "
+            f"<= {plan.max_slots} or shrink max_len"
+        )
+    return [
+        Finding(
+            "ATX706",
+            severity,
+            plan.chip,
+            message,
+            "" if plan.fits else (
+                "the slot KV pool is allocated in one piece at engine init "
+                "— size it with the planner (atx estimate --serve) instead "
+                "of discovering the OOM on the pod"
+            ),
+            data={
+                "chip": plan.chip,
+                "hbm_bytes": plan.hbm_bytes,
+                "weights_bytes": plan.weights_bytes,
+                "kv_bytes_per_slot": plan.kv_bytes_per_slot,
+                "kv_bytes_per_token": plan.kv_bytes_per_token,
+                "overhead_bytes": plan.overhead_bytes,
+                "act_peak_bytes": plan.act_peak_bytes,
+                "n_slots": plan.n_slots,
+                "max_len": plan.max_len,
+                "static_total_bytes": plan.static_total_bytes,
+                "fits": plan.fits,
+                "serve_static_max_slots": plan.max_slots,
+                "max_blocks": {
+                    str(block_size): plan.max_blocks(block_size),
+                },
+            },
+        )
+    ]
+
+
+def check_engine_capacity(engine: Any) -> "CapacityPlan | None":
+    """`Engine.__init__` guard. ``ATX_SERVE_CAPACITY_CHECK`` picks the
+    mode: "warn" (default) warns on a statically-unfitting config,
+    "error" raises `CapacityError`, "0"/"off"/"false"/"none" skips.
+    ``ATX_SERVE_CAPACITY_HBM_MIB`` overrides the HBM budget (the local
+    chip's spec otherwise). Returns the plan (None when skipped)."""
+    import warnings
+
+    from ..utils.environment import get_int_from_env, get_str_from_env
+
+    mode = get_str_from_env(("ATX_SERVE_CAPACITY_CHECK",), "warn").strip().lower()
+    if mode in ("0", "off", "false", "none", "no"):
+        return None
+    hbm_mib = get_int_from_env(("ATX_SERVE_CAPACITY_HBM_MIB",), 0)
+    plan = plan_for_engine(
+        engine, hbm_bytes=hbm_mib << 20 if hbm_mib > 0 else None
+    )
+    if not plan.fits:
+        if mode == "error":
+            raise CapacityError(plan)
+        warnings.warn(
+            f"serving engine statically exceeds {plan.chip} HBM: "
+            f"{plan.format()} (set ATX_SERVE_CAPACITY_CHECK=error to fail "
+            f"fast, =0 to silence)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return plan

@@ -77,6 +77,24 @@ DEFAULT_OPTIONS: dict[str, Any] = {
     # ATX605: a fusion break flags when the materialized intermediate is
     # at least this large (one extra HBM write + read per step).
     "fusion_break_bytes": 32 << 20,
+    # ATX7xx memory family (analysis/memory.py). `hbm_capacity_bytes`
+    # overrides the chip's HBM capacity for the ATX702 OOM gate (None:
+    # use `roofline_chip`'s spec) — the seeded-defect tests use it to
+    # model a small chip without allocating anything.
+    "hbm_capacity_bytes": None,
+    # ATX703: a buffer flags when it sits unused for at least this many
+    # scheduled instructions between definition and first use AND holds at
+    # least this many bytes; top_k bounds the report.
+    "liverange_gap_instrs": 100,
+    "liverange_min_bytes": 16 << 20,
+    "liverange_top_k": 4,
+    # ATX704: undonated state live at the peak flags only above this size.
+    "donation_peak_min_bytes": 1 << 20,
+    # ATX705: XLA temp bytes at the peak flag when they exceed this
+    # multiple of the largest single-instruction working set (and the
+    # absolute floor keeps CPU-scale toys quiet).
+    "temp_blowup_factor": 4.0,
+    "temp_blowup_min_bytes": 16 << 20,
 }
 
 
@@ -184,6 +202,7 @@ class LintContext:
         self._jitted = _UNSET
         self._jaxpr = _UNSET
         self._lowered = _UNSET
+        self._compiled = _UNSET
         self._compiled_text = _UNSET
         self._out_shapes = _UNSET
         self._resolved_param_specs = _UNSET
@@ -265,12 +284,13 @@ class LintContext:
         except Exception:
             return None
 
-    def compiled_text(self) -> str | None:
-        """Optimized HLO text (post-GSPMD: real collectives), or None when
+    def compiled_executable(self) -> Any:
+        """The compiled executable (`jax.stages.Compiled`), or None when
         compilation isn't possible here (e.g. the mesh spans more devices
-        than this host has)."""
-        if self._compiled_text is _UNSET:
-            self._compiled_text = None
+        than this host has). Shared by `compiled_text()` and
+        `memory_stats()` so the step compiles exactly once."""
+        if self._compiled is _UNSET:
+            self._compiled = None
             low = self.lowered()
             if low is not None:
                 try:
@@ -280,11 +300,50 @@ class LintContext:
                     with warnings.catch_warnings(record=True) as rec:
                         warnings.simplefilter("always")
                         with self._mesh_ctx():
-                            self._compiled_text = low.compile().as_text()
+                            self._compiled = low.compile()
                     self.lowering_warnings.extend(rec)
                 except Exception as e:
                     self._note("compile", e)
+        return self._compiled
+
+    def compiled_text(self) -> str | None:
+        """Optimized HLO text (post-GSPMD: real collectives), or None when
+        compilation isn't possible here."""
+        if self._compiled_text is _UNSET:
+            self._compiled_text = None
+            exe = self.compiled_executable()
+            if exe is not None:
+                try:
+                    self._compiled_text = exe.as_text()
+                except Exception as e:
+                    self._note("compile", e)
         return self._compiled_text
+
+    def memory_stats(self) -> Any:
+        """`compiled.memory_analysis()` (CompiledMemoryStats: argument /
+        output / temp / alias bytes), or None when unavailable — the
+        ATX7xx cross-check anchor."""
+        exe = self.compiled_executable()
+        if exe is None:
+            return None
+        try:
+            return exe.memory_analysis()
+        except Exception:
+            return None
+
+    def flat_arg_paths(self) -> dict[int, str]:
+        """Flattened-argument index -> pytree path for the non-static args
+        — the entry-parameter order jax compiles, used as the category
+        fallback when the HLO's ``op_name`` metadata is stripped."""
+        out: dict[int, str] = {}
+        i = 0
+        for argnum, arg in enumerate(self.args):
+            if argnum in self.static_argnums:
+                continue
+            for path, _ in _flat_with_paths(arg):
+                out[i] = path
+                i += 1
+        return out
 
     def out_shapes(self) -> Any:
         if self._out_shapes is _UNSET:
@@ -366,6 +425,7 @@ def _run(ctx: LintContext, only: Sequence[str] | None, strict: bool, target: str
     # them, but guard against direct-engine use.
     from . import rules_collectives  # noqa: F401
     from . import rules_donation  # noqa: F401
+    from . import rules_memory  # noqa: F401
     from . import rules_multihost  # noqa: F401
     from . import rules_perf  # noqa: F401
     from . import rules_recompile  # noqa: F401

@@ -1,0 +1,482 @@
+"""Static per-device HBM lifetime analysis over compiled (scheduled) HLO.
+
+The ATX6xx roofline bounds *compute* ahead of time; this module bounds
+*memory* the same way. The optimized HLO `LintContext.compiled_text()`
+resolves is **scheduled** (`is_scheduled=true` in the module header), so
+the entry computation's instruction order is the order XLA's buffer
+assigner allocates against — which makes peak live bytes statically
+computable on the CPU container, with zero buffers materialized:
+
+- every entry instruction defines a buffer of its result bytes; bookkeeping
+  ops (`bitcast` / `tuple` / `get-tuple-element` / `*-done`) alias existing
+  buffers and define nothing;
+- a buffer is live from its defining instruction through its last use;
+  entry **parameters** are caller-owned and live for the whole program —
+  donation shows up as `input_output_alias={ {k}: (p, ...) }` entries in
+  the module header, which let output producers write into the donated
+  parameter's storage instead of allocating fresh bytes (the 2x-state
+  credit ATX201 reasons about);
+- `while` results run in place over their carried operand; the loop
+  **body**'s internal buffers are charged at the while's schedule position
+  (carries stay resident across iterations), computed by recursing the
+  same sweep; **fusion** temporaries stay on-chip and collapse to the
+  fusion's materialized output;
+- every buffer is attributed to a category — params / grads+opt-state /
+  serving KV rows (from the abstract-arg tree path jax embeds in each
+  parameter's ``op_name`` metadata), other inputs, collective scratch,
+  XLA temps (layout/precision copies), or activations.
+
+The result is a `MemoryTimeline`: the full live-bytes series over the
+schedule, the peak, the instruction at the peak, and per-category
+attribution at the peak — cross-checkable against the executable's own
+`compiled.memory_analysis()` totals (`cross_check`). The ATX7xx rules
+(`analysis/rules_memory.py`) and the serving capacity planner
+(`analysis/capacity.py`) consume it.
+
+Model limits (docs/static_analysis.md): liveness is tracked at
+whole-value granularity against the schedule, so in-place reuse the
+buffer assigner finds *between* differently-shaped values is not modeled
+(the static peak is an upper bound over assignable layouts, not a
+bit-exact replay of the assignment), and `conditional` sites are charged
+at their branches' internal peak regardless of which branch runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import defaultdict
+from typing import Any
+
+from .roofline import (
+    HloComputation,
+    _CALLED_RE,
+    collective_base,
+    entry_computation,
+    parse_hlo_module,
+)
+
+__all__ = [
+    "Buffer",
+    "MemoryTimeline",
+    "build_timeline",
+    "classify_param_path",
+    "parse_input_output_aliases",
+]
+
+# Ops that alias an existing buffer instead of defining a new one. A
+# `-done` completes the async op whose `-start` allocated the result, and
+# a `while` runs in place over its carried operand.
+_ALIAS_OPS = frozenset({
+    "bitcast", "get-tuple-element", "tuple", "after-all", "add-dependency",
+    "opt-barrier", "domain", "while",
+})
+# Buffers defined purely to change layout/precision/extent — XLA temps,
+# not model state or activations (a materialized upcast lands here).
+_TEMP_OPS = frozenset({
+    "copy", "convert", "transpose", "reshape", "pad", "broadcast",
+})
+
+_PARAM_NUM_RE = re.compile(r"%?([\w.\-]+)\s*=\s*[^=\n]*?parameter\((\d+)\)")
+_ROOT_RE = re.compile(r"ROOT\s+%?([\w.\-]+)")
+_ALIAS_ENTRY_RE = re.compile(r"\{\s*[0-9,\s]*\}\s*:\s*\((\d+)")
+
+# Tree-path tokens -> category, checked in order: an optimizer moment tree
+# mirrors the param tree ("opt_state.mu['layers_0']['wq']"), so the
+# opt-state check must win over a nested params token, and a KV cache is
+# often nested under neither.
+_KV_TOKENS = frozenset({"kv", "cache", "kv_cache", "k_cache", "v_cache"})
+_OPT_TOKENS = frozenset({
+    "opt_state", "opt", "mu", "nu", "grads", "grad", "loss_scale",
+    "momentum", "v_row", "v_col", "exp_avg", "exp_avg_sq",
+})
+_PARAM_TOKENS = frozenset({"params", "param", "weights"})
+
+
+def classify_param_path(path: str) -> str:
+    """Category for an entry parameter from its abstract-arg tree path (the
+    ``op_name`` metadata jax stamps on entry parameters — e.g.
+    ``state['params']['wq']``, with quotes escaped in the HLO text)."""
+    tokens = set(re.split(r"[^a-z0-9_]+", path.lower())) - {""}
+    if tokens & _KV_TOKENS:
+        return "kv"
+    if tokens & _OPT_TOKENS:
+        return "opt_state"
+    if tokens & _PARAM_TOKENS:
+        return "params"
+    return "inputs"
+
+
+def parse_input_output_aliases(text: str) -> list[int]:
+    """Donated parameter numbers from the module header's
+    ``input_output_alias={ {k}: (p, {}, may-alias), ... }`` — the compiled
+    form `donate_argnums` resolves to."""
+    marker = "input_output_alias={"
+    start = text.find(marker)
+    if start < 0:
+        return []
+    i, depth = start + len(marker) - 1, 0
+    while i < len(text):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    body = text[start + len(marker) : i]
+    return [int(m.group(1)) for m in _ALIAS_ENTRY_RE.finditer(body)]
+
+
+@dataclasses.dataclass
+class Buffer:
+    """One statically-tracked HBM buffer in the entry schedule."""
+
+    name: str
+    op: str
+    bytes: int           # fresh bytes this buffer allocates (reduced when
+                         # it writes into donated parameter storage)
+    category: str        # params / opt_state / kv / inputs / activations /
+                         # collective / xla_temp
+    def_index: int
+    first_use: int       # -1 when never read
+    last_use: int        # schedule index; == n_instructions for buffers
+                         # that survive the program (params, outputs)
+    dtype: str = ""
+    shape: tuple[int, ...] = ()
+    param_number: int = -1
+    path: str = ""       # abstract-arg tree path (parameters only)
+    donated: bool = False
+    is_output: bool = False
+
+
+@dataclasses.dataclass
+class MemoryTimeline:
+    """Static per-device HBM timeline of one compiled module."""
+
+    peak_bytes: int
+    peak_index: int
+    peak_instr: str            # "name (op)" of the instruction at the peak
+    categories_at_peak: dict[str, int]
+    series: list[tuple[int, int]]   # (schedule index, live bytes)
+    buffers: list[Buffer]
+    n_instructions: int
+    argument_bytes: int        # all entry parameters, donated included
+    output_bytes: int          # full output tuple, aliased elements included
+    alias_bytes: int           # donated-parameter bytes credited back
+    max_working_set_bytes: int  # largest single-instruction operands+output
+    output_signatures: list[tuple[str, tuple[int, ...]]]
+
+    @property
+    def peak_mib(self) -> float:
+        return self.peak_bytes / 2**20
+
+    def live_at_peak(self) -> list[Buffer]:
+        i = self.peak_index
+        return [b for b in self.buffers if b.def_index <= i <= b.last_use]
+
+    def downsampled_series(self, max_points: int = 256) -> list[list[int]]:
+        """The timeline as ``[index, live_bytes]`` pairs, thinned to at most
+        ~``max_points`` (the peak always kept) — the `--json` payload."""
+        if len(self.series) <= max_points:
+            return [[i, b] for i, b in self.series]
+        stride = -(-len(self.series) // max_points)
+        return [
+            [i, b] for j, (i, b) in enumerate(self.series)
+            if j % stride == 0 or i == self.peak_index
+        ]
+
+    def cross_check(self, stats: Any) -> dict[str, float]:
+        """Relative disagreement vs the executable's own
+        `compiled.memory_analysis()` (CompiledMemoryStats) on the totals
+        both sides define. The executable reports argument bytes over ALL
+        parameters (donated included), output bytes over the FULL output
+        tuple (aliased elements included, plus a pointer-table overhead of
+        a few words), and alias bytes as the donated-parameter total — the
+        same conventions used here. Keys absent when a stat is unreported
+        (zero)."""
+        out: dict[str, float] = {}
+        for key, ours, attr in (
+            ("argument_rel_err", self.argument_bytes, "argument_size_in_bytes"),
+            ("output_rel_err", self.output_bytes, "output_size_in_bytes"),
+            ("alias_rel_err", self.alias_bytes, "alias_size_in_bytes"),
+        ):
+            theirs = int(getattr(stats, attr, 0) or 0)
+            if theirs > 0:
+                out[key] = abs(ours - theirs) / theirs
+        return out
+
+
+def _alias_roots(comp: HloComputation) -> dict[str, frozenset[str]]:
+    """name -> defining-buffer names, resolved through bookkeeping ops."""
+    memo: dict[str, frozenset[str]] = {}
+
+    def roots(name: str) -> frozenset[str]:
+        cached = memo.get(name)
+        if cached is not None:
+            return cached
+        memo[name] = frozenset()  # cycle guard
+        instr = comp.by_name.get(name)
+        if instr is None:
+            result = frozenset()
+        elif instr.op in _ALIAS_OPS or instr.op.endswith("-done"):
+            merged: frozenset[str] = frozenset()
+            for _, _, op_name in instr.operands:
+                merged |= roots(op_name)
+            result = merged or frozenset({name})
+        else:
+            result = frozenset({name})
+        memo[name] = result
+        return result
+
+    for instr in comp.instrs:
+        roots(instr.name)
+    return memo
+
+
+def _control_flow_sites(instr: Any) -> list[str]:
+    """Called computations whose internal buffers stay resident while the
+    op runs. Fusion temps collapse on-chip; loop conditions and scalar
+    reduce/collective regions are negligible."""
+    if instr.op == "fusion":
+        return []
+    sites = []
+    for m in _CALLED_RE.finditer(instr.attrs):
+        kind, target = m.group("kind"), m.group(2).strip("%{} ")
+        if kind in ("body", "true_computation", "false_computation",
+                    "branch_computations") or (
+            kind == "calls" and instr.op == "call"
+        ):
+            sites.append(target)
+    return sites
+
+
+def _categorize(instr: Any) -> str:
+    if collective_base(instr.op) or instr.op.endswith("-start"):
+        return "collective"
+    if instr.op in _TEMP_OPS:
+        return "xla_temp"
+    return "activations"
+
+
+def _internal_peak(
+    comps: dict[str, HloComputation],
+    comp_name: str,
+    memo: dict[str, int],
+    visiting: set[str],
+) -> int:
+    """Peak of the buffers a called computation (while body / call /
+    conditional branch) holds internally, charged at the call site's
+    schedule position. Its parameters alias the carried operands already
+    counted at the site (0 fresh bytes); buffers feeding its root stay
+    live to the end of the body — the across-iterations residency."""
+    if comp_name in memo:
+        return memo[comp_name]
+    if comp_name in visiting:
+        return 0
+    comp = comps.get(comp_name)
+    if comp is None or not comp.instrs:
+        return 0
+    visiting.add(comp_name)
+
+    roots_map = _alias_roots(comp)
+    uses: dict[str, list[int]] = defaultdict(list)
+    for instr in comp.instrs:
+        for _, _, op_name in instr.operands:
+            for root in roots_map.get(op_name, ()):
+                uses[root].append(instr.index)
+    n = len(comp.instrs)
+    output_roots = roots_map.get(comp.instrs[-1].name, frozenset())
+
+    delta = [0] * (n + 2)
+    extra_at: dict[int, int] = {}
+    for instr in comp.instrs:
+        for target in _control_flow_sites(instr):
+            extra_at[instr.index] = extra_at.get(instr.index, 0) + _internal_peak(
+                comps, target, memo, visiting
+            )
+        if (
+            instr.op in _ALIAS_OPS
+            or instr.op.endswith("-done")
+            or instr.op == "parameter"
+        ):
+            continue
+        last = n if instr.name in output_roots else max(
+            uses.get(instr.name, []), default=instr.index
+        )
+        delta[instr.index] += instr.out_bytes
+        delta[min(last, n) + 1] -= instr.out_bytes
+
+    live, peak = 0, 0
+    for i in range(n):
+        live += delta[i]
+        peak = max(peak, live + extra_at.get(i, 0))
+    visiting.discard(comp_name)
+    memo[comp_name] = peak
+    return peak
+
+
+def build_timeline(
+    text: str,
+    *,
+    param_paths: dict[int, str] | None = None,
+) -> MemoryTimeline | None:
+    """Build the static HBM timeline for one compiled module's entry
+    computation. ``param_paths`` maps entry parameter numbers to
+    abstract-arg tree paths — the fallback when the HLO's ``op_name``
+    metadata was stripped. None when the text has no entry computation."""
+    comps = parse_hlo_module(text)
+    entry = entry_computation(comps)
+    if entry is None or not entry.instrs:
+        return None
+    n = len(entry.instrs)
+    donated = frozenset(parse_input_output_aliases(text))
+    # Instruction names are module-unique: keep param numbers only for
+    # names that are entry parameters (nested computations number their
+    # own parameters from 0 too).
+    param_numbers = {
+        name: int(num)
+        for name, num in _PARAM_NUM_RE.findall(text)
+        if name in entry.by_name and entry.by_name[name].op == "parameter"
+    }
+
+    roots_map = _alias_roots(entry)
+    uses: dict[str, list[int]] = defaultdict(list)
+    for instr in entry.instrs:
+        for _, _, op_name in instr.operands:
+            for root in roots_map.get(op_name, ()):
+                uses[root].append(instr.index)
+
+    root_name = next(
+        (r for r in _ROOT_RE.findall(text) if r in entry.by_name),
+        entry.instrs[-1].name,
+    )
+    root_instr = entry.by_name[root_name]
+    output_roots = (
+        roots_map.get(root_name, frozenset())
+        if root_instr.op != "parameter"
+        else frozenset({root_name})
+    )
+
+    buffers: list[Buffer] = []
+    param_bytes: dict[int, int] = {}
+    for instr in entry.instrs:
+        if instr.op in _ALIAS_OPS or instr.op.endswith("-done"):
+            continue
+        use_list = uses.get(instr.name, [])
+        if instr.op == "parameter":
+            num = param_numbers.get(instr.name, -1)
+            path = instr.op_name or (param_paths or {}).get(num, "")
+            buf = Buffer(
+                name=instr.name,
+                op=instr.op,
+                bytes=instr.out_bytes,
+                category=classify_param_path(path) if path else "inputs",
+                def_index=0,
+                first_use=min(use_list, default=-1),
+                last_use=n,  # caller-owned: live for the whole program
+                dtype=instr.dtype,
+                shape=tuple(instr.shape),
+                param_number=num,
+                path=path,
+                donated=num in donated,
+                is_output=instr.name in output_roots,
+            )
+            if num >= 0:
+                param_bytes[num] = instr.out_bytes
+        else:
+            is_out = instr.name in output_roots
+            last = n if is_out else max(use_list, default=instr.index)
+            buf = Buffer(
+                name=instr.name,
+                op=instr.op,
+                bytes=instr.out_bytes,
+                category=_categorize(instr),
+                def_index=instr.index,
+                first_use=min(use_list, default=-1),
+                last_use=last,
+                dtype=instr.dtype,
+                shape=tuple(instr.shape),
+                is_output=is_out,
+            )
+        buffers.append(buf)
+
+    # Donation credit: producers of aliased output elements write into the
+    # donated parameters' storage — their fresh bytes shrink by the donated
+    # total. Which producer lands in which tuple element is immaterial for
+    # the timeline totals, so the credit drains largest-producer-first.
+    alias_bytes = sum(param_bytes.get(p, 0) for p in donated)
+    credit = alias_bytes
+    for buf in sorted(
+        (b for b in buffers if b.is_output and b.param_number < 0),
+        key=lambda b: -b.bytes,
+    ):
+        if credit <= 0:
+            break
+        taken = min(buf.bytes, credit)
+        buf.bytes -= taken
+        credit -= taken
+
+    # Callee residency at control-flow sites (while bodies, calls).
+    memo: dict[str, int] = {}
+    extra_at: dict[int, int] = {}
+    for instr in entry.instrs:
+        for target in _control_flow_sites(instr):
+            extra_at[instr.index] = extra_at.get(instr.index, 0) + _internal_peak(
+                comps, target, memo, {entry.name}
+            )
+
+    delta = [0] * (n + 2)
+    for buf in buffers:
+        delta[min(buf.def_index, n)] += buf.bytes
+        delta[min(buf.last_use, n) + 1] -= buf.bytes
+    series: list[tuple[int, int]] = []
+    live, peak, peak_index = 0, -1, 0
+    for i in range(n):
+        live += delta[i]
+        total = live + extra_at.get(i, 0)
+        series.append((i, total))
+        if total > peak:
+            peak, peak_index = total, i
+
+    peak_i = entry.instrs[peak_index]
+    cats: dict[str, int] = defaultdict(int)
+    for b in buffers:
+        if b.def_index <= peak_index <= b.last_use and b.bytes:
+            cats[b.category] += b.bytes
+    if extra_at.get(peak_index, 0):
+        cats["activations"] += extra_at[peak_index]
+
+    out_sigs: list[tuple[str, tuple[int, ...]]] = []
+    if root_instr.op == "tuple":
+        for dt, shape, name in root_instr.operands:
+            src = entry.by_name.get(name)
+            if src is not None and not dt:
+                dt, shape = src.dtype, src.shape
+            out_sigs.append((dt, tuple(shape)))
+    else:
+        out_sigs.append((root_instr.dtype, tuple(root_instr.shape)))
+
+    max_ws = max(
+        (
+            i.operand_bytes + i.out_bytes
+            for i in entry.instrs
+            if i.op not in _ALIAS_OPS and i.op != "parameter"
+        ),
+        default=0,
+    )
+
+    return MemoryTimeline(
+        peak_bytes=max(peak, 0),
+        peak_index=peak_index,
+        peak_instr=f"{peak_i.name} ({peak_i.op})",
+        categories_at_peak=dict(cats),
+        series=series,
+        buffers=buffers,
+        n_instructions=n,
+        argument_bytes=sum(param_bytes.values()),
+        output_bytes=root_instr.out_bytes,
+        alias_bytes=alias_bytes,
+        max_working_set_bytes=max_ws,
+        output_signatures=out_sigs,
+    )

@@ -1,12 +1,14 @@
-"""Ratcheting perf budgets over the ATX601 static-roofline series.
+"""Ratcheting perf budgets over the ATX601/ATX701/ATX706 static series.
 
-`perf/budgets.json` commits three statically-derived numbers per lint
-scenario — the MFU ceiling, the exposed-collective bytes, and the
-tile-padding waste fraction — and `atx lint perf --budgets perf/budgets.json`
-(the `make lint-perf` lane) fails when any of them regresses past
-tolerance: the static twin of `bench.py --compare`. A PR that improves a
-series re-baselines it with `--write-budgets`, so the budget only moves in
-the good direction deliberately — a ratchet.
+`perf/budgets.json` commits statically-derived numbers per lint scenario —
+the MFU ceiling, the exposed-collective bytes, and the tile-padding waste
+fraction from the ATX601 roofline, the peak-HBM figure from the ATX701
+memory timeline, and the serving planner's static max-slots from ATX706 —
+and `atx lint perf|memory --budgets perf/budgets.json` (the `make
+lint-perf` / `make lint-memory` lanes) fails when any of them regresses
+past tolerance: the static twin of `bench.py --compare`. A PR that
+improves a series re-baselines it with `--write-budgets`, so the budget
+only moves in the good direction deliberately — a ratchet.
 
 Tolerances are small-but-nonzero because the series, while deterministic
 for a given jax/XLA version, shift when the compiler changes fusion or
@@ -20,8 +22,15 @@ import json
 import os
 from typing import Any
 
-#: The budgeted series, as emitted in every ATX601 `Finding.data`.
-SERIES = ("static_mfu_bound", "exposed_comms_bytes", "padding_waste_fraction")
+#: The budgeted series: the first three from every ATX601 `Finding.data`,
+#: `peak_hbm_mib` from ATX701, `serve_static_max_slots` from ATX706.
+SERIES = (
+    "static_mfu_bound",
+    "exposed_comms_bytes",
+    "padding_waste_fraction",
+    "peak_hbm_mib",
+    "serve_static_max_slots",
+)
 
 # static_mfu_bound may drop (worsen) by at most this relative fraction.
 MFU_REL_TOL = 0.02
@@ -31,15 +40,34 @@ BYTES_REL_TOL = 0.02
 BYTES_ABS_TOL = 1024
 # padding_waste_fraction may grow by at most this absolute amount.
 FRAC_ABS_TOL = 0.01
+# peak_hbm_mib may grow by at most this relative fraction + 1 MiB.
+HBM_REL_TOL = 0.02
+HBM_ABS_TOL_MIB = 1.0
+# serve_static_max_slots may shrink by at most max(1, 2% of the budget).
+SLOTS_REL_TOL = 0.02
+
+#: Which rule's Finding.data carries each series.
+_SERIES_RULES = {
+    "static_mfu_bound": "ATX601",
+    "exposed_comms_bytes": "ATX601",
+    "padding_waste_fraction": "ATX601",
+    "peak_hbm_mib": "ATX701",
+    "serve_static_max_slots": "ATX706",
+}
 
 
 def extract_series(report: Any) -> dict[str, float] | None:
-    """The budget series from a Report's ATX601 finding, or None when the
-    scenario produced no roofline (build failed, or no compiled step)."""
+    """The budget series from a Report's ATX601/ATX701/ATX706 findings, or
+    None when the scenario produced no roofline AND no memory timeline
+    (build failed, or no compiled step)."""
+    out: dict[str, float] = {}
     for f in getattr(report, "findings", []):
-        if f.rule_id == "ATX601" and f.data:
-            return {k: float(f.data[k]) for k in SERIES if k in f.data}
-    return None
+        if f.rule_id not in ("ATX601", "ATX701", "ATX706") or not f.data:
+            continue
+        for key, rule_id in _SERIES_RULES.items():
+            if f.rule_id == rule_id and key in f.data and key not in out:
+                out[key] = float(f.data[key])
+    return out or None
 
 
 def load_budgets(path: str) -> dict[str, dict[str, float]]:
@@ -51,10 +79,11 @@ def load_budgets(path: str) -> dict[str, dict[str, float]]:
 def write_budgets(path: str, scenarios: dict[str, dict[str, float]]) -> None:
     doc = {
         "_comment": (
-            "Static perf budgets ratcheted by `make lint-perf` "
-            "(atx lint perf --budgets perf/budgets.json). Regenerate with "
-            "--write-budgets only when a regression is understood and "
-            "accepted, or to bank an improvement. docs/performance.md."
+            "Static perf/memory budgets ratcheted by `make lint-perf` and "
+            "`make lint-memory` (atx lint perf|memory --budgets "
+            "perf/budgets.json). Regenerate with --write-budgets only when "
+            "a regression is understood and accepted, or to bank an "
+            "improvement. docs/performance.md, docs/static_analysis.md."
         ),
         "scenarios": {
             name: {k: scenarios[name][k] for k in SERIES if k in scenarios[name]}
@@ -73,9 +102,9 @@ def check_budgets(
     measured: dict[str, dict[str, float] | None],
 ) -> list[str]:
     """Violation messages (empty = ratchet holds). A budgeted scenario
-    that RAN but produced no roofline is a violation (its step stopped
+    that RAN but produced no series is a violation (its step stopped
     compiling); one that wasn't part of this run is skipped, and
-    unbudgeted scenarios pass (they get banked by the next
+    unbudgeted scenarios/series pass (they get banked by the next
     --write-budgets)."""
     problems: list[str] = []
     for name, budget in sorted(budgets.items()):
@@ -84,8 +113,9 @@ def check_budgets(
         series = measured[name]
         if series is None:
             problems.append(
-                f"{name}: budgeted scenario produced no ATX601 roofline "
-                "(step failed to compile, or the perf rules were filtered)"
+                f"{name}: budgeted scenario produced no ATX601/ATX701 "
+                "series (step failed to compile, or the rules were "
+                "filtered)"
             )
             continue
         old = budget.get("static_mfu_bound")
@@ -110,4 +140,25 @@ def check_budgets(
                 f"{name}: padding_waste_fraction regressed {old:.4f} -> "
                 f"{new:.4f} (tolerance +{FRAC_ABS_TOL})"
             )
+        old = budget.get("peak_hbm_mib")
+        new = series.get("peak_hbm_mib")
+        if (
+            old is not None and new is not None
+            and new > old * (1 + HBM_REL_TOL) + HBM_ABS_TOL_MIB
+        ):
+            problems.append(
+                f"{name}: peak_hbm_mib regressed {old:.1f} -> {new:.1f} "
+                f"(tolerance +{100 * HBM_REL_TOL:.0f}% + "
+                f"{HBM_ABS_TOL_MIB:.0f} MiB)"
+            )
+        old = budget.get("serve_static_max_slots")
+        new = series.get("serve_static_max_slots")
+        if old is not None and new is not None:
+            floor = old - max(1.0, old * SLOTS_REL_TOL)
+            if new < floor:
+                problems.append(
+                    f"{name}: serve_static_max_slots regressed {int(old)} "
+                    f"-> {int(new)} (tolerance -max(1, "
+                    f"{100 * SLOTS_REL_TOL:.0f}%))"
+                )
     return problems

@@ -68,6 +68,7 @@ class ChipSpec:
     ici_bytes_per_sec: float
     vmem_bytes: int
     vector_flops_per_sec: float
+    hbm_bytes: int = 16 << 30   # per-chip HBM capacity (ATX7xx memory lint)
     sublane: int = 8
     lane: int = 128
 
@@ -88,27 +89,29 @@ CHIP_SPECS: dict[str, ChipSpec] = {
     "v4": ChipSpec(
         "v4",
         {"bf16": 275e12, "f32": 68.75e12, "int8": 275e12, "f8": 275e12},
-        1228e9, 300e9, 128 << 20, 4.3e12,
+        1228e9, 300e9, 128 << 20, 4.3e12, hbm_bytes=32 << 30,
     ),
     "v5e": ChipSpec(
         "v5e",
         {"bf16": 197e12, "f32": 49.25e12, "int8": 394e12, "f8": 394e12},
-        819e9, 200e9, 128 << 20, 3.1e12,
+        819e9, 200e9, 128 << 20, 3.1e12, hbm_bytes=16 << 30,
     ),
     "v5p": ChipSpec(
         "v5p",
         {"bf16": 459e12, "f32": 114.75e12, "int8": 918e12, "f8": 918e12},
-        2765e9, 600e9, 128 << 20, 7.2e12,
+        2765e9, 600e9, 128 << 20, 7.2e12, hbm_bytes=95 << 30,
     ),
     "v6e": ChipSpec(
         "v6e",
         {"bf16": 918e12, "f32": 229.5e12, "int8": 1836e12, "f8": 1836e12},
-        1640e9, 448e9, 128 << 20, 14.3e12,
+        1640e9, 448e9, 128 << 20, 14.3e12, hbm_bytes=32 << 30,
     ),
     "cpu": ChipSpec(
         "cpu",
         {"bf16": 50e9, "f32": 50e9, "int8": 100e9, "f8": 100e9},
-        20e9, 10e9, 32 << 20, 5e9,
+        # Host-RAM stand-in sized like a v5e so capacity findings stay
+        # TPU-shaped on the CPU container.
+        20e9, 10e9, 32 << 20, 5e9, hbm_bytes=16 << 30,
     ),
 }
 

@@ -1,0 +1,330 @@
+"""ATX7xx — static memory rules over the compiled-HLO HBM timeline.
+
+ATX6xx bounds *speed* ahead of time; this family bounds *memory*.
+Everything derives from one `analysis/memory.py` liveness sweep over
+`LintContext.compiled_text()` (the scheduled, post-GSPMD module), anchored
+against the executable's own `compiled.memory_analysis()` totals:
+
+- **ATX701** (info, always) — the peak-HBM report: static peak live
+  bytes, the instruction at the peak, per-category attribution (params /
+  opt state / KV / inputs / activations / collective scratch / XLA
+  temps), and headroom vs the `--chip` ChipSpec's HBM. The full timeline
+  series plus the two budget series `perf/budgets.json` ratchets
+  (`peak_hbm_mib`, and `serve_static_max_slots` from the capacity
+  planner) ride in `Finding.data` for `--json` consumers.
+- **ATX702** (error) — OOM ahead of time: the static peak exceeds the
+  chip's HBM. Fails `lint="error"` before any buffer moves.
+- **ATX703** (warning) — live-range waste: a top-K buffer sits unused for
+  ≥N scheduled instructions between definition and first use (remat or
+  reorder it closer to its consumer).
+- **ATX704** (warning) — at-peak donation miss: refines ATX201 by
+  reporting only undonated state actually live *at the peak*, with the
+  bytes donating it would cut from the peak.
+- **ATX705** (warning) — temp blowup: XLA temp buffers (layout/precision
+  copies) at the peak exceeding a multiple of the largest single
+  instruction's working set — the materialized-upcast signature ATX604
+  sees only as compute.
+
+Thresholds: the `hbm_capacity_bytes` / `liverange_*` /
+`donation_peak_min_bytes` / `temp_blowup_*` entries in
+`engine.DEFAULT_OPTIONS`.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterator
+
+from .engine import LintContext, rule
+from .findings import Finding, Severity
+from .hbm import human_bytes
+from .memory import MemoryTimeline, build_timeline
+from .roofline import chip_spec_for
+
+_MAX_FINDINGS = 8
+_UNSET = object()
+
+
+def timeline_for(ctx: LintContext) -> MemoryTimeline | None:
+    """One shared HBM-timeline sweep per LintContext (cached on the ctx).
+    ATX105 (analysis/rules_sharding.py) also reads this to cite the
+    compiled figure next to its first-order arithmetic."""
+    cached = getattr(ctx, "_memory_timeline", _UNSET)
+    if cached is not _UNSET:
+        return cached
+    hlo = ctx.compiled_text()
+    timeline = None
+    if hlo is not None:
+        timeline = build_timeline(hlo, param_paths=ctx.flat_arg_paths())
+    ctx._memory_timeline = timeline
+    return timeline
+
+
+def _capacity_bytes(ctx: LintContext) -> int:
+    override = ctx.opt("hbm_capacity_bytes")
+    if override:
+        return int(override)
+    return chip_spec_for(ctx.opt("roofline_chip")).hbm_bytes
+
+
+@rule(
+    "ATX701",
+    Severity.INFO,
+    "memory",
+    "static HBM timeline: peak live bytes, attribution, chip headroom",
+    "",
+    needs={"fn"},
+)
+def atx701_peak_hbm(ctx: LintContext) -> Iterator[Finding]:
+    t = timeline_for(ctx)
+    if t is None or t.peak_bytes <= 0:
+        return
+    chip = chip_spec_for(ctx.opt("roofline_chip"))
+    capacity = _capacity_bytes(ctx)
+    headroom = 1.0 - t.peak_bytes / capacity
+    cats = ", ".join(
+        f"{k} {human_bytes(v)}"
+        for k, v in sorted(t.categories_at_peak.items(), key=lambda kv: -kv[1])
+        if v
+    )
+    stats = ctx.memory_stats()
+    stats_dict = None
+    cross = {}
+    if stats is not None:
+        stats_dict = {
+            attr: int(getattr(stats, f"{attr}_size_in_bytes", 0) or 0)
+            for attr in ("argument", "output", "temp", "alias")
+        }
+        cross = t.cross_check(stats)
+    yield Finding(
+        "ATX701",
+        Severity.INFO,
+        chip.name,
+        f"static peak HBM {human_bytes(t.peak_bytes)} at "
+        f"{t.peak_instr} [{t.peak_index}/{t.n_instructions}] — {cats} — "
+        f"{100 * headroom:.1f}% headroom vs {chip.name} "
+        f"({human_bytes(capacity)})",
+        "",
+        data={
+            "chip": chip.name,
+            "peak_hbm_bytes": t.peak_bytes,
+            "peak_hbm_mib": t.peak_bytes / 2**20,
+            "hbm_capacity_bytes": capacity,
+            "headroom_fraction": headroom,
+            "peak_index": t.peak_index,
+            "peak_instr": t.peak_instr,
+            "categories_at_peak": dict(t.categories_at_peak),
+            "argument_bytes": t.argument_bytes,
+            "output_bytes": t.output_bytes,
+            "alias_bytes": t.alias_bytes,
+            "n_buffers": len(t.buffers),
+            "n_instructions": t.n_instructions,
+            "memory_analysis": stats_dict,
+            "cross_check": cross,
+            "timeline": t.downsampled_series(),
+        },
+    )
+
+
+@rule(
+    "ATX702",
+    Severity.ERROR,
+    "memory",
+    "OOM ahead of time: static peak HBM exceeds the chip's capacity",
+    "this program cannot fit: shrink the per-device footprint (more model "
+    "parallelism, smaller batch, remat/offload activations, narrower "
+    "optimizer state) before launching — the pod would OOM at this exact "
+    "instruction",
+    needs={"fn"},
+)
+def atx702_oom_ahead_of_time(ctx: LintContext) -> Iterator[Finding]:
+    t = timeline_for(ctx)
+    if t is None:
+        return
+    capacity = _capacity_bytes(ctx)
+    if t.peak_bytes <= capacity:
+        return
+    chip = chip_spec_for(ctx.opt("roofline_chip"))
+    over = t.peak_bytes - capacity
+    cats = ", ".join(
+        f"{k} {human_bytes(v)}"
+        for k, v in sorted(t.categories_at_peak.items(), key=lambda kv: -kv[1])
+        if v
+    )
+    yield Finding(
+        "ATX702",
+        Severity.ERROR,
+        chip.name,
+        f"static peak HBM {human_bytes(t.peak_bytes)} exceeds {chip.name} "
+        f"capacity {human_bytes(capacity)} by {human_bytes(over)} "
+        f"(at {t.peak_instr}, instruction {t.peak_index} of "
+        f"{t.n_instructions}) — {cats}",
+        "",
+        data={
+            "chip": chip.name,
+            "peak_hbm_bytes": t.peak_bytes,
+            "hbm_capacity_bytes": capacity,
+            "over_bytes": over,
+            "peak_instr": t.peak_instr,
+            "categories_at_peak": dict(t.categories_at_peak),
+        },
+    )
+
+
+@rule(
+    "ATX703",
+    Severity.WARNING,
+    "memory",
+    "live-range waste: large buffer idle between definition and first use",
+    "the buffer holds HBM across a region that never reads it — define it "
+    "closer to its consumer, or remat it there (jax.checkpoint / "
+    "jax.remat) so the bytes are free in between",
+    needs={"fn"},
+)
+def atx703_liverange_waste(ctx: LintContext) -> Iterator[Finding]:
+    t = timeline_for(ctx)
+    if t is None:
+        return
+    gap_min = int(ctx.opt("liverange_gap_instrs"))
+    bytes_min = int(ctx.opt("liverange_min_bytes"))
+    top_k = int(ctx.opt("liverange_top_k"))
+    hits = []
+    for b in t.buffers:
+        if b.op == "parameter" or b.bytes < bytes_min or b.first_use < 0:
+            continue
+        gap = b.first_use - b.def_index
+        if gap >= gap_min:
+            hits.append((b.bytes * gap, gap, b))
+    for _, gap, b in sorted(hits, key=lambda h: -h[0])[:top_k]:
+        yield Finding(
+            "ATX703",
+            Severity.WARNING,
+            b.name,
+            f"{b.op} buffer {b.name} ({human_bytes(b.bytes)}) is defined at "
+            f"instruction {b.def_index} but first read at {b.first_use} — "
+            f"idle for {gap} of {t.n_instructions} scheduled instructions "
+            f"while holding its HBM",
+            "",
+            data={
+                "name": b.name,
+                "op": b.op,
+                "bytes": b.bytes,
+                "def_index": b.def_index,
+                "first_use": b.first_use,
+                "last_use": b.last_use,
+                "idle_instructions": gap,
+                "byte_instructions": b.bytes * gap,
+            },
+        )
+
+
+@rule(
+    "ATX704",
+    Severity.WARNING,
+    "memory",
+    "at-peak donation miss: undonated state live at the peak instruction",
+    "donate the argument (donate_argnums, or Accelerator donate=True) — "
+    "the output of matching shape/dtype can recycle its storage, cutting "
+    "exactly these bytes from the static peak",
+    needs={"fn"},
+)
+def atx704_donation_miss_at_peak(ctx: LintContext) -> Iterator[Finding]:
+    t = timeline_for(ctx)
+    if t is None:
+        return
+    bytes_min = int(ctx.opt("donation_peak_min_bytes"))
+    # Count-aware signature match against the output tuple (mirrors
+    # ATX201): each output element can recycle at most one argument.
+    available = Counter(t.output_signatures)
+    peak = t.peak_index
+    hits = []
+    for b in t.buffers:
+        if (
+            b.op != "parameter"
+            or b.donated
+            or b.category not in ("params", "opt_state", "kv")
+            or b.bytes < bytes_min
+            or not (b.def_index <= peak <= b.last_use)
+        ):
+            continue
+        sig = (b.dtype, tuple(b.shape))
+        if available.get(sig, 0) <= 0:
+            continue
+        available[sig] -= 1
+        hits.append(b)
+    for b in sorted(hits, key=lambda b: -b.bytes)[:_MAX_FINDINGS]:
+        where = b.path or f"arg {b.param_number}"
+        yield Finding(
+            "ATX704",
+            Severity.WARNING,
+            where,
+            f"{b.category} argument {where} ({human_bytes(b.bytes)}, "
+            f"{b.dtype}{list(b.shape)}) is live at the peak instruction "
+            f"({t.peak_instr}) without donation while an output of the "
+            f"same shape/dtype exists — donating it cuts the static peak "
+            f"by {human_bytes(b.bytes)}",
+            "",
+            data={
+                "path": b.path,
+                "param_number": b.param_number,
+                "category": b.category,
+                "bytes": b.bytes,
+                "dtype": b.dtype,
+                "shape": list(b.shape),
+                "peak_index": t.peak_index,
+            },
+        )
+
+
+@rule(
+    "ATX705",
+    Severity.WARNING,
+    "memory",
+    "temp blowup: XLA temp buffers at the peak dwarf the working set",
+    "temps this large are usually materialized layout/precision copies "
+    "(bf16->f32 upcasts, transposes feeding an unfused consumer) — keep "
+    "the compute dtype narrow end-to-end and check ATX604/ATX605 for the "
+    "op that forced the copy",
+    needs={"fn"},
+)
+def atx705_temp_blowup(ctx: LintContext) -> Iterator[Finding]:
+    t = timeline_for(ctx)
+    if t is None:
+        return
+    temp_bytes = t.categories_at_peak.get("xla_temp", 0)
+    threshold = max(
+        ctx.opt("temp_blowup_factor") * t.max_working_set_bytes,
+        ctx.opt("temp_blowup_min_bytes"),
+    )
+    if temp_bytes <= threshold:
+        return
+    peak = t.peak_index
+    temps = sorted(
+        (
+            b for b in t.buffers
+            if b.category == "xla_temp" and b.def_index <= peak <= b.last_use
+        ),
+        key=lambda b: -b.bytes,
+    )
+    yield Finding(
+        "ATX705",
+        Severity.WARNING,
+        t.peak_instr,
+        f"XLA temp buffers hold {human_bytes(temp_bytes)} at the peak — "
+        f"{t.max_working_set_bytes and temp_bytes / t.max_working_set_bytes or 0:.1f}x "
+        f"the largest single-instruction working set "
+        f"({human_bytes(t.max_working_set_bytes)}); top temps: "
+        + ", ".join(
+            f"{b.name} ({b.op}, {human_bytes(b.bytes)})" for b in temps[:4]
+        ),
+        "",
+        data={
+            "temp_bytes_at_peak": temp_bytes,
+            "max_working_set_bytes": t.max_working_set_bytes,
+            "threshold_bytes": int(threshold),
+            "top_temps": [
+                {"name": b.name, "op": b.op, "bytes": b.bytes}
+                for b in temps[:_MAX_FINDINGS]
+            ],
+        },
+    )

@@ -189,12 +189,31 @@ def atx105_hbm_accounting(ctx: LintContext) -> Iterator[Finding]:
         )
     except Exception:
         return
+    # Cite the compiled-HLO timeline figure next to the first-order
+    # arithmetic when one is buildable (function-level import: rules_memory
+    # imports the engine, and ATX105 sorts before ATX701 so the shared
+    # cached sweep is triggered here).
+    compiled_note = ""
+    data = None
+    from .rules_memory import timeline_for
+
+    timeline = timeline_for(ctx)
+    if timeline is not None and timeline.peak_bytes > 0:
+        compiled_note = (
+            f" — compiled-HLO static peak {human_bytes(timeline.peak_bytes)}"
+            f" (ATX701 timeline)"
+        )
+        data = {
+            "first_order_total_bytes": breakdown.total,
+            "compiled_peak_hbm_bytes": timeline.peak_bytes,
+        }
     yield Finding(
         "ATX105",
         Severity.INFO,
         "",
-        f"sharded train-state HBM: {breakdown.format()}",
+        f"sharded train-state HBM: {breakdown.format()}{compiled_note}",
         "",
+        data=data,
     )
 
 

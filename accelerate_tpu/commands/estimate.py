@@ -70,6 +70,27 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         help="Run the HBM-budget sharding planner over an N-device mesh "
         "(N = --shards) and print the plan verdict",
     )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="Serving capacity planner (analysis/capacity.py): max KV slots "
+        "and paged KV blocks that statically fit beside the serving weights "
+        "on the chip (--chip or --hbm_gb; --seq_len is the slot max_len)",
+    )
+    p.add_argument(
+        "--slots", type=int, default=8,
+        help="Slot count to judge with --serve (the planner also reports "
+        "the static maximum)",
+    )
+    p.add_argument(
+        "--block-size", type=int, default=16,
+        help="Paged-KV page size in tokens for the --serve max-blocks row",
+    )
+    p.add_argument(
+        "--chip", default=None,
+        help="Chip generation for --serve (v4/v5e/v5p/v6e); its HBM spec "
+        "overrides --hbm_gb",
+    )
     p.set_defaults(func=run)
 
 
@@ -218,7 +239,65 @@ def run(args: argparse.Namespace) -> int:
     if args.plan:
         print()
         print(_plan_summary(args, r))
+    if args.serve:
+        print()
+        print(_serve_summary(args, r))
     return 0
+
+
+def _serve_summary(args: argparse.Namespace, r: dict[str, Any]) -> str:
+    """Serving capacity table: per-token/per-slot KV arithmetic from the
+    family's attention config + the static max-slots / max-paged-blocks
+    solve (docs/serving.md, "Capacity planner")."""
+    from ..analysis.capacity import plan_capacity
+    from ..analysis.roofline import chip_spec_for
+
+    config = r["config"]
+    n_layers = getattr(config, "n_layers", None)
+    heads = getattr(config, "num_kv_heads", None) or getattr(config, "num_heads", None)
+    head_dim = getattr(config, "head_dim", None)
+    if head_dim is None and heads and getattr(config, "d_model", None):
+        head_dim = config.d_model // getattr(config, "num_heads", heads)
+    if not (n_layers and heads and head_dim):
+        raise SystemExit(
+            f"estimate --serve: family {r['family']!r} has no decoder "
+            "KV-cache config (needs n_layers, num_heads/num_kv_heads, "
+            "head_dim) — the planner only applies to decode-serving models"
+        )
+    kv_itemsize = 2 if args.precision in ("bf16", "fp16") else 4
+    # K and V, every layer, every KV head, one position.
+    per_token = n_layers * 2 * heads * head_dim * kv_itemsize
+    max_len = args.seq_len
+    weights = r["n_params"] * (2 if args.precision in ("bf16", "fp16") else 4)
+    if args.chip is not None:
+        spec = chip_spec_for(args.chip)
+        chip, hbm_bytes = spec, None  # chip's HBM spec governs
+    else:
+        chip, hbm_bytes = None, int(args.hbm_gb * 1024**3)
+    plan = plan_capacity(
+        chip=chip,
+        hbm_bytes=hbm_bytes,
+        weights_bytes=weights,
+        kv_bytes_per_slot=per_token * max_len,
+        n_slots=args.slots,
+        max_len=max_len,
+    )
+    bs = max(args.block_size, 1)
+    rows = [
+        (f"serving weights ({args.precision})", _human(weights)),
+        ("KV bytes / token", _human(per_token)),
+        (f"KV bytes / slot (max_len {max_len})", _human(plan.kv_bytes_per_slot)),
+        (f"slot pool ({args.slots} slots)", _human(plan.kv_pool_bytes)),
+        ("static total", _human(plan.static_total_bytes)),
+        ("HBM budget", _human(plan.hbm_bytes)),
+        ("static max slots", str(plan.max_slots)),
+        (f"static max paged blocks ({bs} tok)", str(plan.max_blocks(bs))),
+    ]
+    width = max(len(n) for n, _ in rows)
+    lines = ["Serving capacity plan:"]
+    lines += [f"  {name:<{width}}  {val:>12}" for name, val in rows]
+    lines.append(f"  {plan.format()}")
+    return "\n".join(lines)
 
 
 def _plan_summary(args: argparse.Namespace, r: dict[str, Any]) -> str:

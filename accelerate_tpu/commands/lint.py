@@ -75,18 +75,21 @@ def register(subparsers: argparse._SubParsersAction) -> None:
         "--budgets",
         metavar="FILE",
         default=None,
-        help="ratchet the ATX601 roofline series (static_mfu_bound, "
-        "exposed_comms_bytes, padding_waste_fraction) against this "
-        "committed budgets JSON; any regression past tolerance fails the "
-        "run (the `make lint-perf` gate, docs/performance.md)",
+        help="ratchet the static series against this committed budgets "
+        "JSON: the ATX601 roofline series (static_mfu_bound, "
+        "exposed_comms_bytes, padding_waste_fraction), the ATX701 "
+        "peak_hbm_mib, and the ATX706 serve_static_max_slots; any "
+        "regression past tolerance fails the run (the `make lint-perf` / "
+        "`make lint-memory` gates, docs/performance.md)",
     )
     p.add_argument(
         "--write-budgets",
         dest="write_budgets",
         metavar="FILE",
         default=None,
-        help="write/re-baseline the budgets JSON from this run's ATX601 "
-        "series (one entry per scenario that produced a roofline)",
+        help="write/re-baseline the budgets JSON from this run's "
+        "ATX601/ATX701/ATX706 series (one entry per scenario that "
+        "produced any)",
     )
     p.add_argument("--list", action="store_true", help="list lintable scenarios")
     p.add_argument(
@@ -184,14 +187,25 @@ def _scenario_llama2b(**options: Any):
     adafactor is traced/lowered/compiled with zero parameters
     materialized — the scenario the ATX601 roofline bounds for real
     (attention_impl="dot": the pallas flash kernel has no abstract CPU
-    lowering; same dot/collective structure either way)."""
+    lowering; same dot/collective structure either way). Sharded FSDP
+    over the 8 simulated devices: that is the deployment the v5e-rated
+    lanes judge — a fully-replicated 1.64B fp32 state (~21 GiB static)
+    cannot fit one 16 GiB chip, which the ATX702 OOM-ahead-of-time gate
+    would rightly fail."""
     import numpy as np
     import optax
 
     from .. import analysis
+    from ..parallel.mesh import MeshConfig
+
     from ..models import llama
 
-    acc = _fresh_accelerator(mixed_precision="bf16", max_grad_norm=1.0)
+    acc = _fresh_accelerator(
+        mixed_precision="bf16",
+        max_grad_norm=1.0,
+        mesh_config=MeshConfig(data=1, fsdp=8),
+        strategy="FSDP",
+    )
     config = llama.LlamaConfig(
         vocab_size=32000,
         d_model=2048,
@@ -300,6 +314,26 @@ def _scenario_serving(**options: Any):
             **options,
         )
         findings += report.findings
+        if rep.id == router.replicas[0].id:
+            # ATX706 capacity plan for the fleet's engine shape (replicas
+            # are identical): weights + slot pool + prefix pool vs the
+            # chip, with the decode step's at-peak working bytes from the
+            # ATX701 timeline just computed. Emitted here — not as a
+            # registered rule — because the planner needs a constructed
+            # engine, not a step function.
+            atx701 = next(
+                (f for f in report.findings if f.rule_id == "ATX701"), None
+            )
+            act = 0
+            if atx701 is not None and atx701.data:
+                cats = atx701.data.get("categories_at_peak", {})
+                act = sum(
+                    v for k, v in cats.items()
+                    if k in ("activations", "xla_temp", "collective")
+                )
+            findings += analysis.capacity_findings(
+                engine, chip=options.get("roofline_chip"), act_peak_bytes=act
+            )
         if engine.prefix_cache is not None:
             copy_report = analysis.lint_step(
                 engine.copy_fn_for_bucket(engine.buckets[0]),
@@ -389,6 +423,11 @@ SCENARIOS: dict[str, Callable[..., tuple[str, Any]]] = {
 # `atx lint perf`: the scenario set the ATX6xx budget ratchet covers
 # (`make lint-perf`) — the example train steps plus the bench-scale llama.
 PERF_SCENARIOS = ("nlp_example", "lm_example", "cv_example", "llama2b")
+
+# `atx lint memory`: the ATX7xx HBM-timeline set (`make lint-memory`) —
+# the perf scenarios plus the serving scenario, whose ATX706 capacity
+# plan feeds the serve_static_max_slots budget series.
+MEMORY_SCENARIOS = PERF_SCENARIOS + ("serving",)
 
 
 # ----------------------------------------------- multi-host (ATX5xx) scenarios
@@ -1145,6 +1184,8 @@ def resolve_targets(
         stem = os.path.splitext(os.path.basename(t.rstrip("/")))[0]
         if t == "perf":
             names.extend(PERF_SCENARIOS)
+        elif t == "memory":
+            names.extend(MEMORY_SCENARIOS)
         elif t in known:
             names.append(t)
         elif os.path.isdir(t):
@@ -1245,12 +1286,12 @@ def run(args: argparse.Namespace) -> int:
             perf_budget.load_budgets(args.budgets), measured_series
         )
         for problem in problems:
-            print(f"lint-perf budget: {problem}", file=sys.stderr)
+            print(f"lint budget: {problem}", file=sys.stderr)
         if problems:
             budget_failed = True
         else:
             print(
-                f"lint-perf budget: ratchet holds for "
+                f"lint budget: ratchet holds for "
                 f"{len(perf_budget.load_budgets(args.budgets))} scenario(s)"
             )
     if args.write_budgets:
@@ -1259,7 +1300,7 @@ def run(args: argparse.Namespace) -> int:
         series = {k: v for k, v in measured_series.items() if v}
         perf_budget.write_budgets(args.write_budgets, series)
         print(
-            f"lint-perf budget: wrote {args.write_budgets} "
+            f"lint budget: wrote {args.write_budgets} "
             f"({len(series)} scenario(s))"
         )
     if args.json_lines:

@@ -278,6 +278,20 @@ def run(args: argparse.Namespace) -> int:
         )
     else:
         engine = mk_engine()
+    # Startup capacity line: the static planner verdict for the replica-0
+    # engine (atx estimate --serve gives the full table).
+    import sys as _sys
+
+    from ..analysis.capacity import plan_for_engine
+
+    _cap_engine = router.replicas[0].engine if router is not None else engine
+    try:
+        print(
+            f"[atx serve] {plan_for_engine(_cap_engine).format()}",
+            file=_sys.stderr,
+        )
+    except Exception:
+        pass  # planner is advisory; never block serving on it
     if args.shared_prefix > 0:
         trace = shared_prefix_trace(
             args.requests,

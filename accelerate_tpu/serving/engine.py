@@ -354,6 +354,14 @@ class Engine:
                 )
                 self.prefix_cache = PrefixCache(rows, self.buckets, self.max_len)
 
+        # Static capacity guard (ATX_SERVE_CAPACITY_CHECK, default "warn"):
+        # weights + slot pool + prefix pool are all committed by this point,
+        # so a config that cannot fit the chip is known *now*, not at the
+        # first burst of traffic. docs/serving.md#capacity-planner.
+        from ..analysis.capacity import check_engine_capacity
+
+        check_engine_capacity(self)
+
         self._queue: deque[Request] = deque()
         self._slots: list[_Slot | None] = [None] * self.n_slots
         self._free: deque[int] = deque(range(self.n_slots))

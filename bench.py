@@ -170,7 +170,7 @@ def main() -> None:
         # campaign"): the ATX601 roofline over the SAME compiled step, so
         # `--compare` can tell "the program got worse" (bound moved) from
         # "the run got slower" (bound unchanged, measured MFU dropped).
-        _RESULT.update(_static_perf_series(step, state, batch))
+        _RESULT.update(_static_perf_series(step, state, batch, config))
     except Exception as e:
         _RESULT["static_perf_error"] = f"{type(e).__name__}: {e}"[:200]
     _phase_snapshot("train")
@@ -215,22 +215,46 @@ def main() -> None:
     print(json.dumps(_RESULT))
 
 
-def _static_perf_series(step, state, batch) -> dict:
-    """ATX601's statically-derived series next to the measured ones: lower
-    + compile the already-built train step (no extra steps run) and bound
-    it against the local chip's roofline spec. Emitted per run so
-    `bench.py --compare` ratchets them alongside the measured MFU."""
-    from accelerate_tpu.analysis import roofline
+def _static_perf_series(step, state, batch, config) -> dict:
+    """ATX601/ATX70x statically-derived series next to the measured ones:
+    lower + compile the already-built train step (no extra steps run),
+    bound it against the local chip's roofline spec, sweep the scheduled
+    HLO for the peak-HBM timeline, and solve the serving capacity planner
+    for this config on this chip. Emitted per run so `bench.py --compare`
+    ratchets them alongside the measured MFU."""
+    from accelerate_tpu.analysis import capacity, memory, roofline
+    from accelerate_tpu.models import llama
 
     text = step.lower(state, batch).compile().as_text()
     spec = roofline.chip_spec_for()
     res = roofline.analyze_hlo(text, spec)
     exposed = roofline.find_exposed_collectives(text, spec)
-    return {
+    out = {
         "train_static_mfu_bound": round(res.static_mfu_bound, 4),
         "train_exposed_comms_mib": round(sum(e.bytes for e in exposed) / 2**20, 3),
         "train_padding_waste_frac": round(res.padding_waste_fraction, 4),
     }
+    try:
+        timeline = memory.build_timeline(text)
+        out["train_peak_hbm_mib"] = round(timeline.peak_bytes / 2**20, 1)
+    except Exception:
+        pass  # the roofline series above still land
+    try:
+        # Serving twin: one abstract KV slot of this config + the bf16
+        # weights it would serve with — the planner needs only byte counts.
+        slot_kv = jax.eval_shape(lambda: llama.init_cache(config, 1, config.max_seq_len))
+        weights = 2 * config.param_count()  # bf16 serving weights
+        plan = capacity.plan_capacity(
+            chip=spec,
+            weights_bytes=weights,
+            kv_bytes_per_slot=capacity.tree_bytes(slot_kv),
+            n_slots=1,
+            max_len=config.max_seq_len,
+        )
+        out["serve_static_max_slots"] = int(plan.max_slots)
+    except Exception:
+        pass
+    return out
 
 
 def _timed_steps(step, state, batch, steps: int, warmup: int, fetch_latency: float | None = None):
@@ -1722,10 +1746,11 @@ def _bench_bert(on_tpu: bool, fetch_latency: float) -> dict:
 _HIGHER_BETTER = (
     "_mfu", "_tokens_per_sec", "_samples_per_sec", "_per_sec", "_tflops",
     "_mib_s", "_gib_s", "_speedup", "_hit_rate", "_flops", "_mfu_bound",
+    "_max_slots",
 )
 _LOWER_BETTER = (
     "_ms", "_s", "_secs", "_compiles", "_gib_per_token", "_comms_mib",
-    "_waste_frac",
+    "_waste_frac", "_peak_hbm_mib",
 )
 
 
